@@ -1,0 +1,28 @@
+"""starrocks_tpu — a TPU-native, vectorized, MPP-parallel OLAP SQL engine.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of StarRocks
+(reference: /root/reference — Java FE + C++ BE). The columnar Chunk model
+(reference: be/src/column/chunk.h:66) becomes static-shaped struct-of-array
+device buffers; the vectorized pipeline engine (be/src/exec/) becomes compiled
+mesh programs; hash-partition exchange (be/src/exec/pipeline/exchange/) maps to
+lax.all_to_all over the TPU ICI mesh.
+
+Subpackages
+-----------
+- ``types``     logical type system (reference: be/src/types/logical_type.h:27)
+- ``column``    columnar chunk model (reference: be/src/column/)
+- ``exprs``     vectorized expression engine (reference: be/src/exprs/)
+- ``ops``       relational operators (reference: be/src/exec/)
+- ``parallel``  mesh sharding + exchange (reference: be/src/exec/pipeline/exchange/)
+- ``sql``       parser/analyzer/optimizer/planner (reference: fe/fe-core/.../sql/)
+- ``storage``   catalog + tablet storage (reference: be/src/storage/)
+- ``runtime``   session, executor, profile, config (reference: be/src/common/, exec/runtime/)
+"""
+
+import jax
+
+# The engine needs 64-bit ints for DECIMAL arithmetic (scaled int64) and
+# DATETIME microseconds; enable before any tracing happens.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

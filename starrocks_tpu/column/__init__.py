@@ -1,0 +1,18 @@
+"""Columnar core: device Chunk model, host tables, string dictionaries.
+
+Reference: be/src/column/ (38k LoC) — see SURVEY.md §2.1 "Column model".
+"""
+
+from .column import Chunk, Field, Schema, chunk_from_arrays, pad_capacity
+from .dict_encoding import StringDict
+from .host_table import HostTable
+
+__all__ = [
+    "Chunk",
+    "Field",
+    "Schema",
+    "StringDict",
+    "HostTable",
+    "chunk_from_arrays",
+    "pad_capacity",
+]

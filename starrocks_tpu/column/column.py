@@ -1,0 +1,233 @@
+"""Device-side columnar chunk model.
+
+Reference behavior: be/src/column/column.h:44 (COW Column hierarchy) and
+be/src/column/chunk.h:66 (Chunk = slot-indexed batch of columns, default 4096
+rows). The TPU re-design replaces dynamic-length COW columns with a
+*static-shaped* struct-of-arrays pytree:
+
+- every column is a fixed-capacity 1-D device array (padded);
+- nullability is a per-column boolean ``valid`` mask (True = not NULL);
+- row liveness is a chunk-wide boolean ``sel`` mask (True = live row),
+  replacing physical filtering/compaction — filters AND into ``sel`` and
+  compaction happens only where an operator genuinely needs it (exchange,
+  join build). This is the central static-shape design decision (SURVEY §7).
+
+A Chunk is a registered JAX pytree whose aux data is the (hashable) schema, so
+jitted query programs specialize on schema+capacity and cache across calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import LogicalType, TypeKind, VARCHAR
+from .dict_encoding import StringDict
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    """Schema entry for one column. Hashable (StringDict hashes by identity)."""
+
+    name: str
+    type: LogicalType
+    nullable: bool = True
+    dict: Optional[StringDict] = None
+
+    def __repr__(self):
+        n = "" if self.nullable else " NOT NULL"
+        return f"{self.name}:{self.type}{n}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    fields: tuple
+
+    def __post_init__(self):
+        object.__setattr__(self, "fields", tuple(self.fields))
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __len__(self):
+        return len(self.fields)
+
+    @property
+    def names(self):
+        return tuple(f.name for f in self.fields)
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(f"no column {name!r}; have {self.names}")
+
+    def index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __repr__(self):
+        return "Schema(" + ", ".join(map(repr, self.fields)) + ")"
+
+
+def pad_capacity(n: int, align: int = 1024) -> int:
+    """Round row count up to a TPU-friendly capacity (multiple of 1024)."""
+    if n <= 0:
+        return align
+    return ((n + align - 1) // align) * align
+
+
+class Chunk:
+    """Fixed-capacity columnar batch on device. Immutable; pytree.
+
+    data:  tuple of 1-D arrays, one per schema field, all the same length.
+    valid: tuple of (bool array | None) per field; None = no NULLs possible.
+    sel:   bool array | None; None = all capacity rows are live.
+    """
+
+    __slots__ = ("schema", "data", "valid", "sel")
+
+    def __init__(self, schema: Schema, data, valid, sel):
+        self.schema = schema
+        self.data = tuple(data)
+        self.valid = tuple(valid)
+        self.sel = sel
+        assert len(self.data) == len(schema.fields)
+        assert len(self.valid) == len(schema.fields)
+
+    # --- basic accessors ----------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.data[0].shape[0] if self.data else 0
+
+    def col(self, name: str):
+        """Returns (data, valid|None) for a column."""
+        i = self.schema.index(name)
+        return self.data[i], self.valid[i]
+
+    def field(self, name: str) -> Field:
+        return self.schema.field(name)
+
+    def num_rows(self):
+        """Traced live-row count."""
+        if self.sel is None:
+            return jnp.asarray(self.capacity, dtype=jnp.int64)
+        return jnp.sum(self.sel, dtype=jnp.int64)
+
+    def sel_mask(self):
+        """Always-materialized selection mask."""
+        if self.sel is None:
+            return jnp.ones((self.capacity,), dtype=jnp.bool_)
+        return self.sel
+
+    # --- functional updates -------------------------------------------------
+    def with_sel(self, sel) -> "Chunk":
+        return Chunk(self.schema, self.data, self.valid, sel)
+
+    def and_sel(self, mask) -> "Chunk":
+        sel = mask if self.sel is None else (self.sel & mask)
+        return Chunk(self.schema, self.data, self.valid, sel)
+
+    def with_columns(self, new_fields, new_data, new_valid) -> "Chunk":
+        """Append columns (replacing any with the same name)."""
+        keep = [
+            i
+            for i, f in enumerate(self.schema.fields)
+            if f.name not in {nf.name for nf in new_fields}
+        ]
+        fields = tuple(self.schema.fields[i] for i in keep) + tuple(new_fields)
+        data = tuple(self.data[i] for i in keep) + tuple(new_data)
+        valid = tuple(self.valid[i] for i in keep) + tuple(new_valid)
+        return Chunk(Schema(fields), data, valid, self.sel)
+
+    def project(self, names) -> "Chunk":
+        idx = [self.schema.index(n) for n in names]
+        return Chunk(
+            Schema(tuple(self.schema.fields[i] for i in idx)),
+            tuple(self.data[i] for i in idx),
+            tuple(self.valid[i] for i in idx),
+            self.sel,
+        )
+
+    def rename(self, mapping: dict) -> "Chunk":
+        fields = tuple(
+            dataclasses.replace(f, name=mapping.get(f.name, f.name))
+            for f in self.schema.fields
+        )
+        return Chunk(Schema(fields), self.data, self.valid, self.sel)
+
+    def take(self, indices, row_valid=None) -> "Chunk":
+        """Gather rows by index; optional row_valid marks live output rows."""
+        data = tuple(d[indices] for d in self.data)
+        valid = tuple(None if v is None else v[indices] for v in self.valid)
+        sel = None
+        if self.sel is not None:
+            sel = self.sel[indices]
+        if row_valid is not None:
+            sel = row_valid if sel is None else (sel & row_valid)
+        return Chunk(self.schema, data, valid, sel)
+
+    # --- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.valid, self.sel), self.schema
+
+    @classmethod
+    def tree_unflatten(cls, schema, children):
+        data, valid, sel = children
+        return cls(schema, data, valid, sel)
+
+    def __repr__(self):
+        return f"Chunk(cap={self.capacity}, {self.schema})"
+
+
+jax.tree_util.register_pytree_node(
+    Chunk, Chunk.tree_flatten, Chunk.tree_unflatten
+)
+
+
+# --- construction helpers ---------------------------------------------------
+
+
+def chunk_from_arrays(
+    schema: Schema,
+    arrays: dict,
+    valids: dict | None = None,
+    n_rows: int | None = None,
+    capacity: int | None = None,
+) -> Chunk:
+    """Build a device Chunk from host numpy arrays, padding to capacity."""
+    valids = valids or {}
+    first = next(iter(arrays.values()))
+    n = len(first) if n_rows is None else n_rows
+    cap = capacity if capacity is not None else pad_capacity(n)
+    data, valid = [], []
+    for f in schema.fields:
+        a = np.asarray(arrays[f.name])
+        if a.dtype != f.type.np_dtype:
+            a = a.astype(f.type.np_dtype)
+        if len(a) < cap:
+            a = np.concatenate([a, np.zeros(cap - len(a), dtype=a.dtype)])
+        elif len(a) > cap:
+            raise ValueError(f"column {f.name}: {len(a)} rows > capacity {cap}")
+        data.append(jnp.asarray(a))
+        v = valids.get(f.name)
+        if v is None:
+            valid.append(None)
+        else:
+            v = np.asarray(v, dtype=np.bool_)
+            if len(v) > cap:
+                raise ValueError(f"valid mask {f.name}: {len(v)} rows > capacity {cap}")
+            if len(v) < cap:
+                v = np.concatenate([v, np.zeros(cap - len(v), dtype=np.bool_)])
+            valid.append(jnp.asarray(v))
+    if n == cap:
+        sel = None
+    else:
+        sel = jnp.asarray(np.arange(cap) < n)
+    return Chunk(schema, data, valid, sel)

@@ -1,0 +1,96 @@
+"""Host-side string dictionaries.
+
+TPUs cannot chase pointers, so every VARCHAR column is dictionary-encoded at
+ingest: the device sees int32 codes, the dictionary (sorted unique values)
+stays on the host. This generalizes the reference's low-cardinality global
+dict optimization (be/src/compute_env/global_dict/parser.h, FE
+sql/optimizer/CacheDictManager.java) into *the* string representation.
+
+Because the dictionary is sorted, code order == lexicographic order, so
+<, >, ORDER BY, and min/max on codes are directly correct, and prefix-LIKE
+predicates become code-range tests. Arbitrary string predicates are evaluated
+host-side over the (small) dictionary into a boolean LUT that the device
+gathers per-row.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class StringDict:
+    """Immutable sorted dictionary of strings -> int32 codes.
+
+    Identity-hashed so it can ride in jit-static schema metadata without
+    hashing the whole vocabulary on every trace.
+    """
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: np.ndarray):
+        # values must be a sorted unique array of python str / np.str_
+        self.values = np.asarray(values, dtype=object)
+        self._index: dict | None = None
+
+    @classmethod
+    def from_strings(cls, strings) -> tuple["StringDict", np.ndarray]:
+        """Build a dict from raw strings; returns (dict, int32 codes)."""
+        arr = np.asarray(strings, dtype=object)
+        uniq, codes = np.unique(arr.astype(str), return_inverse=True)
+        return cls(uniq.astype(object)), codes.astype(np.int32)
+
+    @classmethod
+    def from_values(cls, sorted_unique) -> "StringDict":
+        return cls(np.asarray(sorted_unique, dtype=object))
+
+    def __len__(self):
+        return len(self.values)
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+    @property
+    def index(self) -> dict:
+        if self._index is None:
+            self._index = {v: i for i, v in enumerate(self.values)}
+        return self._index
+
+    def encode_one(self, s: str) -> int:
+        """Code for s, or -1 if absent."""
+        return self.index.get(s, -1)
+
+    def encode(self, strings) -> np.ndarray:
+        idx = self.index
+        return np.fromiter(
+            (idx.get(s, -1) for s in strings), count=len(strings), dtype=np.int32
+        )
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        """Codes -> strings; the -1 'absent' sentinel decodes to ""."""
+        codes = np.asarray(codes)
+        out = self.values[np.clip(codes, 0, max(len(self.values) - 1, 0))]
+        if len(out) and (codes < 0).any():
+            out = out.copy()
+            out[codes < 0] = ""
+        return out
+
+    def lut(self, predicate) -> np.ndarray:
+        """Boolean lookup table: lut[code] = predicate(values[code]).
+
+        The device evaluates arbitrary string predicates as lut[codes]."""
+        return np.fromiter(
+            (bool(predicate(v)) for v in self.values),
+            count=len(self.values),
+            dtype=np.bool_,
+        )
+
+    def merge(self, other: "StringDict") -> tuple["StringDict", np.ndarray, np.ndarray]:
+        """Union two dicts; returns (merged, remap_self, remap_other)."""
+        merged = np.unique(
+            np.concatenate([self.values.astype(str), other.values.astype(str)])
+        )
+        md = StringDict(merged.astype(object))
+        return md, md.encode(self.values), md.encode(other.values)

@@ -1,0 +1,246 @@
+"""Host-side columnar tables and host<->device conversion.
+
+The host table is the ingest/result-side twin of the device Chunk: numpy
+struct-of-arrays with the same schema, unpadded, with VARCHAR kept as dict
+codes + a StringDict. Reference analog: the Arrow conversion layer
+(be/src/column/arrow/) and result materialization
+(be/src/data_sink/result/mysql_result_writer.h:48).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types import LogicalType, TypeKind, VARCHAR, null_value
+from .column import Chunk, Field, Schema, chunk_from_arrays, pad_capacity
+from .dict_encoding import StringDict
+
+
+class HostTable:
+    """Unpadded columnar data on host. arrays[name] is numpy, codes for VARCHAR."""
+
+    def __init__(self, schema: Schema, arrays: dict, valids: dict | None = None):
+        self.schema = schema
+        self.arrays = {f.name: np.asarray(arrays[f.name]) for f in schema.fields}
+        self.valids = {
+            k: np.asarray(v, dtype=np.bool_)
+            for k, v in (valids or {}).items()
+            if v is not None
+        }
+        lens = {len(a) for a in self.arrays.values()}
+        assert len(lens) <= 1, f"ragged columns: { {k: len(v) for k, v in self.arrays.items()} }"
+
+    @property
+    def num_rows(self) -> int:
+        if not self.arrays:
+            return 0
+        return len(next(iter(self.arrays.values())))
+
+    # --- construction -------------------------------------------------------
+    @classmethod
+    def from_pydict(cls, data: dict, types: dict | None = None, nullable=True):
+        """Build from {name: list/array}; strings are dict-encoded; None = NULL."""
+        types = types or {}
+        fields, arrays, valids = [], {}, {}
+        for name, values in data.items():
+            vals = list(values) if not isinstance(values, np.ndarray) else values
+            t = types.get(name)
+            nulls = None
+            if isinstance(vals, list) and any(v is None for v in vals):
+                nulls = np.array([v is None for v in vals])
+                fill = "" if (t is None and any(isinstance(v, str) for v in vals if v is not None)) or (t is not None and t.is_string) else 0
+                vals = [fill if v is None else v for v in vals]
+            if t is None:
+                t = _infer_type(vals)
+            if t.is_string:
+                d, codes = StringDict.from_strings([str(v) for v in vals])
+                fields.append(Field(name, VARCHAR, nullable, d))
+                arrays[name] = codes
+            else:
+                a = np.asarray(vals)
+                if t.is_decimal and a.dtype.kind in "iu":
+                    # inputs are unscaled logical values; store scaled ints
+                    a = a.astype(np.int64) * 10 ** t.scale
+                elif t.is_decimal and a.dtype.kind == "f":
+                    a = np.round(a * 10 ** t.scale).astype(np.int64)
+                arrays[name] = a.astype(t.np_dtype)
+                fields.append(Field(name, t, nullable))
+            if nulls is not None:
+                valids[name] = ~nulls
+        return cls(Schema(tuple(fields)), arrays, valids)
+
+    @classmethod
+    def from_arrow(cls, table, decimal_scales: dict | None = None):
+        """Convert a pyarrow Table (used by the parquet storage layer)."""
+        import pyarrow as pa
+
+        fields, arrays, valids = [], {}, {}
+        for col_name in table.column_names:
+            col = table.column(col_name).combine_chunks()
+            at = col.type
+            nulls = None
+            if col.null_count:
+                nulls = ~np.asarray(col.is_null())
+            if pa.types.is_string(at) or pa.types.is_large_string(at) or pa.types.is_dictionary(at):
+                if pa.types.is_dictionary(at):
+                    col = col.cast(pa.string())
+                svals = col.to_pylist()
+                svals = ["" if v is None else v for v in svals]
+                d, codes = StringDict.from_strings(svals)
+                fields.append(Field(col_name, VARCHAR, True, d))
+                arrays[col_name] = codes
+            elif pa.types.is_decimal(at):
+                scale = at.scale
+                ints = np.array(
+                    [0 if v is None else int(v.scaleb(scale).to_integral_value()) for v in col.to_pylist()],
+                    dtype=np.int64,
+                )
+                t = LogicalType(TypeKind.DECIMAL, min(at.precision, 18), scale)
+                fields.append(Field(col_name, t, True))
+                arrays[col_name] = ints
+            elif pa.types.is_date(at):
+                days = col.cast(pa.int32()).to_numpy(zero_copy_only=False)
+                fields.append(Field(col_name, LogicalType(TypeKind.DATE), True))
+                arrays[col_name] = np.nan_to_num(days).astype(np.int32)
+            elif pa.types.is_timestamp(at):
+                us = col.cast(pa.timestamp("us")).cast(pa.int64()).to_numpy(zero_copy_only=False)
+                fields.append(Field(col_name, LogicalType(TypeKind.DATETIME), True))
+                arrays[col_name] = np.nan_to_num(us).astype(np.int64)
+            else:
+                # Fill nulls *in arrow* first: to_numpy on a column with nulls
+                # widens ints to float64 (corrupting int64 > 2^53) and turns
+                # bools into object arrays.
+                t = _arrow_to_logical(at)
+                filled = col.fill_null(False if t.kind is TypeKind.BOOLEAN else 0)
+                a = filled.to_numpy(zero_copy_only=False)
+                fields.append(Field(col_name, t, True))
+                arrays[col_name] = a.astype(t.np_dtype)
+            if nulls is not None:
+                valids[col_name] = nulls
+        return cls(Schema(tuple(fields)), arrays, valids)
+
+    # --- device -------------------------------------------------------------
+    def to_chunk(self, capacity: int | None = None) -> Chunk:
+        return chunk_from_arrays(
+            self.schema, self.arrays, self.valids, self.num_rows, capacity
+        )
+
+    @classmethod
+    def from_chunk(cls, chunk: Chunk) -> "HostTable":
+        """Pull a device chunk back to host, dropping dead rows."""
+        sel = np.asarray(chunk.sel_mask())
+        arrays, valids = {}, {}
+        for i, f in enumerate(chunk.schema.fields):
+            a = np.asarray(chunk.data[i])[sel]
+            arrays[f.name] = a
+            if chunk.valid[i] is not None:
+                valids[f.name] = np.asarray(chunk.valid[i])[sel]
+        return cls(chunk.schema, arrays, valids)
+
+    # --- result materialization --------------------------------------------
+    def to_pylist(self) -> list:
+        """Rows as python tuples with dicts decoded and NULLs as None."""
+        out = []
+        cols = []
+        for f in self.schema.fields:
+            a = self.arrays[f.name]
+            v = self.valids.get(f.name)
+            if f.type.is_string and f.dict is not None:
+                decoded = f.dict.decode(a)
+                cols.append((decoded, v, f))
+            else:
+                cols.append((a, v, f))
+        for r in range(self.num_rows):
+            row = []
+            for a, v, f in cols:
+                if v is not None and not v[r]:
+                    row.append(None)
+                elif f.type.is_decimal:
+                    row.append(int(a[r]) / (10 ** f.type.scale))
+                elif f.type.kind is TypeKind.DATE:
+                    row.append(
+                        np.datetime64(int(a[r]), "D").astype("datetime64[D]").astype(str)
+                    )
+                elif f.type.kind is TypeKind.DATETIME:
+                    row.append(str(np.datetime64(int(a[r]), "us")))
+                elif f.type.is_float:
+                    row.append(float(a[r]))
+                elif f.type.kind is TypeKind.BOOLEAN:
+                    row.append(bool(a[r]))
+                elif f.type.is_string:
+                    row.append(str(a[r]))
+                else:
+                    row.append(int(a[r]))
+            out.append(tuple(row))
+        return out
+
+    def to_pandas(self):
+        import pandas as pd
+
+        cols = {}
+        for f in self.schema.fields:
+            a = self.arrays[f.name]
+            v = self.valids.get(f.name)
+            if f.type.is_string and f.dict is not None:
+                s = pd.Series(f.dict.decode(a))
+            elif f.type.is_decimal:
+                s = pd.Series(a / 10 ** f.type.scale)
+            elif f.type.kind is TypeKind.DATE:
+                s = pd.Series(a.astype("datetime64[D]"))
+            elif f.type.kind is TypeKind.DATETIME:
+                s = pd.Series(a.astype("datetime64[us]"))
+            else:
+                s = pd.Series(a)
+            if v is not None:
+                s = s.mask(~v)
+            cols[f.name] = s
+        return pd.DataFrame(cols)
+
+
+def _infer_type(vals) -> LogicalType:
+    a = np.asarray(vals)
+    if a.dtype.kind in ("U", "S", "O"):
+        return VARCHAR
+    return _numpy_to_logical(a.dtype)
+
+
+def _arrow_to_logical(at) -> LogicalType:
+    import pyarrow as pa
+
+    m = [
+        (pa.types.is_boolean, TypeKind.BOOLEAN),
+        (pa.types.is_int8, TypeKind.TINYINT),
+        (pa.types.is_int16, TypeKind.SMALLINT),
+        (pa.types.is_int32, TypeKind.INT),
+        (pa.types.is_int64, TypeKind.BIGINT),
+        (pa.types.is_uint8, TypeKind.SMALLINT),
+        (pa.types.is_uint16, TypeKind.INT),
+        (pa.types.is_uint32, TypeKind.BIGINT),
+        (pa.types.is_uint64, TypeKind.BIGINT),
+        (pa.types.is_float32, TypeKind.FLOAT),
+        (pa.types.is_float64, TypeKind.DOUBLE),
+    ]
+    for pred, kind in m:
+        if pred(at):
+            return LogicalType(kind)
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def _numpy_to_logical(dt) -> LogicalType:
+    dt = np.dtype(dt)
+    m = {
+        np.dtype(np.bool_): TypeKind.BOOLEAN,
+        np.dtype(np.int8): TypeKind.TINYINT,
+        np.dtype(np.int16): TypeKind.SMALLINT,
+        np.dtype(np.int32): TypeKind.INT,
+        np.dtype(np.int64): TypeKind.BIGINT,
+        np.dtype(np.uint8): TypeKind.SMALLINT,
+        np.dtype(np.uint16): TypeKind.INT,
+        np.dtype(np.uint32): TypeKind.BIGINT,
+        np.dtype(np.uint64): TypeKind.BIGINT,
+        np.dtype(np.float32): TypeKind.FLOAT,
+        np.dtype(np.float64): TypeKind.DOUBLE,
+    }
+    if dt in m:
+        return LogicalType(m[dt])
+    raise TypeError(f"unsupported numpy dtype {dt}")

@@ -1,0 +1,204 @@
+"""Logical type system.
+
+Reference behavior: be/src/types/logical_type.h:27 defines 40+ LogicalTypes.
+On TPU we map every type onto a fixed-width device representation:
+
+- BOOLEAN            -> bool_
+- TINYINT..BIGINT    -> int8/int16/int32/int64
+- FLOAT/DOUBLE       -> float32/float64
+- DECIMAL(p, s)      -> scaled int64 (p <= 18); the value is data * 10**-s.
+                        (DECIMAL128 emulation via int64 pairs is future work;
+                        p<=18 covers TPC-H/SSB/TPC-DS.)
+- DATE               -> int32 days since 1970-01-01
+- DATETIME           -> int64 microseconds since epoch
+- VARCHAR/CHAR       -> int32 dictionary codes; the dictionary itself lives
+                        host-side (see column/dict_encoding.py). This is the
+                        global-dict strategy the reference already uses for
+                        low-cardinality strings (be/src/compute_env/global_dict/,
+                        fe CacheDictManager.java) promoted to *the* string
+                        representation, because TPUs cannot chase pointers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TypeKind(Enum):
+    BOOLEAN = "boolean"
+    TINYINT = "tinyint"
+    SMALLINT = "smallint"
+    INT = "int"
+    BIGINT = "bigint"
+    FLOAT = "float"
+    DOUBLE = "double"
+    DECIMAL = "decimal"
+    DATE = "date"
+    DATETIME = "datetime"
+    VARCHAR = "varchar"
+    NULL = "null"  # type of a bare NULL literal
+
+
+_INT_KINDS = (TypeKind.TINYINT, TypeKind.SMALLINT, TypeKind.INT, TypeKind.BIGINT)
+_NUMERIC_KINDS = _INT_KINDS + (TypeKind.FLOAT, TypeKind.DOUBLE, TypeKind.DECIMAL)
+
+_DTYPES = {
+    TypeKind.BOOLEAN: jnp.bool_,
+    TypeKind.TINYINT: jnp.int8,
+    TypeKind.SMALLINT: jnp.int16,
+    TypeKind.INT: jnp.int32,
+    TypeKind.BIGINT: jnp.int64,
+    TypeKind.FLOAT: jnp.float32,
+    TypeKind.DOUBLE: jnp.float64,
+    TypeKind.DECIMAL: jnp.int64,
+    TypeKind.DATE: jnp.int32,
+    TypeKind.DATETIME: jnp.int64,
+    TypeKind.VARCHAR: jnp.int32,
+    TypeKind.NULL: jnp.int32,
+}
+
+_NP_DTYPES = {
+    TypeKind.BOOLEAN: np.bool_,
+    TypeKind.TINYINT: np.int8,
+    TypeKind.SMALLINT: np.int16,
+    TypeKind.INT: np.int32,
+    TypeKind.BIGINT: np.int64,
+    TypeKind.FLOAT: np.float32,
+    TypeKind.DOUBLE: np.float64,
+    TypeKind.DECIMAL: np.int64,
+    TypeKind.DATE: np.int32,
+    TypeKind.DATETIME: np.int64,
+    TypeKind.VARCHAR: np.int32,
+    TypeKind.NULL: np.int32,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class LogicalType:
+    """A SQL-level type. Hashable and comparable so it can be jit-static."""
+
+    kind: TypeKind
+    precision: int | None = None  # DECIMAL only
+    scale: int | None = None  # DECIMAL only
+
+    def __post_init__(self):
+        if self.kind is TypeKind.DECIMAL:
+            p = self.precision if self.precision is not None else 18
+            s = self.scale if self.scale is not None else 0
+            if p > 18:
+                raise NotImplementedError(
+                    f"DECIMAL({p},{s}): precision > 18 not supported yet"
+                )
+            object.__setattr__(self, "precision", p)
+            object.__setattr__(self, "scale", s)
+
+    # --- device/host dtypes -------------------------------------------------
+    @property
+    def dtype(self):
+        return _DTYPES[self.kind]
+
+    @property
+    def np_dtype(self):
+        return _NP_DTYPES[self.kind]
+
+    # --- classification -----------------------------------------------------
+    @property
+    def is_numeric(self) -> bool:
+        return self.kind in _NUMERIC_KINDS
+
+    @property
+    def is_integer(self) -> bool:
+        return self.kind in _INT_KINDS
+
+    @property
+    def is_float(self) -> bool:
+        return self.kind in (TypeKind.FLOAT, TypeKind.DOUBLE)
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.kind is TypeKind.DECIMAL
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind is TypeKind.VARCHAR
+
+    @property
+    def is_temporal(self) -> bool:
+        return self.kind in (TypeKind.DATE, TypeKind.DATETIME)
+
+    def __repr__(self):
+        if self.kind is TypeKind.DECIMAL:
+            return f"DECIMAL({self.precision},{self.scale})"
+        return self.kind.name
+
+
+# Convenience singletons
+BOOLEAN = LogicalType(TypeKind.BOOLEAN)
+TINYINT = LogicalType(TypeKind.TINYINT)
+SMALLINT = LogicalType(TypeKind.SMALLINT)
+INT = LogicalType(TypeKind.INT)
+BIGINT = LogicalType(TypeKind.BIGINT)
+FLOAT = LogicalType(TypeKind.FLOAT)
+DOUBLE = LogicalType(TypeKind.DOUBLE)
+DATE = LogicalType(TypeKind.DATE)
+DATETIME = LogicalType(TypeKind.DATETIME)
+VARCHAR = LogicalType(TypeKind.VARCHAR)
+NULLTYPE = LogicalType(TypeKind.NULL)
+
+
+def DECIMAL(precision: int = 18, scale: int = 0) -> LogicalType:
+    return LogicalType(TypeKind.DECIMAL, precision, scale)
+
+
+# --- type promotion ---------------------------------------------------------
+
+_INT_RANK = {
+    TypeKind.TINYINT: 0,
+    TypeKind.SMALLINT: 1,
+    TypeKind.INT: 2,
+    TypeKind.BIGINT: 3,
+}
+
+
+def common_numeric_type(a: LogicalType, b: LogicalType) -> LogicalType:
+    """Result type when two numerics meet in arithmetic/comparison.
+
+    Rules (mirrors the reference's implicit cast lattice, simplified):
+    int x int -> wider int; any float -> DOUBLE (FLOAT only if both FLOAT);
+    decimal x int -> decimal; decimal x float -> DOUBLE;
+    decimal x decimal -> decimal with max scale.
+    """
+    if a.kind == TypeKind.NULL:
+        return b
+    if b.kind == TypeKind.NULL:
+        return a
+    if not (a.is_numeric and b.is_numeric):
+        if a == b:
+            return a
+        raise TypeError(f"no common numeric type for {a} and {b}")
+    if a.is_float or b.is_float:
+        if a.kind == TypeKind.FLOAT and b.kind == TypeKind.FLOAT:
+            return FLOAT
+        return DOUBLE
+    if a.is_decimal or b.is_decimal:
+        sa = a.scale if a.is_decimal else 0
+        sb = b.scale if b.is_decimal else 0
+        return DECIMAL(18, max(sa, sb))
+    rank = max(_INT_RANK[a.kind], _INT_RANK[b.kind])
+    for k, r in _INT_RANK.items():
+        if r == rank:
+            return LogicalType(k)
+    raise AssertionError
+
+
+def null_value(t: LogicalType):
+    """Placeholder stored in null slots (never observed through the mask)."""
+    if t.kind is TypeKind.BOOLEAN:
+        return False
+    if t.is_float:
+        return 0.0
+    return 0
