@@ -1,0 +1,168 @@
+"""Expression IR.
+
+Reference behavior: be/src/exprs/expr.h:70 (vectorized expr trees evaluated
+over Chunks). Here an expression is an immutable, hashable tree compiled
+(at jit-trace time) to pure jax array ops — the analog of the reference's
+Expr::evaluate over a Chunk, but fused by XLA instead of tree-walked.
+
+Nodes are deliberately minimal: Col / Lit / Call / Case / Cast / InList.
+Aggregate calls (AggExpr) only appear inside aggregation operator specs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..types import LogicalType
+
+
+class Expr:
+    """Base. All subclasses are frozen dataclasses => hashable, usable as
+    jit-static plan attributes and plan-cache keys."""
+
+    __slots__ = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Col(Expr):
+    name: str
+
+    def __repr__(self):
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class Lit(Expr):
+    value: Any
+    type: Optional[LogicalType] = None  # inferred when None
+
+    def __repr__(self):
+        return repr(self.value)
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(Expr):
+    fn: str
+    args: tuple
+
+    def __init__(self, fn, *args):
+        object.__setattr__(self, "fn", fn)
+        object.__setattr__(self, "args", tuple(args))
+
+    def __repr__(self):
+        return f"{self.fn}({', '.join(map(repr, self.args))})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Case(Expr):
+    """CASE WHEN c1 THEN v1 ... ELSE e END (search form)."""
+
+    whens: tuple  # tuple[(cond_expr, value_expr)]
+    orelse: Optional[Expr]
+
+    def __repr__(self):
+        parts = " ".join(f"WHEN {c} THEN {v}" for c, v in self.whens)
+        return f"CASE {parts} ELSE {self.orelse} END"
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expr):
+    arg: Expr
+    to: LogicalType
+
+    def __repr__(self):
+        return f"CAST({self.arg} AS {self.to})"
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Expr):
+    arg: Expr
+    values: tuple
+    negated: bool = False
+
+    def __repr__(self):
+        return f"{self.arg} {'NOT ' if self.negated else ''}IN {self.values}"
+
+
+@dataclasses.dataclass(frozen=True)
+class AggExpr(Expr):
+    """Aggregate function reference used in aggregation specs."""
+
+    fn: str  # sum | count | avg | min | max | count_star | count_distinct
+    arg: Optional[Expr]  # None for count(*)
+    distinct: bool = False
+
+    def __repr__(self):
+        a = "*" if self.arg is None else repr(self.arg)
+        d = "DISTINCT " if self.distinct else ""
+        return f"{self.fn}({d}{a})"
+
+
+# --- sugar builders ---------------------------------------------------------
+
+
+def col(name: str) -> Col:
+    return Col(name)
+
+
+def lit(value, type: LogicalType | None = None) -> Lit:
+    return Lit(value, type)
+
+
+def _b(fn):
+    def build(*args):
+        return Call(fn, *(a if isinstance(a, Expr) else Lit(a) for a in args))
+
+    return build
+
+
+add = _b("add")
+sub = _b("subtract")
+mul = _b("multiply")
+div = _b("divide")
+eq = _b("eq")
+ne = _b("ne")
+lt = _b("lt")
+le = _b("le")
+gt = _b("gt")
+ge = _b("ge")
+and_ = _b("and")
+or_ = _b("or")
+not_ = _b("not")
+is_null = _b("is_null")
+is_not_null = _b("is_not_null")
+like = _b("like")
+coalesce = _b("coalesce")
+year = _b("year")
+month = _b("month")
+day = _b("day")
+
+
+def between(x, lo, hi):
+    return and_(ge(x, lo), le(x, hi))
+
+
+def walk(e: Expr):
+    """Yield every node in the tree (pre-order)."""
+    yield e
+    if isinstance(e, Call):
+        for a in e.args:
+            yield from walk(a)
+    elif isinstance(e, Case):
+        for c, v in e.whens:
+            yield from walk(c)
+            yield from walk(v)
+        if e.orelse is not None:
+            yield from walk(e.orelse)
+    elif isinstance(e, Cast):
+        yield from walk(e.arg)
+    elif isinstance(e, InList):
+        yield from walk(e.arg)
+    elif isinstance(e, AggExpr):
+        if e.arg is not None:
+            yield from walk(e.arg)
+
+
+def referenced_columns(e: Expr) -> set:
+    return {n.name for n in walk(e) if isinstance(n, Col)}

@@ -1,27 +1,34 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test harness: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's PseudoCluster strategy (fe test
 pseudocluster/PseudoCluster.java:1 — multi-"node" cluster in one JVM): we fake
-a multi-chip TPU slice with 8 host devices so sharding/exchange logic is
+a multi-chip TPU slice with 8 host CPU devices so sharding/exchange logic is
 exercised without hardware.
+
+Environment note: this container preloads an `axon` PJRT plugin (real-TPU
+tunnel) via sitecustomize, which force-sets jax_platforms="axon,cpu" — eager
+test ops would each take a network round trip (or hang). The conftest flips
+the already-imported jax config back to cpu *before any backend initializes*,
+and widens the host platform to 8 virtual devices.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
-    import jax
-
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
