@@ -1,0 +1,84 @@
+"""Benchmark: TPC-H Q1 (scan + filter + group-by aggregation) on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- value: lineitem rows/sec through the full jitted Q1 plan (post-compile,
+  best of N timed runs, data resident on device).
+- vs_baseline: speedup vs a single-process pandas implementation of the same
+  query on the same host (the stand-in for the reference BE's single-node
+  vectorized CPU path; see BASELINE.md for the reference's published cluster
+  numbers).
+
+Scale factor via SR_TPU_BENCH_SF (default 1.0 -> ~6M lineitem rows).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    sf = float(os.environ.get("SR_TPU_BENCH_SF", "1.0"))
+    repeats = int(os.environ.get("SR_TPU_BENCH_REPEATS", "5"))
+
+    import jax
+
+    from __graft_entry__ import _q1_plan
+    from starrocks_tpu.column import HostTable
+    from starrocks_tpu.storage.datagen.tpch import gen_tpch
+    from tests.test_tpch_q1 import q1_pandas  # same query, pandas oracle
+
+    t0 = time.time()
+    li = gen_tpch(sf=sf)["lineitem"]
+    n_rows = li.num_rows
+    gen_s = time.time() - t0
+
+    # --- pandas baseline (single-node CPU stand-in) --------------------------
+    df = li.to_pandas()
+    import pandas as pd
+
+    cutoff = pd.Timestamp("1998-09-02")
+    t0 = time.time()
+    expected = q1_pandas(df, cutoff)
+    pandas_s = time.time() - t0
+
+    # --- device path ----------------------------------------------------------
+    chunk = li.to_chunk()  # host->device
+    fn = jax.jit(_q1_plan)
+    out, ng = fn(chunk)  # compile + first run
+    jax.block_until_ready(out.data)
+    compile_s = time.time() - t0 - pandas_s
+
+    best = float("inf")
+    for _ in range(repeats):
+        t1 = time.time()
+        out, ng = fn(chunk)
+        jax.block_until_ready(out.data)
+        best = min(best, time.time() - t1)
+
+    # correctness guard: compare against pandas
+    got = HostTable.from_chunk(out).to_pylist()
+    assert int(ng) == len(expected), (int(ng), len(expected))
+    for row, (_, exp) in zip(got, expected.iterrows()):
+        assert row[0] == exp["l_returnflag"] and row[1] == exp["l_linestatus"]
+        rel = abs(row[2] - exp["sum_qty"]) / max(abs(exp["sum_qty"]), 1)
+        assert rel < 1e-9, (row, exp)
+
+    rows_per_sec = n_rows / best
+    result = {
+        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
+        "value": round(rows_per_sec),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(pandas_s / best, 3),
+    }
+    print(json.dumps(result))
+    print(
+        f"# backend={jax.default_backend()} rows={n_rows} gen={gen_s:.2f}s "
+        f"pandas={pandas_s*1000:.0f}ms compile={compile_s:.1f}s "
+        f"best_device={best*1000:.1f}ms",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

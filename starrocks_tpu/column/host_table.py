@@ -39,10 +39,23 @@ class HostTable:
     # --- construction -------------------------------------------------------
     @classmethod
     def from_pydict(cls, data: dict, types: dict | None = None, nullable=True):
-        """Build from {name: list/array}; strings are dict-encoded; None = NULL."""
+        """Build from {name: list/array}; strings are dict-encoded; None = NULL.
+
+        Fast path: a value may be (StringDict, int32_codes) to skip the
+        expensive unique/encode pass (used by data generators and storage).
+        """
         types = types or {}
         fields, arrays, valids = [], {}, {}
         for name, values in data.items():
+            if (
+                isinstance(values, tuple)
+                and len(values) == 2
+                and isinstance(values[0], StringDict)
+            ):
+                d, codes = values
+                fields.append(Field(name, VARCHAR, nullable, d))
+                arrays[name] = np.asarray(codes, dtype=np.int32)
+                continue
             vals = list(values) if not isinstance(values, np.ndarray) else values
             t = types.get(name)
             nulls = None

@@ -1,0 +1,28 @@
+"""Relational operators over Chunks (reference: be/src/exec/, SURVEY §2.1).
+
+Every operator is a pure function Chunk -> Chunk (plus static params), so a
+query plan composes into one jittable program — the compiled analog of the
+reference's PipelineDriver::process pull/push loop
+(be/src/exec/runtime/pipeline_driver.cpp:351).
+"""
+
+from .aggregate import COMPLETE, FINAL, PARTIAL, final_agg_exprs, hash_aggregate
+from .common import compact
+from .filter import filter_chunk, project
+from .join import (
+    INNER,
+    LEFT_ANTI,
+    LEFT_OUTER,
+    LEFT_SEMI,
+    hash_join_expand,
+    hash_join_unique,
+    pack_keys,
+)
+from .sort import limit_chunk, sort_chunk
+
+__all__ = [
+    "COMPLETE", "FINAL", "PARTIAL", "INNER", "LEFT_ANTI", "LEFT_OUTER",
+    "LEFT_SEMI", "compact", "filter_chunk", "final_agg_exprs",
+    "hash_aggregate", "hash_join_expand", "hash_join_unique", "limit_chunk",
+    "pack_keys", "project", "sort_chunk",
+]

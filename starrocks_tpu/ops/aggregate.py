@@ -1,0 +1,247 @@
+"""Sort-based grouped aggregation.
+
+Reference behavior: be/src/exec/aggregator.h:255 + agg hash maps
+(be/src/exec/aggregate/agg_hash_variant.h) — blocking hash aggregation with
+two-phase (local partial / global final) splitting for distribution
+(SURVEY §2.4 item 4). TPUs lack a scatter-friendly memory model, so instead
+of a hash table we use: lexicographic multi-key sort -> segment boundaries ->
+segment reductions. Group count has a *static capacity*; the operator returns
+the true group count so the host executor can detect overflow and recompile
+at a larger capacity (the adaptive-DOP analog).
+
+Modes (for mesh two-phase aggregation):
+- COMPLETE: raw rows in, final values out.
+- PARTIAL:  raw rows in, merge-able state columns out (avg -> sum+count).
+- FINAL:    state columns in (from PARTIAL, e.g. after an all_to_all
+            exchange), final values out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..column.column import Chunk, Field, Schema
+from ..exprs.compile import EVal, ExprCompiler
+from ..exprs.ir import AggExpr, Col, Expr
+from .common import boundaries, eval_keys, key_sort_arrays
+
+COMPLETE = "complete"
+PARTIAL = "partial"
+FINAL = "final"
+
+
+def _sum_out_type(t: T.LogicalType) -> T.LogicalType:
+    if t.is_decimal:
+        return T.DECIMAL(18, t.scale)
+    if t.is_float:
+        return T.DOUBLE
+    if t.kind is T.TypeKind.BOOLEAN:
+        return T.BIGINT
+    return T.BIGINT
+
+
+def _minmax_identity(t: T.LogicalType, is_min: bool):
+    if t.is_float:
+        return jnp.inf if is_min else -jnp.inf
+    info = jnp.iinfo(t.dtype) if t.kind is not T.TypeKind.BOOLEAN else None
+    if info is None:
+        return True if is_min else False
+    return info.max if is_min else info.min
+
+
+def _state_fields(name: str, agg: AggExpr, arg_t: Optional[T.LogicalType]):
+    """State columns a PARTIAL aggregation emits for `agg` (name -> type)."""
+    if agg.fn == "count" or agg.fn == "count_star":
+        return [(f"{name}", T.BIGINT)]
+    if agg.fn == "sum":
+        return [(f"{name}", _sum_out_type(arg_t))]
+    if agg.fn in ("min", "max"):
+        return [(f"{name}", arg_t)]
+    if agg.fn == "avg":
+        return [(f"{name}__sum", _sum_out_type(arg_t)), (f"{name}__cnt", T.BIGINT)]
+    raise NotImplementedError(f"aggregate {agg.fn}")
+
+
+def hash_aggregate(
+    chunk: Chunk,
+    group_by: tuple,  # tuple[(name, Expr)]
+    aggs: tuple,  # tuple[(name, AggExpr)]
+    num_groups: int,
+    mode: str = COMPLETE,
+):
+    """Returns (output_chunk, true_group_count). Output capacity=num_groups.
+
+    In FINAL mode, `aggs` args must be Cols referring to the PARTIAL state
+    columns produced by the same spec (avg reads name__sum / name__cnt).
+    """
+    cc = ExprCompiler(chunk)
+    cap = chunk.capacity
+    live = chunk.sel_mask()
+    keys = eval_keys(chunk, tuple(e for _, e in group_by))
+
+    if keys:
+        order = jnp.lexsort(tuple(key_sort_arrays(keys, live)))
+        is_new = boundaries(keys, live, order)
+    else:
+        # global aggregation: one group holding all live rows
+        order = jnp.arange(cap)
+        is_new = jnp.zeros((cap,), jnp.bool_).at[0].set(jnp.any(live))
+        live = live  # group 0 regardless; contributions masked by live
+
+    gid = jnp.clip(jnp.cumsum(is_new) - 1, 0, num_groups - 1)
+    live_s = live[order]
+    ngroups = jnp.sum(is_new, dtype=jnp.int64)
+    if not keys:
+        # a global agg always yields one row (COUNT over empty set = 0)
+        ngroups = jnp.maximum(ngroups, 1)
+
+    out_fields, out_data, out_valid = [], [], []
+
+    # --- group key columns ---------------------------------------------------
+    pos = jnp.arange(cap)
+    first_pos = jax.ops.segment_min(
+        jnp.where(live_s, pos, cap), gid, num_segments=num_groups,
+        indices_are_sorted=True,
+    )
+    safe_first = jnp.clip(first_pos, 0, cap - 1)
+    for (kname, _), k in zip(group_by, keys):
+        ks = k.data[order][safe_first]
+        kv = None if k.valid is None else k.valid[order][safe_first]
+        out_fields.append(Field(kname, k.type, k.valid is not None, k.dict))
+        out_data.append(ks)
+        out_valid.append(kv)
+
+    # --- aggregate columns ----------------------------------------------------
+    def seg_sum(vals):
+        return jax.ops.segment_sum(
+            vals, gid, num_segments=num_groups, indices_are_sorted=True
+        )
+
+    for name, agg in aggs:
+        if agg.fn in ("count_star",) or (agg.fn == "count" and agg.arg is None):
+            if mode == FINAL:
+                st = cc.eval(Col(name))
+                v = jnp.where(live_s, st.data[order], 0)
+                cnt = seg_sum(jnp.asarray(v, jnp.int64))
+            else:
+                cnt = seg_sum(jnp.asarray(live_s, jnp.int64))
+            out_fields.append(Field(name, T.BIGINT, False))
+            out_data.append(cnt)
+            out_valid.append(None)
+            continue
+
+        if agg.fn == "avg":
+            if mode == FINAL:
+                s = cc.eval(Col(f"{name}__sum"))
+                c = cc.eval(Col(f"{name}__cnt"))
+                sum_t = s.type
+                vals = jnp.where(live_s, s.data[order], 0)
+                cnts = jnp.where(live_s, c.data[order], 0)
+            else:
+                a = cc.eval(agg.arg)
+                sum_t = _sum_out_type(a.type)
+                d = jnp.broadcast_to(_to_rep(a, sum_t), (cap,))[order]
+                m = live_s if a.valid is None else (live_s & a.valid[order])
+                vals = jnp.where(m, d, 0)
+                cnts = jnp.asarray(m, jnp.int64)
+            gsum = seg_sum(vals)
+            gcnt = seg_sum(cnts)
+            if mode == PARTIAL:
+                out_fields.append(Field(f"{name}__sum", sum_t, False))
+                out_data.append(gsum)
+                out_valid.append(None)
+                out_fields.append(Field(f"{name}__cnt", T.BIGINT, False))
+                out_data.append(gcnt)
+                out_valid.append(None)
+            else:
+                denom = jnp.maximum(gcnt, 1)
+                if sum_t.is_decimal:
+                    res = (
+                        jnp.asarray(gsum, jnp.float64)
+                        / (10 ** sum_t.scale)
+                        / denom
+                    )
+                else:
+                    res = jnp.asarray(gsum, jnp.float64) / denom
+                out_fields.append(Field(name, T.DOUBLE, True))
+                out_data.append(res)
+                out_valid.append(gcnt > 0)
+            continue
+
+        # sum / min / max / count(x)
+        if mode == FINAL:
+            a = cc.eval(Col(name))
+        else:
+            a = cc.eval(agg.arg)
+        m = live_s if a.valid is None else (live_s & jnp.broadcast_to(a.valid, (cap,))[order])
+
+        if agg.fn == "count":
+            if mode == FINAL:
+                vals = jnp.where(m, jnp.asarray(a.data, jnp.int64)[order], 0)
+                res = seg_sum(vals)
+            else:
+                res = seg_sum(jnp.asarray(m, jnp.int64))
+            out_fields.append(Field(name, T.BIGINT, False))
+            out_data.append(res)
+            out_valid.append(None)
+        elif agg.fn == "sum":
+            out_t = a.type if mode == FINAL else _sum_out_type(a.type)
+            d = jnp.broadcast_to(_to_rep(a, out_t), (cap,))[order]
+            res = seg_sum(jnp.where(m, d, 0))
+            nonempty = seg_sum(jnp.asarray(m, jnp.int64)) > 0
+            out_fields.append(Field(name, out_t, True))
+            out_data.append(res)
+            out_valid.append(nonempty)
+        elif agg.fn in ("min", "max"):
+            is_min = agg.fn == "min"
+            ident = _minmax_identity(a.type, is_min)
+            d = jnp.broadcast_to(jnp.asarray(a.data), (cap,))[order]
+            dd = jnp.where(m, d, jnp.asarray(ident, a.type.dtype))
+            seg = jax.ops.segment_min if is_min else jax.ops.segment_max
+            res = seg(dd, gid, num_segments=num_groups, indices_are_sorted=True)
+            nonempty = seg_sum(jnp.asarray(m, jnp.int64)) > 0
+            out_fields.append(Field(name, a.type, True, a.dict))
+            out_data.append(res)
+            out_valid.append(nonempty)
+        else:
+            raise NotImplementedError(f"aggregate {agg.fn}")
+
+    sel = jnp.arange(num_groups) < ngroups
+    out = Chunk(Schema(tuple(out_fields)), tuple(out_data), tuple(out_valid), sel)
+    return out, ngroups
+
+
+def _to_rep(a: EVal, out_t: T.LogicalType):
+    """Cast an arg EVal's data to the aggregation accumulator representation."""
+    if a.type.is_decimal and out_t.is_decimal:
+        d = jnp.asarray(a.data, jnp.int64)
+        if a.type.scale < out_t.scale:
+            d = d * (10 ** (out_t.scale - a.type.scale))
+        return d
+    if out_t.is_decimal and not a.type.is_decimal:
+        return jnp.asarray(a.data, jnp.int64) * (10 ** out_t.scale)
+    return jnp.asarray(a.data, out_t.dtype)
+
+
+def final_agg_exprs(aggs: tuple) -> tuple:
+    """Rewrite agg specs for the FINAL stage over PARTIAL state columns."""
+    out = []
+    for name, agg in aggs:
+        if agg.fn in ("count", "count_star"):
+            out.append((name, AggExpr("count", Col(name))))
+        elif agg.fn == "sum":
+            out.append((name, AggExpr("sum", Col(name))))
+        elif agg.fn == "min":
+            out.append((name, AggExpr("min", Col(name))))
+        elif agg.fn == "max":
+            out.append((name, AggExpr("max", Col(name))))
+        elif agg.fn == "avg":
+            out.append((name, AggExpr("avg", None)))
+        else:
+            raise NotImplementedError(agg.fn)
+    return tuple(out)

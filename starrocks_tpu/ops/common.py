@@ -1,0 +1,88 @@
+"""Shared operator utilities: key normalization, lexicographic sort, compaction.
+
+Reference behavior being re-designed: the hash-table machinery in
+be/src/exec/aggregate/agg_hash_map.h and be/src/exec/join/join_hash_map.h.
+TPUs have no scatter-friendly memory model, so grouping/joining is sort-based:
+lexicographic multi-key sort (one fused lax.sort via jnp.lexsort), segment
+boundaries, and segment reductions (SURVEY §7 "Hash tables on TPU").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..column.column import Chunk
+from ..exprs.compile import EVal, ExprCompiler
+
+
+def eval_keys(chunk: Chunk, key_exprs) -> list:
+    cc = ExprCompiler(chunk)
+    out = []
+    for e in key_exprs:
+        v = cc.eval(e)
+        data = jnp.broadcast_to(jnp.asarray(v.data), (chunk.capacity,))
+        out.append(EVal(data, v.valid, v.type, v.dict))
+    return out
+
+
+def key_sort_arrays(keys, live, nulls_last_sentinel=True):
+    """Build the lexsort operand list for (live-first, then key order).
+
+    Returns list ordered least-significant-first (jnp.lexsort convention:
+    the LAST array is the primary key). Dead rows sort last. NULL key values
+    sort together (before non-null values of the same column).
+    """
+    ops = []
+    for k in reversed(keys):
+        ops.append(k.data)
+        if k.valid is not None:
+            # sort by (is_null, value): nulls form their own cluster
+            ops.append(jnp.asarray(~k.valid, jnp.int8))
+    ops.append(jnp.asarray(~live, jnp.int8))  # primary: live rows first
+    return ops
+
+
+def boundaries(keys, live, order):
+    """Given sort order (indices), mark rows starting a new group.
+
+    Row 0 of the sorted sequence is new iff live; row i is new iff live and
+    any key (value or nullness) differs from row i-1.
+    """
+    cap = order.shape[0]
+    live_s = live[order]
+    diff = jnp.zeros((cap,), jnp.bool_)
+    for k in keys:
+        ks = k.data[order]
+        d = jnp.concatenate([jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
+        if k.valid is not None:
+            vs = k.valid[order]
+            dv = jnp.concatenate([jnp.ones((1,), jnp.bool_), vs[1:] != vs[:-1]])
+            # both NULL -> equal regardless of payload
+            both_null = jnp.concatenate(
+                [jnp.zeros((1,), jnp.bool_), (~vs[1:]) & (~vs[:-1])]
+            )
+            d = (d & ~both_null) | dv
+        diff = diff | d
+    return diff & live_s
+
+
+def compact(chunk: Chunk, capacity: int | None = None):
+    """Gather live rows to the front (stable). Output capacity may shrink.
+
+    The moral equivalent of the reference's Chunk::filter; only used where
+    an operator genuinely needs dense rows (exchange, join build sides).
+    Returns (chunk, true_live_count): when true_live_count > out capacity,
+    rows were dropped — the host must recompile with a larger capacity
+    (same overflow contract as hash_aggregate / hash_join_expand).
+    """
+    cap = chunk.capacity
+    out_cap = capacity or cap
+    live = chunk.sel_mask()
+    order = jnp.argsort(~live, stable=True)
+    order = order[:out_cap]
+    n = jnp.sum(live)
+    taken = chunk.take(order)
+    sel = jnp.arange(out_cap) < n
+    return taken.with_sel(sel), n

@@ -1,0 +1,39 @@
+"""Filter & project operators.
+
+Reference behavior: SelectOperator (be/src/exec/pipeline/select_operator.h)
+and ProjectOperator (be/src/exec/pipeline/project_operator.h). On TPU a
+filter is just an AND into the chunk's selection mask — no row movement —
+and projection evaluates expressions into a fresh chunk; XLA fuses both into
+neighboring kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..column.column import Chunk, Field, Schema
+from ..exprs.compile import ExprCompiler
+from ..exprs.ir import Expr
+
+
+def filter_chunk(chunk: Chunk, predicate: Expr) -> Chunk:
+    mask = ExprCompiler(chunk).eval_predicate(predicate)
+    return chunk.and_sel(mask)
+
+
+def project(chunk: Chunk, exprs, names) -> Chunk:
+    """Evaluate `exprs`, producing a chunk with columns `names` (in order)."""
+    cc = ExprCompiler(chunk)
+    fields, data, valid = [], [], []
+    for name, e in zip(names, exprs):
+        v = cc.eval(e)
+        d = jnp.broadcast_to(jnp.asarray(v.data), (chunk.capacity,))
+        fields.append(Field(name, v.type, v.valid is not None, v.dict))
+        data.append(d)
+        valid.append(
+            None if v.valid is None else jnp.broadcast_to(v.valid, (chunk.capacity,))
+        )
+    return Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), chunk.sel)

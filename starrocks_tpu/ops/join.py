@@ -1,0 +1,203 @@
+"""Sort-based hash join.
+
+Reference behavior: be/src/exec/hash_joiner.h:192 + join_hash_map.h —
+build/probe hash join with INNER/LEFT OUTER/RIGHT variants, SEMI/ANTI, and
+build-side runtime filters. The TPU re-design replaces the pointer-chasing
+hash table with: sort the (compacted) build side by key, binary-search probes
+into it (jnp.searchsorted compiles to an XLA while-free ladder), and gather
+payloads. Multi-column keys are packed into one int64 by the planner
+(pack_keys) using key-range stats; that keeps probe a single vector compare.
+
+Two shapes:
+- unique build keys (PK-FK joins — the common TPC-H/SSB case): output rows
+  = probe rows, pure gather, no expansion.
+- duplicate build keys: run-length expansion via jnp.repeat with a static
+  output capacity + true-size return for host-side overflow recompile.
+
+NULL join keys never match (SQL equality semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..column.column import Chunk, Field, Schema
+from ..exprs.compile import ExprCompiler
+from .common import eval_keys
+
+INNER = "inner"
+LEFT_OUTER = "left_outer"
+LEFT_SEMI = "left_semi"
+LEFT_ANTI = "left_anti"
+
+_I64MAX = jnp.iinfo(jnp.int64).max
+
+
+def pack_keys(chunk: Chunk, key_exprs, bit_widths=None):
+    """Evaluate key exprs and pack them into one int64 per row.
+
+    bit_widths[i] = bits reserved for key i (from planner stats); when None a
+    single key is used as-is. NULL any-key or dead row -> sentinel INT64 MAX
+    (sorts last, never matches a probe because probe NULLs are also masked).
+    Returns (packed[cap] int64, ok[cap] bool) where ok = live & all keys valid.
+    """
+    keys = eval_keys(chunk, key_exprs)
+    live = chunk.sel_mask()
+    ok = live
+    for k in keys:
+        if k.valid is not None:
+            ok = ok & k.valid
+    if len(keys) == 1 and bit_widths is None:
+        packed = jnp.asarray(keys[0].data, jnp.int64)
+    else:
+        assert bit_widths is not None and len(bit_widths) == len(keys), (
+            "multi-key join requires planner-provided bit widths"
+        )
+        packed = jnp.zeros((chunk.capacity,), jnp.int64)
+        for k, w in zip(keys, bit_widths):
+            kd = jnp.asarray(k.data, jnp.int64)
+            packed = (packed << w) | (kd & ((1 << w) - 1))
+    return jnp.where(ok, packed, _I64MAX), ok
+
+
+def _merge_schemas(left: Chunk, right: Chunk, right_names) -> tuple:
+    lnames = set(left.schema.names)
+    out_fields = list(left.schema.fields)
+    for n in right_names:
+        f = right.schema.field(n)
+        if n in lnames:
+            raise ValueError(f"duplicate output column {n!r} in join")
+        out_fields.append(f)
+    return tuple(out_fields)
+
+
+def hash_join_unique(
+    probe: Chunk,
+    build: Chunk,
+    probe_keys,
+    build_keys,
+    join_type: str = INNER,
+    payload=None,  # build column names to attach; default all
+    bit_widths=None,
+):
+    """Join where build keys are unique (validated by planner/caller).
+
+    Output chunk has probe's capacity: probe columns + gathered build payload.
+    """
+    payload = list(payload if payload is not None else build.schema.names)
+    pk, p_ok = pack_keys(probe, probe_keys, bit_widths)
+    bk, _ = pack_keys(build, build_keys, bit_widths)  # build NULL/dead rows pack to the sentinel
+
+    order = jnp.argsort(bk, stable=True)  # sentinels (dead/null) go last
+    bk_sorted = bk[order]
+    bcap = build.capacity
+
+    pos = jnp.searchsorted(bk_sorted, pk)
+    pos_c = jnp.clip(pos, 0, bcap - 1)
+    match = (bk_sorted[pos_c] == pk) & p_ok & (pk != _I64MAX)
+    build_row = order[pos_c]
+
+    out_fields = _merge_schemas(probe, build, payload)
+    data = list(probe.data)
+    valid = list(probe.valid)
+    for n in payload:
+        i = build.schema.index(n)
+        d = build.data[i][build_row]
+        v = build.valid[i]
+        v = None if v is None else v[build_row]
+        if join_type == LEFT_OUTER:
+            # non-matching rows carry NULL build columns
+            mv = match if v is None else (v & match)
+            v = mv
+        data.append(d)
+        valid.append(v)
+
+    sel = probe.sel_mask()
+    if join_type == INNER:
+        sel = sel & match
+    elif join_type == LEFT_SEMI:
+        return probe.and_sel(match)
+    elif join_type == LEFT_ANTI:
+        return probe.and_sel(~match)
+    elif join_type != LEFT_OUTER:
+        raise NotImplementedError(join_type)
+    return Chunk(Schema(out_fields), tuple(data), tuple(valid), sel)
+
+
+def hash_join_expand(
+    probe: Chunk,
+    build: Chunk,
+    probe_keys,
+    build_keys,
+    out_capacity: int,
+    join_type: str = INNER,
+    payload=None,
+    bit_widths=None,
+):
+    """General join allowing duplicate build keys.
+
+    Expands matches by run-length: for probe row r matching build run
+    [start_r, end_r), emits (r, start_r + j) pairs. Static out_capacity with
+    true output size returned for host overflow handling.
+    Returns (chunk, true_rows).
+    """
+    payload = list(payload if payload is not None else build.schema.names)
+    pk, p_ok = pack_keys(probe, probe_keys, bit_widths)
+    bk, _ = pack_keys(build, build_keys, bit_widths)  # build NULL/dead rows pack to the sentinel
+
+    order = jnp.argsort(bk, stable=True)
+    bk_sorted = bk[order]
+    bcap = build.capacity
+
+    probe_ok = p_ok & (pk != _I64MAX)
+    start = jnp.searchsorted(bk_sorted, pk, side="left")
+    end = jnp.searchsorted(bk_sorted, pk, side="right")
+    counts = jnp.where(probe_ok, end - start, 0)
+
+    if join_type == LEFT_SEMI:
+        out = probe.and_sel(counts > 0)
+        return out, out.num_rows()
+    if join_type == LEFT_ANTI:
+        out = probe.and_sel(counts == 0)
+        return out, out.num_rows()
+    if join_type == LEFT_OUTER:
+        counts = jnp.where(probe.sel_mask() & (counts == 0), 1, counts)
+    elif join_type != INNER:
+        raise NotImplementedError(join_type)
+
+    total = jnp.sum(counts)
+    # expansion: repeat probe-row ids by counts into fixed out_capacity
+    probe_rows = jnp.repeat(
+        jnp.arange(probe.capacity), counts, total_repeat_length=out_capacity
+    )
+    # offset of each output slot within its probe row's run
+    run_start = jnp.cumsum(counts) - counts  # first out slot per probe row
+    offs = jnp.arange(out_capacity) - run_start[probe_rows]
+    build_pos = jnp.clip(start[probe_rows] + offs, 0, bcap - 1)
+    build_row = order[build_pos]
+    out_live = jnp.arange(out_capacity) < total
+    if join_type == LEFT_OUTER:
+        # probe_ok masking matters: a NULL-key probe row must not "match"
+        # the build side's sentinel run (NULL/dead rows also pack to the
+        # sentinel), so its payload stays NULL
+        had_match = (probe_ok & ((end - start) > 0))[probe_rows]
+    else:
+        had_match = jnp.ones((out_capacity,), jnp.bool_)
+
+    taken = probe.take(probe_rows)
+    data = list(taken.data)
+    valid = list(taken.valid)
+    out_fields = _merge_schemas(probe, build, payload)
+    for n in payload:
+        i = build.schema.index(n)
+        d = build.data[i][build_row]
+        v = build.valid[i]
+        v = None if v is None else v[build_row]
+        if join_type == LEFT_OUTER:
+            v = had_match if v is None else (v & had_match)
+        data.append(d)
+        valid.append(v)
+    sel = out_live if taken.sel is None else (out_live & taken.sel)
+    return Chunk(Schema(out_fields), tuple(data), tuple(valid), sel), total

@@ -1,0 +1,62 @@
+"""ORDER BY / TopN / LIMIT operators.
+
+Reference behavior: be/src/exec/chunks_sorter.h:44 (full sort),
+chunks_sorter_topn.h:26 (heap TopN), and the merge-path parallel merge
+kernels (be/src/compute_env/sorting/merge_path.h). On TPU, XLA's lax.sort is
+already a parallel bitonic-class sort, so both full sort and TopN are one
+fused lexsort; the distributed merge phase lives in parallel/ (gather +
+re-sort, or all_gather of per-shard TopN).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..column.column import Chunk
+from .common import eval_keys
+
+
+def sort_chunk(chunk: Chunk, sort_keys, limit: int | None = None) -> Chunk:
+    """sort_keys: tuple of (expr, asc: bool, nulls_first: bool).
+
+    Dead rows always sort last; output sel marks the first n (or limit) rows.
+    """
+    cap = chunk.capacity
+    live = chunk.sel_mask()
+    keys = eval_keys(chunk, tuple(e for e, _, _ in sort_keys))
+
+    ops = []
+    for k, (_, asc, nulls_first) in zip(reversed(keys), reversed(list(sort_keys))):
+        d = k.data
+        if d.dtype == jnp.bool_:
+            d = jnp.asarray(d, jnp.int8)
+        dd = d if asc else _descending(d)
+        ops.append(dd)
+        if k.valid is not None:
+            # the flag is more significant than the value (appended later);
+            # ascending sort puts 0 first, so: nulls_first -> valid flag (null=0)
+            ops.append(jnp.asarray(k.valid if nulls_first else ~k.valid, jnp.int8))
+    ops.append(jnp.asarray(~live, jnp.int8))  # live rows first
+    order = jnp.lexsort(tuple(ops))
+
+    out = chunk.take(order)
+    n = jnp.sum(live)
+    k = n if limit is None else jnp.minimum(n, limit)
+    sel = jnp.arange(cap) < k
+    return out.with_sel(sel)
+
+
+def _descending(d):
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        return -d
+    if d.dtype == jnp.uint32 or d.dtype == jnp.uint64:
+        return jnp.iinfo(d.dtype).max - d
+    return -d  # signed ints: negation safe except INT_MIN (accepted caveat)
+
+
+def limit_chunk(chunk: Chunk, limit: int, offset: int = 0) -> Chunk:
+    """Keep `limit` live rows after skipping `offset` (row order = physical)."""
+    live = chunk.sel_mask()
+    rank = jnp.cumsum(live) - 1  # rank among live rows
+    keep = live & (rank >= offset) & (rank < offset + limit)
+    return chunk.with_sel(keep)

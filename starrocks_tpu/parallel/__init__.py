@@ -1,0 +1,16 @@
+"""Mesh parallelism: sharding, exchange collectives, distributed operators.
+
+Reference: the fragment/exchange machinery (SURVEY §2.4) — fragments over BEs
+-> SPMD shard_map over a jax.sharding.Mesh; bRPC transmit_chunk ->
+lax.all_to_all / all_gather over ICI.
+"""
+
+from .dist_ops import BROADCAST, SHUFFLE, broadcast_join, dist_aggregate
+from .exchange import all_gather_chunk, shuffle_chunk
+from .mesh import DATA_AXIS, chunk_pspec, make_mesh, replicated_pspec, shard_host_table
+
+__all__ = [
+    "BROADCAST", "SHUFFLE", "DATA_AXIS",
+    "all_gather_chunk", "broadcast_join", "chunk_pspec", "dist_aggregate",
+    "make_mesh", "replicated_pspec", "shard_host_table", "shuffle_chunk",
+]

@@ -1,0 +1,93 @@
+"""Distributed operator patterns composed from local ops + exchange.
+
+Reference behavior mapping (SURVEY §2.4):
+- two-phase aggregation (local partial -> exchange -> final) mirrors the
+  reference's two-phase agg split chosen by the optimizer enforcers
+  (fe sql/optimizer/ChildOutputPropertyGuarantor.java).
+- broadcast join  = all_gather the build side (UNPARTITIONED exchange).
+- shuffle join    = hash-partition both sides onto the mesh, local join.
+These run INSIDE shard_map over the data axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..exprs.ir import Col
+from ..ops.aggregate import FINAL, PARTIAL, final_agg_exprs, hash_aggregate
+from ..ops.common import compact
+from ..ops.join import hash_join_unique
+from .exchange import all_gather_chunk, shuffle_chunk
+
+BROADCAST = "broadcast"
+SHUFFLE = "shuffle"
+
+
+def dist_aggregate(
+    local_chunk,
+    group_by,
+    aggs,
+    axis: str,
+    n_shards: int,
+    partial_groups: int,
+    final_groups: int,
+    via: str = BROADCAST,
+    bucket_capacity: int | None = None,
+):
+    """Distributed grouped aggregation.
+
+    via=BROADCAST: all_gather partial states (right when group count is
+    small, e.g. TPC-H Q1's 4 groups) — every shard computes the identical
+    final result (replicated output).
+    via=SHUFFLE: hash-partition partial states by group key so each shard
+    finalizes its own key range (right for high-cardinality group-bys,
+    e.g. TPC-DS Q67); output is sharded.
+    Returns (final_chunk, ngroups, max_bucket): max_bucket is the largest
+    pre-padding exchange bucket (0 for BROADCAST); the host must check
+    max_bucket <= bucket_capacity or rows were dropped.
+    """
+    part, _ = hash_aggregate(
+        local_chunk, group_by, aggs, partial_groups, mode=PARTIAL
+    )
+    key_cols = tuple(Col(name) for name, _ in group_by)
+    final_group_by = tuple((name, Col(name)) for name, _ in group_by)
+    if via == BROADCAST:
+        merged = all_gather_chunk(part, axis)
+        max_bucket = jnp.zeros((), jnp.int64)
+    else:
+        cap = bucket_capacity or max(partial_groups, 16)
+        merged, max_bucket = shuffle_chunk(part, key_cols, axis, n_shards, cap)
+    out, ng = hash_aggregate(
+        merged, final_group_by, final_agg_exprs(aggs), final_groups, mode=FINAL
+    )
+    return out, ng, max_bucket
+
+
+def broadcast_join(
+    probe_local,
+    build_local,
+    probe_keys,
+    build_keys,
+    axis: str,
+    join_type: str = "inner",
+    payload=None,
+    bit_widths=None,
+    build_capacity: int | None = None,
+):
+    """Replicate the (small) build side to every shard, then local join.
+
+    The reference analog: UNPARTITIONED exchange on the build side of a
+    broadcast HashJoin fragment. With build_capacity set, the gathered build
+    side is compacted down to that capacity.
+    Returns (joined_chunk, build_rows): the host must check build_rows <=
+    build_capacity (when set) or build rows were silently dropped — the
+    shared overflow-recompile contract."""
+    build_all = all_gather_chunk(build_local, axis)
+    build_n = build_all.num_rows()
+    if build_capacity is not None:
+        build_all, build_n = compact(build_all, build_capacity)
+    joined = hash_join_unique(
+        probe_local, build_all, probe_keys, build_keys, join_type,
+        payload=payload, bit_widths=bit_widths,
+    )
+    return joined, build_n

@@ -1,0 +1,124 @@
+"""Exchange: data movement between mesh shards (runs INSIDE shard_map).
+
+Reference behavior: ExchangeSinkOperator -> SinkBuffer -> bRPC transmit_chunk
+-> DataStreamMgr -> ExchangeSourceOperator
+(be/src/exec/pipeline/exchange/exchange_sink_operator.h:47,
+ compute_env/data_stream/data_stream_mgr.h:101), with partition strategies
+UNPARTITIONED (broadcast/gather), HASH_PARTITIONED, RANDOM
+(gensrc/thrift/Partitions.thrift:41). On TPU these become compiled
+collectives over ICI:
+
+- broadcast / gather       -> lax.all_gather
+- hash partition (shuffle) -> bucket + pad + lax.all_to_all
+- backpressure/flow control -> not needed: the exchange is a compiled
+  collective; skew shows up as padding, handled by a skew factor + a
+  true-count overflow check the host can react to (the adaptive-dop analog).
+
+All functions here take/return Chunks whose arrays are *local shards* (they
+are called inside shard_map, where a Chunk pytree holds per-device views).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..column.column import Chunk
+from ..ops.common import eval_keys
+
+
+def _tree_chunk(chunk: Chunk, fn):
+    data = tuple(fn(d) for d in chunk.data)
+    valid = tuple(None if v is None else fn(v) for v in chunk.valid)
+    sel = None if chunk.sel is None else fn(chunk.sel)
+    return data, valid, sel
+
+
+def all_gather_chunk(chunk: Chunk, axis: str) -> Chunk:
+    """Every shard receives all rows (UNPARTITIONED/broadcast exchange).
+
+    Local capacity C -> output capacity n*C on every shard."""
+    def ag(x):
+        return lax.all_gather(x, axis, axis=0, tiled=True)
+
+    data, valid, sel = _tree_chunk(chunk, ag)
+    if sel is None:
+        sel = jnp.ones((data[0].shape[0],), jnp.bool_)
+    return Chunk(chunk.schema, data, valid, sel)
+
+
+def hash_hash64(x: jnp.ndarray) -> jnp.ndarray:
+    """Cheap 64-bit integer mix (splitmix64 finalizer)."""
+    z = jnp.asarray(x, jnp.uint64)
+    z = (z ^ (z >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> 31)
+    return z
+
+
+def shuffle_chunk(
+    chunk: Chunk,
+    key_exprs,
+    axis: str,
+    n_shards: int,
+    bucket_capacity: int,
+    bit_widths=None,
+):
+    """HASH_PARTITIONED exchange: rows travel to shard hash(key) % n.
+
+    Returns (chunk_out, max_bucket_count):
+    - chunk_out: local capacity n_shards*bucket_capacity, rows this shard
+      received; dead slots masked.
+    - max_bucket_count: traced scalar = largest per-bucket row count BEFORE
+      padding; host checks <= bucket_capacity (else recompile bigger).
+    NULL keys hash like a value (bucket 0) so group-by-NULL still works;
+    `pack_keys`'s ok flag is ignored here on purpose (exchange must move
+    every live row).
+    """
+    live = chunk.sel_mask()
+    # dead rows -> bucket n (dropped); NULL-key live rows still travel
+    keys = eval_keys(chunk, key_exprs)
+    mix = jnp.zeros((chunk.capacity,), jnp.uint64)
+    for k in keys:
+        kd = jnp.asarray(k.data, jnp.int64)
+        if k.valid is not None:
+            kd = jnp.where(k.valid, kd, jnp.int64(-1))
+        kd_u = jnp.asarray(kd, jnp.uint64) * jnp.uint64(0x9E3779B97F4A7C15)
+        mix = hash_hash64(mix ^ kd_u)
+    bucket = jnp.asarray(mix % jnp.uint64(n_shards), jnp.int32)
+    bucket = jnp.where(live, bucket, n_shards)
+
+    order = jnp.argsort(bucket, stable=True)
+    b_sorted = bucket[order]
+    counts = jnp.bincount(bucket, length=n_shards + 1)[:n_shards]
+    starts = jnp.cumsum(counts) - counts
+    pos_in_bucket = jnp.arange(chunk.capacity) - starts[jnp.clip(b_sorted, 0, n_shards - 1)]
+    ok = (b_sorted < n_shards) & (pos_in_bucket < bucket_capacity)
+
+    out_cap = n_shards * bucket_capacity
+    # not-ok rows (dead / bucket overflow) are routed out of bounds so the
+    # "drop" scatter mode discards them instead of colliding with real slots
+    dest = jnp.where(
+        ok, b_sorted * bucket_capacity + pos_in_bucket, out_cap
+    )
+
+    def scatter(x):
+        buf = jnp.zeros((out_cap,), x.dtype)
+        return buf.at[dest].set(x[order], mode="drop")
+
+    live_buf = jnp.zeros((out_cap,), jnp.bool_).at[dest].set(ok, mode="drop")
+
+    def a2a(x):
+        # [n*C] -> [n, C] -> swap shard/abucket -> receive my bucket from all
+        return lax.all_to_all(
+            x.reshape(n_shards, bucket_capacity), axis, split_axis=0, concat_axis=0,
+            tiled=False,
+        ).reshape(out_cap)
+
+    data = tuple(a2a(scatter(d)) for d in chunk.data)
+    valid = tuple(
+        None if v is None else a2a(scatter(v)) for v in chunk.valid
+    )
+    sel = a2a(live_buf)
+    return Chunk(chunk.schema, data, valid, sel), jnp.max(counts)

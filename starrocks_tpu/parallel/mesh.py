@@ -1,0 +1,60 @@
+"""Device mesh management and chunk sharding.
+
+Reference behavior: plan fragments get N instances across BEs with scan ranges
+assigned by locality (fe qe/CoordinatorPreprocessor.java:70, BackendSelector).
+The TPU re-design: one SPMD program over a jax.sharding.Mesh; a table shard on
+device i plays the role of fragment-instance i's scan range. Exchange between
+fragments becomes XLA collectives over ICI (see exchange.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..column.column import Chunk, pad_capacity
+
+DATA_AXIS = "d"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = DATA_AXIS) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def shard_host_table(table, mesh: Mesh, axis: str = DATA_AXIS) -> Chunk:
+    """Build a row-sharded global Chunk from a HostTable.
+
+    Global capacity is padded so every shard has equal rows (XLA needs equal
+    splits); the selection mask marks the real rows.
+    """
+    n = mesh.shape[axis]
+    rows = table.num_rows
+    local_cap = pad_capacity((rows + n - 1) // n)
+    chunk = table.to_chunk(capacity=local_cap * n)
+    spec = P(axis)
+    sharding = NamedSharding(mesh, spec)
+
+    def put(x):
+        return jax.device_put(x, sharding)
+
+    data = tuple(put(d) for d in chunk.data)
+    valid = tuple(None if v is None else put(v) for v in chunk.valid)
+    sel = put(chunk.sel_mask())
+    return Chunk(chunk.schema, data, valid, sel)
+
+
+def chunk_pspec(chunk: Chunk, axis: str = DATA_AXIS):
+    """PartitionSpec pytree matching a chunk's structure (row-sharded)."""
+    spec = P(axis)
+    return jax.tree_util.tree_map(lambda _: spec, chunk)
+
+
+def replicated_pspec(tree):
+    return jax.tree_util.tree_map(lambda _: P(), tree)

@@ -1,0 +1,284 @@
+"""TPC-H data generator (numpy, vectorized).
+
+Schema-faithful generator for the 8 TPC-H tables (column names/types per the
+TPC-H spec; same tables the reference's benchmark kit loads —
+/root/reference/tools/tpch-poc/, docs/en/benchmarking/TPC-H_Benchmarking.md).
+Value distributions are simplified but referentially consistent (every FK
+resolves; l_suppkey agrees with partsupp's 4-suppliers-per-part rule, which
+Q9-style joins rely on). Money columns are DECIMAL(15,2), dates are DATE.
+
+Row counts at scale factor SF: supplier 10k·SF, customer 150k·SF, part
+200k·SF, partsupp 800k·SF, orders 1.5M·SF, lineitem ≈6M·SF.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+
+from ... import types as T
+from ...column import Field, HostTable, Schema, StringDict
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _days(y, m, d):
+    return (datetime.date(y, m, d) - _EPOCH).days
+
+
+START_DATE = _days(1992, 1, 1)
+END_DATE = _days(1998, 8, 2)
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+SHIPINSTRUCT = ["COLLECT COD", "DELIVER IN PERSON", "NONE", "TAKE BACK RETURN"]
+TYPES_SYL1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPES_SYL2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPES_SYL3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINERS_SYL1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+CONTAINERS_SYL2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+
+DEC = T.DECIMAL(15, 2)
+
+
+def _ht(cols: dict, types: dict) -> HostTable:
+    return HostTable.from_pydict(cols, types=types)
+
+
+def _brand_col(brand_m, brand_n):
+    vals = sorted({f"Brand#{m}{n}" for m in range(1, 6) for n in range(1, 6)})
+    d = StringDict.from_values(vals)
+    codes = d.encode([f"Brand#{m}{n}" for m, n in zip(brand_m, brand_n)])
+    return d, codes
+
+
+def _type_col(t1, t2, t3):
+    vals = sorted({
+        f"{a} {b} {c}" for a in TYPES_SYL1 for b in TYPES_SYL2 for c in TYPES_SYL3
+    })
+    d = StringDict.from_values(vals)
+    codes = d.encode([
+        f"{TYPES_SYL1[a]} {TYPES_SYL2[b]} {TYPES_SYL3[c]}" for a, b, c in zip(t1, t2, t3)
+    ])
+    return d, codes
+
+
+def _pname_col(p_key):
+    d = StringDict.from_values(sorted({f"part {i}" for i in range(997)}))
+    codes = d.encode([f"part {k}" for k in (p_key % 997)])
+    return d, codes.astype(np.int32)
+
+
+def _container_col(ct1, ct2):
+    vals = sorted({f"{a} {b}" for a in CONTAINERS_SYL1 for b in CONTAINERS_SYL2})
+    d = StringDict.from_values(vals)
+    codes = d.encode([
+        f"{CONTAINERS_SYL1[a]} {CONTAINERS_SYL2[b]}" for a, b in zip(ct1, ct2)
+    ])
+    return d, codes
+
+
+def gen_tpch(sf: float = 0.01, seed: int = 42) -> dict:
+    """Generate all 8 tables as HostTables keyed by lowercase name."""
+    rng = np.random.default_rng(seed)
+    out = {}
+
+    # --- region / nation -----------------------------------------------------
+    out["region"] = _ht(
+        {"r_regionkey": np.arange(5, dtype=np.int32), "r_name": REGIONS,
+         "r_comment": ["" for _ in REGIONS]},
+        {"r_regionkey": T.INT},
+    )
+    out["nation"] = _ht(
+        {
+            "n_nationkey": np.arange(25, dtype=np.int32),
+            "n_name": [n for n, _ in NATIONS],
+            "n_regionkey": np.array([r for _, r in NATIONS], dtype=np.int32),
+            "n_comment": ["" for _ in NATIONS],
+        },
+        {"n_nationkey": T.INT, "n_regionkey": T.INT},
+    )
+
+    # --- supplier -------------------------------------------------------------
+    ns = max(int(10_000 * sf), 10)
+    s_key = np.arange(1, ns + 1, dtype=np.int64)
+    s_nation = rng.integers(0, 25, ns).astype(np.int32)
+    out["supplier"] = _ht(
+        {
+            "s_suppkey": s_key,
+            "s_name": (StringDict.from_values([f"Supplier#{k:09d}" for k in s_key]),
+                       np.arange(ns, dtype=np.int32)),
+            "s_address": (StringDict.from_values([""]), np.zeros(ns, dtype=np.int32)),
+            "s_nationkey": s_nation,
+            "s_phone": (StringDict.from_values([""]), np.zeros(ns, dtype=np.int32)),
+            "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, ns), 2),
+            "s_comment": (StringDict.from_values([""]), np.zeros(ns, dtype=np.int32)),
+        },
+        {"s_suppkey": T.BIGINT, "s_nationkey": T.INT, "s_acctbal": DEC},
+    )
+
+    # --- customer -------------------------------------------------------------
+    nc = max(int(150_000 * sf), 30)
+    c_key = np.arange(1, nc + 1, dtype=np.int64)
+    out["customer"] = _ht(
+        {
+            "c_custkey": c_key,
+            "c_name": (StringDict.from_values([f"Customer#{k:09d}" for k in c_key]),
+                       np.arange(nc, dtype=np.int32)),
+            "c_address": (StringDict.from_values([""]), np.zeros(nc, dtype=np.int32)),
+            "c_nationkey": rng.integers(0, 25, nc).astype(np.int32),
+            "c_phone": (StringDict.from_values([""]), np.zeros(nc, dtype=np.int32)),
+            "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, nc), 2),
+            "c_mktsegment": (StringDict.from_values(sorted(SEGMENTS)),
+                             rng.integers(0, 5, nc).astype(np.int32)),
+            "c_comment": (StringDict.from_values([""]), np.zeros(nc, dtype=np.int32)),
+        },
+        {"c_custkey": T.BIGINT, "c_nationkey": T.INT, "c_acctbal": DEC},
+    )
+
+    # --- part -----------------------------------------------------------------
+    npart = max(int(200_000 * sf), 40)
+    p_key = np.arange(1, npart + 1, dtype=np.int64)
+    brand_m = rng.integers(1, 6, npart)
+    brand_n = rng.integers(1, 6, npart)
+    t1 = rng.integers(0, len(TYPES_SYL1), npart)
+    t2 = rng.integers(0, len(TYPES_SYL2), npart)
+    t3 = rng.integers(0, len(TYPES_SYL3), npart)
+    ct1 = rng.integers(0, len(CONTAINERS_SYL1), npart)
+    ct2 = rng.integers(0, len(CONTAINERS_SYL2), npart)
+    retail = np.round(900 + (p_key % 1000) / 10 + 100 * (p_key % 10), 2)
+    out["part"] = _ht(
+        {
+            "p_partkey": p_key,
+            "p_name": _pname_col(p_key),
+            "p_mfgr": (StringDict.from_values([f"Manufacturer#{m}" for m in range(1, 6)]),
+                       (brand_m - 1).astype(np.int32)),
+            "p_brand": _brand_col(brand_m, brand_n),
+            "p_type": _type_col(t1, t2, t3),
+            "p_size": rng.integers(1, 51, npart).astype(np.int32),
+            "p_container": _container_col(ct1, ct2),
+            "p_retailprice": retail,
+            "p_comment": (StringDict.from_values([""]), np.zeros(npart, dtype=np.int32)),
+        },
+        {"p_partkey": T.BIGINT, "p_size": T.INT, "p_retailprice": DEC},
+    )
+
+    # --- partsupp: 4 suppliers per part (TPC-H rule) ---------------------------
+    ps_part = np.repeat(p_key, 4)
+    # supplier j of part p: (p + j*(ns/4 + p//ns)) % ns + 1 — spec-like spread
+    j = np.tile(np.arange(4), npart)
+    ps_supp = ((ps_part - 1 + j * (ns // 4 + (ps_part - 1) // ns)) % ns + 1).astype(
+        np.int64
+    )
+    out["partsupp"] = _ht(
+        {
+            "ps_partkey": ps_part,
+            "ps_suppkey": ps_supp,
+            "ps_availqty": rng.integers(1, 10_000, npart * 4).astype(np.int32),
+            "ps_supplycost": np.round(rng.uniform(1.0, 1000.0, npart * 4), 2),
+            "ps_comment": (StringDict.from_values([""]), np.zeros(npart * 4, dtype=np.int32)),
+        },
+        {"ps_partkey": T.BIGINT, "ps_suppkey": T.BIGINT,
+         "ps_availqty": T.INT, "ps_supplycost": DEC},
+    )
+
+    # --- orders ---------------------------------------------------------------
+    no = max(int(1_500_000 * sf), 150)
+    o_key = np.arange(1, no + 1, dtype=np.int64)
+    o_cust = rng.integers(1, nc + 1, no).astype(np.int64)
+    o_date = rng.integers(START_DATE, END_DATE - 151, no).astype(np.int32)
+    o_prio = rng.integers(0, 5, no)
+
+    # --- lineitem: 1..7 lines per order ---------------------------------------
+    nlines = rng.integers(1, 8, no)
+    l_order = np.repeat(o_key, nlines)
+    l_odate = np.repeat(o_date, nlines)
+    nl = len(l_order)
+    l_linenumber = (
+        np.arange(nl) - np.repeat(np.cumsum(nlines) - nlines, nlines) + 1
+    ).astype(np.int32)
+    l_part = rng.integers(1, npart + 1, nl).astype(np.int64)
+    lj = rng.integers(0, 4, nl)
+    l_supp = ((l_part - 1 + lj * (ns // 4 + (l_part - 1) // ns)) % ns + 1).astype(
+        np.int64
+    )
+    l_qty = rng.integers(1, 51, nl).astype(np.int64)
+    l_price = np.round(l_qty * retail[l_part - 1] / 1.0, 2)
+    l_disc = rng.integers(0, 11, nl) / 100.0
+    l_tax = rng.integers(0, 9, nl) / 100.0
+    l_ship = (l_odate + rng.integers(1, 122, nl)).astype(np.int32)
+    l_commit = (l_odate + rng.integers(30, 91, nl)).astype(np.int32)
+    l_receipt = (l_ship + rng.integers(1, 31, nl)).astype(np.int32)
+    cutoff = _days(1995, 6, 17)
+    l_linestatus_code = (l_ship > cutoff).astype(np.int64)  # F=0 else O=1
+    ret_rand = rng.integers(0, 2, nl)
+    l_returnflag_code = np.where(l_receipt <= cutoff, ret_rand, 2)  # R/A else N
+
+    out["lineitem"] = _ht(
+        {
+            "l_orderkey": l_order,
+            "l_partkey": l_part,
+            "l_suppkey": l_supp,
+            "l_linenumber": l_linenumber,
+            "l_quantity": l_qty.astype(np.float64),
+            "l_extendedprice": l_price,
+            "l_discount": l_disc,
+            "l_tax": l_tax,
+            "l_returnflag": (StringDict.from_values(["A", "N", "R"]),
+                             np.array([0, 2, 1], dtype=np.int32)[l_returnflag_code]),
+            "l_linestatus": (StringDict.from_values(["F", "O"]),
+                             l_linestatus_code.astype(np.int32)),
+            "l_shipdate": l_ship,
+            "l_commitdate": l_commit,
+            "l_receiptdate": l_receipt,
+            "l_shipinstruct": (StringDict.from_values(sorted(SHIPINSTRUCT)),
+                               rng.integers(0, 4, nl).astype(np.int32)),
+            "l_shipmode": (StringDict.from_values(sorted(SHIPMODES)),
+                           rng.integers(0, 7, nl).astype(np.int32)),
+            "l_comment": (StringDict.from_values([""]),
+                          np.zeros(nl, dtype=np.int32)),
+        },
+        {
+            "l_orderkey": T.BIGINT, "l_partkey": T.BIGINT, "l_suppkey": T.BIGINT,
+            "l_linenumber": T.INT, "l_quantity": T.DECIMAL(15, 2),
+            "l_extendedprice": DEC, "l_discount": T.DECIMAL(15, 2),
+            "l_tax": T.DECIMAL(15, 2), "l_shipdate": T.DATE,
+            "l_commitdate": T.DATE, "l_receiptdate": T.DATE,
+        },
+    )
+
+    # order totalprice = sum of line gross prices
+    gross = np.round(l_price * (1 - l_disc) * (1 + l_tax), 2)
+    totals = np.zeros(no)
+    np.add.at(totals, l_order - 1, gross)
+    out["orders"] = _ht(
+        {
+            "o_orderkey": o_key,
+            "o_custkey": o_cust,
+            "o_orderstatus": (StringDict.from_values(["F", "O"]),
+                              (rng.integers(0, 3, no) != 0).astype(np.int32)),
+            "o_totalprice": np.round(totals, 2),
+            "o_orderdate": o_date,
+            "o_orderpriority": [PRIORITIES[i] for i in o_prio],
+            "o_clerk": [f"Clerk#{k % 1000:09d}" for k in o_key],
+            "o_shippriority": np.zeros(no, dtype=np.int32),
+            "o_comment": ["" for _ in o_key],
+        },
+        {
+            "o_orderkey": T.BIGINT, "o_custkey": T.BIGINT,
+            "o_totalprice": DEC, "o_orderdate": T.DATE, "o_shippriority": T.INT,
+        },
+    )
+    return out

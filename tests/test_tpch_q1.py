@@ -1,0 +1,95 @@
+"""End-to-end TPC-H Q1 with a hand-built physical plan, validated against a
+pandas oracle (the SQL-regression-suite analog of SURVEY §4 tier 3)."""
+
+import numpy as np
+import pandas as pd
+
+import jax
+
+from starrocks_tpu import types as T
+from starrocks_tpu.column import HostTable
+from starrocks_tpu.exprs import AggExpr, col, le, lit, mul, sub, add
+from starrocks_tpu.ops import filter_chunk, hash_aggregate, project, sort_chunk
+
+
+def tpch_q1(chunk):
+    """select l_returnflag, l_linestatus, sum(qty), sum(price),
+    sum(price*(1-disc)), sum(price*(1-disc)*(1+tax)), avg(qty), avg(price),
+    avg(disc), count(*) from lineitem where l_shipdate <= '1998-09-02'
+    group by 1, 2 order by 1, 2"""
+    f = filter_chunk(chunk, le(col("l_shipdate"), lit("1998-09-02")))
+    disc_price = mul(col("l_extendedprice"), sub(lit(1), col("l_discount")))
+    charge = mul(disc_price, add(lit(1), col("l_tax")))
+    pre = project(
+        f,
+        [col("l_returnflag"), col("l_linestatus"), col("l_quantity"),
+         col("l_extendedprice"), disc_price, charge, col("l_discount")],
+        ["rf", "ls", "qty", "price", "disc_price", "charge", "disc"],
+    )
+    out, ng = hash_aggregate(
+        pre,
+        group_by=(("l_returnflag", col("rf")), ("l_linestatus", col("ls"))),
+        aggs=(
+            ("sum_qty", AggExpr("sum", col("qty"))),
+            ("sum_base_price", AggExpr("sum", col("price"))),
+            ("sum_disc_price", AggExpr("sum", col("disc_price"))),
+            ("sum_charge", AggExpr("sum", col("charge"))),
+            ("avg_qty", AggExpr("avg", col("qty"))),
+            ("avg_price", AggExpr("avg", col("price"))),
+            ("avg_disc", AggExpr("avg", col("disc"))),
+            ("count_order", AggExpr("count", None)),
+        ),
+        num_groups=8,
+    )
+    return sort_chunk(out, ((col("l_returnflag"), True, False),
+                            (col("l_linestatus"), True, False))), ng
+
+
+def q1_pandas(df, cutoff):
+    f = df[df["l_shipdate"] <= cutoff]
+    g = f.assign(
+        disc_price=f.l_extendedprice * (1 - f.l_discount),
+        charge=f.l_extendedprice * (1 - f.l_discount) * (1 + f.l_tax),
+    ).groupby(["l_returnflag", "l_linestatus"], as_index=False).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "size"),
+    )
+    return g.sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+
+
+def test_q1_vs_pandas():
+    from starrocks_tpu.storage.datagen.tpch import gen_tpch
+
+    li = gen_tpch(sf=0.01)["lineitem"]
+    chunk = li.to_chunk()
+
+    jq1 = jax.jit(tpch_q1)
+    out, ng = jq1(chunk)
+    got = pd.DataFrame(
+        HostTable.from_chunk(out).to_pylist(),
+        columns=["l_returnflag", "l_linestatus", "sum_qty", "sum_base_price",
+                 "sum_disc_price", "sum_charge", "avg_qty", "avg_price",
+                 "avg_disc", "count_order"],
+    )
+
+    df = li.to_pandas()
+    exp = q1_pandas(df, pd.Timestamp("1998-09-02"))
+
+    assert int(ng) == len(exp) == 4  # A/F, N/F, N/O, R/F
+    assert list(got["l_returnflag"]) == list(exp["l_returnflag"])
+    assert list(got["l_linestatus"]) == list(exp["l_linestatus"])
+    np.testing.assert_allclose(got["sum_qty"], exp["sum_qty"], rtol=1e-12)
+    np.testing.assert_allclose(got["sum_base_price"], exp["sum_base_price"], rtol=1e-12)
+    # decimal (scale 4/6) vs float64 oracle: float64 is the imprecise one here
+    np.testing.assert_allclose(got["sum_disc_price"], exp["sum_disc_price"], rtol=1e-9)
+    np.testing.assert_allclose(got["sum_charge"], exp["sum_charge"], rtol=1e-9)
+    np.testing.assert_allclose(got["avg_qty"], exp["avg_qty"], rtol=1e-9)
+    np.testing.assert_allclose(got["avg_price"], exp["avg_price"], rtol=1e-9)
+    np.testing.assert_allclose(got["avg_disc"], exp["avg_disc"], rtol=1e-9)
+    np.testing.assert_array_equal(got["count_order"], exp["count_order"])
