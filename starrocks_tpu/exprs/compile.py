@@ -155,9 +155,12 @@ class ExprCompiler:
                     jnp.zeros((self.chunk.capacity,), dtype=jnp.bool_),
                     lt,
                 )
-            if lt.is_string:
-                return EVal(hv, None, lt)  # kept host-side until context known
-            return EVal(jnp.asarray(hv, dtype=lt.dtype), None, lt)
+            # literals stay as HOST scalars (strings and numbers alike):
+            # jax 0.9 turns arrays constructed inside a jit trace into
+            # tracers, which would break host consumers (substr bounds,
+            # LIKE patterns); compute sites coerce via jnp.asarray where
+            # needed and XLA constant-folds them
+            return EVal(hv, None, lt)
         if isinstance(e, Cast):
             return self._cast(self.eval(e.arg), e.to)
         if isinstance(e, Case):
@@ -606,6 +609,33 @@ def _f_date_add_days(cc, a, n):
         jnp.asarray(a.data, jnp.int32) + jnp.asarray(n.data, jnp.int32),
         _and_valid(a.valid, n.valid),
         T.DATE,
+    )
+
+
+def _days_from_civil(y, m, d):
+    yy = jnp.asarray(y, jnp.int64) - jnp.asarray(m <= 2, jnp.int64)
+    era = jnp.where(yy >= 0, yy, yy - 399) // 400
+    yoe = yy - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146_097 + doe - 719_468).astype(jnp.int32)
+
+
+@function("date_add_months")
+def _f_date_add_months(cc, a, n):
+    a = _lit_as_date_if_str(a)
+    y, m, d = _civil_from_days(_as_days(a))
+    months = jnp.asarray(y, jnp.int64) * 12 + (m - 1) + jnp.asarray(n.data, jnp.int64)
+    y2 = months // 12
+    m2 = (months % 12 + 1).astype(jnp.int64)
+    leap = ((y2 % 4 == 0) & ((y2 % 100 != 0) | (y2 % 400 == 0))).astype(jnp.int64)
+    dim = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31], jnp.int64)[
+        m2 - 1
+    ] + jnp.where(m2 == 2, leap, 0)
+    d2 = jnp.minimum(jnp.asarray(d, jnp.int64), dim)
+    return EVal(
+        _days_from_civil(y2, m2, d2), _and_valid(a.valid, n.valid), T.DATE
     )
 
 

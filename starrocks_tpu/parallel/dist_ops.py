@@ -42,11 +42,13 @@ def dist_aggregate(
     via=SHUFFLE: hash-partition partial states by group key so each shard
     finalizes its own key range (right for high-cardinality group-bys,
     e.g. TPC-DS Q67); output is sharded.
-    Returns (final_chunk, ngroups, max_bucket): max_bucket is the largest
-    pre-padding exchange bucket (0 for BROADCAST); the host must check
-    max_bucket <= bucket_capacity or rows were dropped.
+    Returns (final_chunk, ngroups, max_bucket, partial_ngroups):
+    - max_bucket: largest pre-padding exchange bucket (0 for BROADCAST);
+      host must check max_bucket <= bucket_capacity.
+    - partial_ngroups: this shard's true partial group count; host must
+      check <= partial_groups (overflow silently merges groups otherwise).
     """
-    part, _ = hash_aggregate(
+    part, partial_ng = hash_aggregate(
         local_chunk, group_by, aggs, partial_groups, mode=PARTIAL
     )
     key_cols = tuple(Col(name) for name, _ in group_by)
@@ -60,7 +62,7 @@ def dist_aggregate(
     out, ng = hash_aggregate(
         merged, final_group_by, final_agg_exprs(aggs), final_groups, mode=FINAL
     )
-    return out, ng, max_bucket
+    return out, ng, max_bucket, partial_ng
 
 
 def broadcast_join(

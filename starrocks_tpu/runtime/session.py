@@ -1,0 +1,145 @@
+"""Session: the SQL entry point.
+
+Reference behavior: fe qe/ConnectContext + StmtExecutor.execute
+(qe/StmtExecutor.java:923) — parse, analyze, plan, execute, return rows.
+DDL (CREATE/DROP) and INSERT mutate the catalog the way LocalMetastore
+does (server/LocalMetastore.java:301), minus replication (storage layer).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from ..column import Field, HostTable, Schema, StringDict
+from ..sql import ast
+from ..sql.analyzer import Analyzer
+from ..sql.logical import plan_tree_str
+from ..sql.optimizer import optimize
+from ..sql.parser import parse
+from ..storage.catalog import Catalog
+from .executor import DeviceCache, Executor, QueryResult
+
+
+class Session:
+    def __init__(self, catalog: Catalog | None = None):
+        self.catalog = catalog or Catalog()
+        self.cache = DeviceCache()
+
+    def sql(self, text: str):
+        stmt = parse(text)
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt)
+        if isinstance(stmt, ast.Select):
+            return self._query(stmt)
+        if isinstance(stmt, ast.CreateTable):
+            return self._create(stmt)
+        if isinstance(stmt, ast.DropTable):
+            self.catalog.drop(stmt.name, stmt.if_exists)
+            self.cache.invalidate(stmt.name.lower())
+            return None
+        if isinstance(stmt, ast.Insert):
+            return self._insert(stmt)
+        raise ValueError(f"unsupported statement {type(stmt).__name__}")
+
+    # --- SELECT ---------------------------------------------------------------
+    def _query(self, sel: ast.Select) -> QueryResult:
+        plan = Analyzer(self.catalog).analyze(sel)
+        return Executor(self.catalog, self.cache).execute_logical(plan)
+
+    def _explain(self, stmt: ast.Explain) -> str:
+        assert isinstance(stmt.stmt, ast.Select), "EXPLAIN supports SELECT"
+        plan = Analyzer(self.catalog).analyze(stmt.stmt)
+        plan = optimize(plan, self.catalog)
+        return plan_tree_str(plan)
+
+    # --- DDL / DML -------------------------------------------------------------
+    def _create(self, stmt: ast.CreateTable):
+        fields, arrays = [], {}
+        for c in stmt.columns:
+            t = c.type
+            d = StringDict.from_values([]) if t.is_string else None
+            fields.append(Field(c.name, t, c.nullable, d))
+            arrays[c.name] = np.zeros(0, dtype=t.np_dtype)
+        ht = HostTable(Schema(tuple(fields)), arrays, {})
+        # DISTRIBUTED BY HASH is bucketing, NOT a uniqueness guarantee, so it
+        # must not feed unique_keys; key-model DDL (PRIMARY/UNIQUE KEY) will
+        self.catalog.register(stmt.name, ht, unique_keys=())
+        return None
+
+    def _insert(self, stmt: ast.Insert):
+        handle = self.catalog.get_table(stmt.table)
+        if handle is None:
+            raise ValueError(f"unknown table {stmt.table}")
+        if stmt.select is not None:
+            res = self._query(stmt.select)
+            incoming = res.table
+        else:
+            incoming = self._values_to_table(handle, stmt)
+        merged = concat_tables(handle.table, incoming, target_schema=handle.schema)
+        self.catalog.register(handle.name, merged, handle.unique_keys)
+        self.cache.invalidate(handle.name)
+        return incoming.num_rows
+
+    def _values_to_table(self, handle, stmt: ast.Insert) -> HostTable:
+        cols = stmt.columns or tuple(f.name for f in handle.schema)
+        rows = stmt.values
+        data = {c: [] for c in cols}
+        from ..exprs.ir import Lit
+
+        for row in rows:
+            if len(row) != len(cols):
+                raise ValueError("INSERT arity mismatch")
+            for c, e in zip(cols, row):
+                if not isinstance(e, Lit):
+                    raise ValueError("INSERT VALUES must be literals")
+                data[c].append(e.value)
+        types = {}
+        valids = {}
+        out = {}
+        for f in handle.schema:
+            if f.name in data:
+                vals = data[f.name]
+                types[f.name] = f.type
+                out[f.name] = vals
+            else:
+                out[f.name] = [None] * len(rows)
+                types[f.name] = f.type
+        return HostTable.from_pydict(out, types=types)
+
+
+def concat_tables(a: HostTable, b: HostTable, target_schema: Schema) -> HostTable:
+    """Append b's rows to a, merging string dictionaries per column."""
+    fields, arrays, valids = [], {}, {}
+    bn = {f.name.split(".", 1)[-1]: f.name for f in b.schema}
+    for f in target_schema:
+        name = f.name
+        bname = bn.get(name, name)
+        fb = b.schema.field(bname)
+        aa = a.arrays[name]
+        ba = b.arrays[bname]
+        if f.type.is_string:
+            da = f.dict or StringDict.from_values([])
+            db = fb.dict or StringDict.from_values([])
+            merged, ra, rb = da.merge(db)
+            aa = ra[aa] if len(aa) else aa
+            ba = rb[ba] if len(ba) else ba
+            fields.append(Field(name, f.type, f.nullable, merged))
+        else:
+            if fb.type != f.type:
+                if f.type.is_decimal and fb.type.is_decimal:
+                    diff = f.type.scale - fb.type.scale
+                    ba = ba * (10 ** diff) if diff >= 0 else ba // (10 ** -diff)
+                elif f.type.is_decimal and fb.type.is_float:
+                    ba = np.round(ba * 10 ** f.type.scale).astype(np.int64)
+                else:
+                    ba = ba.astype(f.type.np_dtype)
+            fields.append(Field(name, f.type, f.nullable, None))
+        arrays[name] = np.concatenate([aa, ba]).astype(f.type.np_dtype)
+        va = a.valids.get(name)
+        vb = b.valids.get(bname)
+        if va is not None or vb is not None:
+            va = va if va is not None else np.ones(len(aa), dtype=np.bool_)
+            vb = vb if vb is not None else np.ones(len(ba), dtype=np.bool_)
+            valids[name] = np.concatenate([va, vb])
+    return HostTable(Schema(tuple(fields)), arrays, valids)

@@ -1,0 +1,451 @@
+"""Semantic analysis: AST -> logical plan.
+
+Reference behavior: fe sql/analyzer/Analyzer.java:192 + the relation
+transformer (sql/optimizer/transformer/RelationTransformer.java) — scope-based
+name resolution, aggregate extraction, subquery marking. Output columns are
+qualified "alias.column" so self-joins (TPC-H Q21's three lineitem instances)
+stay unambiguous.
+
+Subqueries (ast.Subquery/Exists/InSubquery) survive analysis as expression
+markers holding *analyzed* logical plans + correlation info; the optimizer
+rewrites them into joins or the executor evaluates them (uncorrelated scalar).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional
+
+from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit
+from . import ast
+from .logical import (
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LogicalPlan,
+)
+
+
+class AnalyzerError(ValueError):
+    pass
+
+
+# --- analyzed subquery markers (carried inside expressions) ------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    plan: LogicalPlan
+    correlated: tuple  # tuple[(outer_col_name, inner_col_name)] equi-pairs
+
+    def __repr__(self):
+        return f"ScalarSubquery(corr={self.correlated})"
+
+
+@dataclasses.dataclass(frozen=True)
+class SemiJoinMark(Expr):
+    """EXISTS / IN-subquery lowered to a (anti)semi-join marker."""
+
+    plan: LogicalPlan
+    correlated: tuple
+    probe_expr: Optional[Expr]  # for IN: outer expr to match inner_col
+    inner_col: Optional[str]
+    negated: bool = False
+
+    def __repr__(self):
+        k = "anti" if self.negated else "semi"
+        return f"SemiJoinMark[{k}]"
+
+
+class Scope:
+    """Visible columns: list of (alias, column_base_name) -> qualified name."""
+
+    def __init__(self, entries, parent: Optional["Scope"] = None):
+        # entries: list[(alias, tuple[base_names])]
+        self.entries = entries
+        self.parent = parent
+
+    def resolve(self, table: Optional[str], name: str):
+        """Returns (qualified_name, depth) — depth>0 means outer (correlated)."""
+        hits = []
+        for alias, cols in self.entries:
+            if table is not None and alias != table:
+                continue
+            if name in cols:
+                hits.append(f"{alias}.{name}")
+        if len(hits) > 1:
+            raise AnalyzerError(f"ambiguous column {name!r}: {hits}")
+        if hits:
+            return hits[0], 0
+        if self.parent is not None:
+            q, d = self.parent.resolve(table, name)
+            return q, d + 1
+        raise AnalyzerError(
+            f"unknown column {(table + '.') if table else ''}{name}"
+        )
+
+    def all_names(self):
+        return [f"{a}.{c}" for a, cols in self.entries for c in cols]
+
+
+class Analyzer:
+    def __init__(self, catalog):
+        self.catalog = catalog
+        self._ids = itertools.count()
+
+    # --- relations -----------------------------------------------------------
+    def analyze(self, sel: ast.Select) -> LogicalPlan:
+        return self._analyze_select(sel, None, {})
+
+    def _analyze_select(
+        self, sel: ast.Select, outer: Optional[Scope], ctes: dict
+    ) -> LogicalPlan:
+        ctes = dict(ctes)
+        for name, sub in sel.ctes:
+            ctes[name.lower()] = sub
+
+        if sel.from_ is None:
+            raise AnalyzerError("SELECT without FROM not supported yet")
+        plan, scope = self._analyze_relation(sel.from_, outer, ctes)
+
+        if sel.where is not None:
+            pred = self._lower(sel.where, scope, ctes, allow_agg=False)
+            plan = LFilter(plan, pred)
+
+        # --- aggregate detection --------------------------------------------
+        lowered_items = []
+        for item in sel.items:
+            if isinstance(item.expr, ast.Star):
+                for q in self._star_names(scope, item.expr.table):
+                    lowered_items.append((q.split(".", 1)[1], Col(q)))
+                continue
+            e = self._lower(item.expr, scope, ctes, allow_agg=True)
+            name = item.alias or self._auto_name(item.expr)
+            if any(name == n for n, _ in lowered_items):
+                # chunks need unique column names (SQL allows duplicates;
+                # values are what matter, readers use positions)
+                k = 1
+                while any(f"{name}_{k}" == n for n, _ in lowered_items):
+                    k += 1
+                name = f"{name}_{k}"
+            lowered_items.append((name, e))
+
+        group_exprs = []
+        for g in sel.group_by:
+            if isinstance(g, Lit) and isinstance(g.value, int):
+                idx = g.value - 1
+                if not (0 <= idx < len(lowered_items)):
+                    raise AnalyzerError(f"GROUP BY ordinal {g.value} out of range")
+                group_exprs.append(lowered_items[idx][1])
+            else:
+                group_exprs.append(self._lower(g, scope, ctes, allow_agg=False))
+
+        having = (
+            self._lower(sel.having, scope, ctes, allow_agg=True)
+            if sel.having is not None
+            else None
+        )
+        order_items = [
+            (self._lower_order_expr(o.expr, lowered_items, scope, ctes), o.asc,
+             o.nulls_first if o.nulls_first is not None else not o.asc)
+            for o in sel.order_by
+        ]
+
+        has_agg = (
+            bool(group_exprs)
+            or any(_contains_agg(e) for _, e in lowered_items)
+            or (having is not None and _contains_agg(having))
+        )
+
+        if has_agg:
+            plan, lowered_items, having, order_items = self._build_aggregate(
+                plan, group_exprs, lowered_items, having, order_items
+            )
+            if having is not None:
+                plan = LFilter(plan, having)
+
+        plan = LProject(plan, tuple(lowered_items))
+
+        if sel.distinct:
+            plan = LAggregate(
+                plan,
+                tuple((n, Col(n)) for n, _ in lowered_items),
+                (),
+            )
+
+        if order_items:
+            limit = sel.limit if sel.offset == 0 else None
+            plan = LSort(plan, tuple(order_items), limit)
+            if sel.limit is not None and sel.offset != 0:
+                plan = LLimit(plan, sel.limit, sel.offset)
+        elif sel.limit is not None:
+            plan = LLimit(plan, sel.limit, sel.offset)
+        return plan
+
+    def _analyze_relation(self, rel, outer, ctes):
+        if isinstance(rel, ast.TableRef):
+            name = rel.name.lower()
+            if name in ctes:
+                alias = rel.alias or name
+                sub_plan = self._analyze_select(ctes[name], outer, ctes)
+                return self._aliased_subplan(sub_plan, alias)
+            t = self.catalog.get_table(name)
+            if t is None:
+                raise AnalyzerError(f"unknown table {rel.name!r}")
+            alias = rel.alias or name
+            cols = tuple(f.name for f in t.schema)
+            scan = LScan(name, alias, cols)
+            return scan, Scope([(alias, cols)], outer)
+        if isinstance(rel, ast.SubqueryRef):
+            sub_plan = self._analyze_select(rel.select, outer, ctes)
+            return self._aliased_subplan(sub_plan, rel.alias)
+        if isinstance(rel, ast.JoinRef):
+            lplan, lscope = self._analyze_relation(rel.left, outer, ctes)
+            rplan, rscope = self._analyze_relation(rel.right, outer, ctes)
+            scope = Scope(lscope.entries + rscope.entries, outer)
+            kind = rel.kind
+            cond = None
+            if rel.on is not None:
+                cond = self._lower(rel.on, scope, ctes, allow_agg=False)
+            if kind == "right":
+                # normalize RIGHT JOIN to LEFT JOIN with swapped inputs
+                lplan, rplan = rplan, lplan
+                scope = Scope(rscope.entries + lscope.entries, outer)
+                kind = "left"
+            return LJoin(lplan, rplan, kind, cond), scope
+        raise AnalyzerError(f"unsupported relation {rel!r}")
+
+    def _aliased_subplan(self, sub_plan: LogicalPlan, alias: str):
+        """Wrap a subquery plan so its outputs become alias.col."""
+        out = sub_plan.output_names()
+        base = tuple(n.split(".", 1)[-1] for n in out)
+        if len(set(base)) != len(base):
+            raise AnalyzerError(f"duplicate column names in subquery {alias}: {base}")
+        proj = LProject(
+            sub_plan, tuple((f"{alias}.{b}", Col(q)) for b, q in zip(base, out))
+        )
+        return proj, Scope([(alias, base)], None)
+
+    def _star_names(self, scope: Scope, table: Optional[str]):
+        names = []
+        for alias, cols in scope.entries:
+            if table is None or alias == table:
+                names.extend(f"{alias}.{c}" for c in cols)
+        if not names:
+            raise AnalyzerError(f"unknown table in star: {table}")
+        return names
+
+    # --- expressions ---------------------------------------------------------
+    def _lower(self, e: Expr, scope: Scope, ctes, allow_agg: bool) -> Expr:
+        if isinstance(e, ast.RawCol):
+            q, depth = scope.resolve(e.table, e.name)
+            if depth > 0:
+                # correlated outer reference: mark with special prefix; the
+                # subquery assembler extracts these
+                return Col(f"@outer.{q}")
+            return Col(q)
+        if isinstance(e, Col):
+            return e
+        if isinstance(e, Lit):
+            return e
+        if isinstance(e, AggExpr):
+            if not allow_agg:
+                raise AnalyzerError(f"aggregate {e} not allowed here")
+            arg = (
+                self._lower(e.arg, scope, ctes, allow_agg=False)
+                if e.arg is not None
+                else None
+            )
+            return AggExpr(e.fn, arg, e.distinct)
+        if isinstance(e, Call):
+            return Call(e.fn, *[self._lower(a, scope, ctes, allow_agg) for a in e.args])
+        if isinstance(e, Case):
+            whens = tuple(
+                (self._lower(c, scope, ctes, allow_agg), self._lower(v, scope, ctes, allow_agg))
+                for c, v in e.whens
+            )
+            orelse = self._lower(e.orelse, scope, ctes, allow_agg) if e.orelse is not None else None
+            return Case(whens, orelse)
+        if isinstance(e, Cast):
+            return Cast(self._lower(e.arg, scope, ctes, allow_agg), e.to)
+        if isinstance(e, InList):
+            return InList(self._lower(e.arg, scope, ctes, allow_agg), e.values, e.negated)
+        if isinstance(e, ast.Subquery):
+            plan, corr = self._analyze_subquery(e.select, scope, ctes)
+            return ScalarSubquery(plan, corr)
+        if isinstance(e, ast.Exists):
+            plan, corr = self._analyze_subquery(e.select, scope, ctes)
+            return SemiJoinMark(plan, corr, None, None, e.negated)
+        if isinstance(e, ast.InSubquery):
+            probe = self._lower(e.arg, scope, ctes, allow_agg=False)
+            plan, corr = self._analyze_subquery(e.select, scope, ctes)
+            inner = plan.output_names()
+            if len(inner) != 1:
+                raise AnalyzerError("IN subquery must produce one column")
+            return SemiJoinMark(plan, corr, probe, inner[0], e.negated)
+        if isinstance(e, ast.RawFunc):
+            raise AnalyzerError(f"unknown function {e.name!r}")
+        if isinstance(e, ast.Star):
+            raise AnalyzerError("* only allowed as a top-level select item")
+        raise AnalyzerError(f"cannot analyze expression {e!r}")
+
+    def _lower_order_expr(self, e, lowered_items, scope, ctes):
+        # ORDER BY may reference select aliases or ordinals
+        if isinstance(e, Lit) and isinstance(e.value, int):
+            idx = e.value - 1
+            if not (0 <= idx < len(lowered_items)):
+                raise AnalyzerError(f"ORDER BY ordinal {e.value} out of range")
+            return Col(lowered_items[idx][0])
+        if isinstance(e, ast.RawCol) and e.table is None:
+            for n, _ in lowered_items:
+                if n == e.name:
+                    return Col(n)
+        lowered = self._lower(e, scope, ctes, allow_agg=True)
+        # exact match against a select item -> reference it by name
+        for n, le in lowered_items:
+            if le == lowered:
+                return Col(n)
+        return lowered
+
+    def _analyze_subquery(self, sel: ast.Select, outer_scope: Scope, ctes):
+        """Analyze a subquery; extract correlated equality pairs.
+
+        The subquery plan may contain Col("@outer.x") references; we pull
+        equality predicates of the form inner_col = @outer.x out of filters
+        (the optimizer turns them into join keys)."""
+        plan = self._analyze_select(sel, outer_scope, ctes)
+        corr = _extract_correlations(plan)
+        return plan, corr
+
+    # --- aggregates ----------------------------------------------------------
+    def _build_aggregate(self, plan, group_exprs, items, having, order_items):
+        """Split select items into (pre-projection, aggregate, post-projection)."""
+        aggs = {}
+        pre = {}
+
+        def agg_name(a: AggExpr) -> str:
+            for n, existing in aggs.items():
+                if existing == a:
+                    return n
+            n = f"agg_{len(aggs)}"
+            aggs[n] = a
+            return n
+
+        group_named = []
+        for i, g in enumerate(group_exprs):
+            if isinstance(g, Col):
+                group_named.append((g.name, g))
+            else:
+                group_named.append((f"gexpr_{i}", g))
+
+        def replace(e: Expr) -> Expr:
+            # replace whole-group-expr matches and aggregates by refs
+            for gname, gexpr in group_named:
+                if e == gexpr:
+                    return Col(gname)
+            if isinstance(e, AggExpr):
+                return Col(agg_name(e))
+            if isinstance(e, Call):
+                return Call(e.fn, *[replace(a) for a in e.args])
+            if isinstance(e, Case):
+                return Case(
+                    tuple((replace(c), replace(v)) for c, v in e.whens),
+                    replace(e.orelse) if e.orelse is not None else None,
+                )
+            if isinstance(e, Cast):
+                return Cast(replace(e.arg), e.to)
+            if isinstance(e, InList):
+                return InList(replace(e.arg), e.values, e.negated)
+            if isinstance(e, Col):
+                return e
+            if isinstance(e, Lit):
+                return e
+            if isinstance(e, (ScalarSubquery, SemiJoinMark)):
+                return e
+            raise AnalyzerError(f"cannot use {e!r} in aggregate query")
+
+        new_items = [(n, replace(e)) for n, e in items]
+        new_having = replace(having) if having is not None else None
+        new_order = [(replace(e), asc, nf) for e, asc, nf in order_items]
+
+        # validate: non-agg select items must now only reference group keys/aggs
+        allowed = {n for n, _ in group_named} | set(aggs)
+        for n, e in new_items:
+            for c in _cols_of(e):
+                if c not in allowed:
+                    raise AnalyzerError(
+                        f"column {c!r} must appear in GROUP BY or an aggregate"
+                    )
+
+        agg_node = LAggregate(plan, tuple(group_named), tuple(aggs.items()))
+        return agg_node, new_items, new_having, new_order
+
+    @staticmethod
+    def _auto_name(e) -> str:
+        if isinstance(e, ast.RawCol):
+            return e.name
+        r = repr(e)
+        return r if len(r) <= 40 else r[:37] + "..."
+
+
+def _contains_agg(e: Expr) -> bool:
+    if isinstance(e, AggExpr):
+        return True
+    if isinstance(e, Call):
+        return any(_contains_agg(a) for a in e.args)
+    if isinstance(e, Case):
+        return any(
+            _contains_agg(c) or _contains_agg(v) for c, v in e.whens
+        ) or (e.orelse is not None and _contains_agg(e.orelse))
+    if isinstance(e, Cast):
+        return _contains_agg(e.arg)
+    if isinstance(e, InList):
+        return _contains_agg(e.arg)
+    return False
+
+
+def _cols_of(e: Expr):
+    if isinstance(e, Col):
+        yield e.name
+    elif isinstance(e, Call):
+        for a in e.args:
+            yield from _cols_of(a)
+    elif isinstance(e, Case):
+        for c, v in e.whens:
+            yield from _cols_of(c)
+            yield from _cols_of(v)
+        if e.orelse is not None:
+            yield from _cols_of(e.orelse)
+    elif isinstance(e, Cast):
+        yield from _cols_of(e.arg)
+    elif isinstance(e, InList):
+        yield from _cols_of(e.arg)
+
+
+def _extract_correlations(plan: LogicalPlan) -> tuple:
+    """Find Col('@outer.x') equality pairs in the plan's filters."""
+    from .logical import walk_plan
+
+    pairs = []
+    for node in walk_plan(plan):
+        if isinstance(node, LFilter):
+            for conj in _conjuncts(node.predicate):
+                if (
+                    isinstance(conj, Call)
+                    and conj.fn == "eq"
+                    and len(conj.args) == 2
+                ):
+                    a, b = conj.args
+                    if isinstance(a, Col) and a.name.startswith("@outer."):
+                        if isinstance(b, Col):
+                            pairs.append((a.name[len("@outer."):], b.name))
+                    elif isinstance(b, Col) and b.name.startswith("@outer."):
+                        if isinstance(a, Col):
+                            pairs.append((b.name[len("@outer."):], a.name))
+    return tuple(pairs)
+
+
+def _conjuncts(e: Expr):
+    if isinstance(e, Call) and e.fn == "and":
+        for a in e.args:
+            yield from _conjuncts(a)
+    else:
+        yield e

@@ -1,0 +1,165 @@
+"""SQL AST.
+
+Reference behavior: the ANTLR grammar fe/fe-grammar/StarRocks.g4 (3390 lines)
++ AST classes fe-core/.../sql/ast/ (110 files). We cover the analytic subset
+(SELECT with joins/subqueries/CTEs, DDL for tables, INSERT) and reuse the
+expression IR (exprs/ir.py) for scalar expressions, extended with unresolved
+forms the analyzer lowers: RawCol (qualified names), RawFunc (pre-registry
+function refs), Star, Subquery/Exists/InSubquery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..exprs.ir import Expr
+
+
+# --- unresolved expression nodes (lowered by the analyzer) -------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RawCol(Expr):
+    table: Optional[str]  # alias qualifier or None
+    name: str
+
+    def __repr__(self):
+        return f"{self.table}.{self.name}" if self.table else self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class RawFunc(Expr):
+    name: str
+    args: tuple
+    distinct: bool = False
+
+    def __repr__(self):
+        d = "DISTINCT " if self.distinct else ""
+        return f"{self.name}({d}{', '.join(map(repr, self.args))})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Expr):
+    table: Optional[str] = None
+
+    def __repr__(self):
+        return f"{self.table}.*" if self.table else "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class Subquery(Expr):
+    """Scalar subquery in an expression."""
+
+    select: "Select"
+
+    def __repr__(self):
+        return "(<subquery>)"
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists(Expr):
+    select: "Select"
+    negated: bool = False
+
+    def __repr__(self):
+        return f"{'NOT ' if self.negated else ''}EXISTS(<subquery>)"
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Expr):
+    arg: Expr
+    select: "Select"
+    negated: bool = False
+
+    def __repr__(self):
+        return f"{self.arg} {'NOT ' if self.negated else ''}IN (<subquery>)"
+
+
+# --- relations ---------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRef:
+    select: "Select"
+    alias: str
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinRef:
+    left: object
+    right: object
+    kind: str  # inner | left | right | cross
+    on: Optional[Expr]
+
+
+# --- statements --------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderItem:
+    expr: Expr
+    asc: bool = True
+    nulls_first: Optional[bool] = None  # default: asc->nulls last (MySQL-ish)
+
+
+@dataclasses.dataclass(frozen=True)
+class Select:
+    items: tuple  # tuple[SelectItem]
+    from_: Optional[object]  # TableRef | SubqueryRef | JoinRef | None
+    where: Optional[Expr] = None
+    group_by: tuple = ()
+    having: Optional[Expr] = None
+    order_by: tuple = ()  # tuple[OrderItem]
+    limit: Optional[int] = None
+    offset: int = 0
+    distinct: bool = False
+    ctes: tuple = ()  # tuple[(name, Select)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnDef:
+    name: str
+    type: object  # types.LogicalType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTable:
+    name: str
+    columns: tuple  # tuple[ColumnDef]
+    distributed_by: tuple = ()  # hash distribution keys
+    buckets: int = 0
+    properties: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Insert:
+    table: str
+    columns: tuple
+    select: Optional[Select]
+    values: tuple  # tuple of row tuples of Expr
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable:
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Explain:
+    stmt: object
+    analyze: bool = False

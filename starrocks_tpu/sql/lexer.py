@@ -1,0 +1,117 @@
+"""SQL tokenizer.
+
+Reference behavior: the ANTLR lexer fe/fe-grammar (646-line lexer grammar).
+Hand-rolled here: the analytic subset needs ~40 token kinds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "like", "between", "is",
+    "null", "case", "when", "then", "else", "end", "join", "inner", "left",
+    "right", "outer", "cross", "on", "asc", "desc", "distinct", "exists",
+    "union", "all", "interval", "date", "extract", "cast", "with", "create",
+    "table", "insert", "into", "values", "drop", "if", "true", "false",
+    "nulls", "first", "last", "explain", "analyze", "year", "month", "day",
+    "distributed", "hash", "buckets", "properties", "substring", "any",
+}
+
+
+@dataclasses.dataclass
+class Token:
+    kind: str  # 'kw', 'ident', 'number', 'string', 'op', 'eof'
+    value: str
+    pos: int
+
+
+class LexError(ValueError):
+    pass
+
+
+def tokenize(sql: str) -> list:
+    out = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if c == "-" and i + 1 < n and sql[i + 1] == "-":
+            while i < n and sql[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and sql[i + 1] == "*":
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise LexError(f"unterminated comment at {i}")
+            i = j + 2
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            word = sql[i:j]
+            lw = word.lower()
+            out.append(Token("kw" if lw in KEYWORDS else "ident", lw if lw in KEYWORDS else word, i))
+            i = j
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = False
+            while j < n and (sql[j].isdigit() or (sql[j] == "." and not seen_dot)):
+                if sql[j] == ".":
+                    seen_dot = True
+                j += 1
+            if j < n and sql[j] in "eE":
+                k = j + 1
+                if k < n and sql[k] in "+-":
+                    k += 1
+                while k < n and sql[k].isdigit():
+                    k += 1
+                j = k
+                seen_dot = True
+            out.append(Token("number", sql[i:j], i))
+            i = j
+            continue
+        if c == "'":
+            j = i + 1
+            buf = []
+            while j < n:
+                if sql[j] == "'" and j + 1 < n and sql[j + 1] == "'":
+                    buf.append("'")
+                    j += 2
+                elif sql[j] == "'":
+                    break
+                else:
+                    buf.append(sql[j])
+                    j += 1
+            if j >= n:
+                raise LexError(f"unterminated string at {i}")
+            out.append(Token("string", "".join(buf), i))
+            i = j + 1
+            continue
+        if c == '"' or c == "`":
+            q = c
+            j = sql.find(q, i + 1)
+            if j < 0:
+                raise LexError(f"unterminated quoted identifier at {i}")
+            out.append(Token("ident", sql[i + 1 : j], i))
+            i = j + 1
+            continue
+        for op in ("<=", ">=", "<>", "!=", "||"):
+            if sql.startswith(op, i):
+                out.append(Token("op", "<>" if op == "!=" else op, i))
+                i += 2
+                break
+        else:
+            if c in "+-*/%(),.<>=;":
+                out.append(Token("op", c, i))
+                i += 1
+            else:
+                raise LexError(f"unexpected character {c!r} at {i}")
+    out.append(Token("eof", "", n))
+    return out

@@ -1,0 +1,156 @@
+"""Logical plan.
+
+Reference behavior: the optimizer's logical OptExpression tree
+(fe sql/optimizer/operator/logical/*). Nodes carry resolved column names
+(qualified as "alias.column" to survive self-joins) and exprs.ir expressions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..exprs.ir import AggExpr, Expr
+
+
+class LogicalPlan:
+    """Base; all nodes are frozen dataclasses (hashable plan fingerprints)."""
+
+    __slots__ = ()
+
+    @property
+    def children(self):
+        return ()
+
+    def output_names(self) -> tuple:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class LScan(LogicalPlan):
+    table: str  # catalog table name
+    alias: str  # instance alias (qualifies output names)
+    columns: tuple  # base column names
+
+    def output_names(self):
+        return tuple(f"{self.alias}.{c}" for c in self.columns)
+
+    def __repr__(self):
+        return f"Scan[{self.table} as {self.alias}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class LFilter(LogicalPlan):
+    child: LogicalPlan
+    predicate: Expr
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def output_names(self):
+        return self.child.output_names()
+
+    def __repr__(self):
+        return f"Filter[{self.predicate}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class LProject(LogicalPlan):
+    child: LogicalPlan
+    exprs: tuple  # tuple[(name, Expr)]
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def output_names(self):
+        return tuple(n for n, _ in self.exprs)
+
+    def __repr__(self):
+        return f"Project[{', '.join(n for n, _ in self.exprs)}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class LJoin(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+    kind: str  # inner | left | semi | anti | cross
+    condition: Optional[Expr]  # full ON condition (analyzer form)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def output_names(self):
+        if self.kind in ("semi", "anti"):
+            return self.left.output_names()
+        return self.left.output_names() + self.right.output_names()
+
+    def __repr__(self):
+        return f"Join[{self.kind} on {self.condition}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class LAggregate(LogicalPlan):
+    child: LogicalPlan
+    group_by: tuple  # tuple[(name, Expr)]
+    aggs: tuple  # tuple[(name, AggExpr)]
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def output_names(self):
+        return tuple(n for n, _ in self.group_by) + tuple(n for n, _ in self.aggs)
+
+    def __repr__(self):
+        return f"Agg[{[n for n, _ in self.group_by]} | {[n for n, _ in self.aggs]}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class LSort(LogicalPlan):
+    child: LogicalPlan
+    keys: tuple  # tuple[(Expr, asc, nulls_first)]
+    limit: Optional[int] = None  # TopN fusion
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def output_names(self):
+        return self.child.output_names()
+
+    def __repr__(self):
+        return f"Sort[{len(self.keys)} keys, limit={self.limit}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class LLimit(LogicalPlan):
+    child: LogicalPlan
+    limit: int
+    offset: int = 0
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def output_names(self):
+        return self.child.output_names()
+
+    def __repr__(self):
+        return f"Limit[{self.limit} offset {self.offset}]"
+
+
+def plan_tree_str(p: LogicalPlan, indent: int = 0) -> str:
+    """EXPLAIN-style tree rendering (golden-plan test surface)."""
+    s = "  " * indent + repr(p) + "\n"
+    for c in p.children:
+        s += plan_tree_str(c, indent + 1)
+    return s
+
+
+def walk_plan(p: LogicalPlan):
+    yield p
+    for c in p.children:
+        yield from walk_plan(c)

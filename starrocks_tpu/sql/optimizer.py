@@ -1,0 +1,618 @@
+"""Rule-based optimizer.
+
+Reference behavior: the Cascades CBO (fe sql/optimizer/QueryOptimizer.java:163,
+165 transformation rules, cost model). The TPU build uses a pragmatic rule
+pipeline over the logical tree — the search-space problems the memo solves
+(join order, distribution enforcement) are handled with a greedy size-ordered
+join enumeration driven by catalog row counts, which is what the reference's
+cost model effectively picks for PK-FK star/snowflake joins like TPC-H/SSB:
+
+1. pushdown_filters     — split conjuncts, inline through projects, push into
+                          join inputs (fe rule analog: PushDownPredicate*)
+2. rewrite_subqueries   — EXISTS/IN -> semi/anti join; correlated scalar agg
+                          -> grouped subplan + left join (rule analog:
+                          sql/optimizer/rule/transformation/*Apply* rules)
+3. reorder_joins        — flatten inner-join regions, greedy smallest-build
+                          left-deep order (cost-model stand-in)
+4. pushdown_filters     — again, now over the new shape
+5. prune_columns        — scans read only referenced columns (analog:
+                          PruneScanColumnRule)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit
+from .analyzer import ScalarSubquery, SemiJoinMark, _conjuncts
+from .logical import (
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LogicalPlan,
+)
+
+
+def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
+    plan = pushdown_filters(plan)
+    plan = rewrite_subqueries(plan, catalog)
+    plan = pushdown_filters(plan)
+    plan = reorder_joins(plan, catalog)
+    plan = pushdown_filters(plan)
+    plan = prune_columns(plan)
+    return plan
+
+
+# --- expression helpers ------------------------------------------------------
+
+
+def expr_cols(e: Expr) -> frozenset:
+    out = set()
+
+    def rec(x):
+        if isinstance(x, Col):
+            out.add(x.name)
+        elif isinstance(x, Call):
+            for a in x.args:
+                rec(a)
+        elif isinstance(x, Case):
+            for c, v in x.whens:
+                rec(c)
+                rec(v)
+            if x.orelse is not None:
+                rec(x.orelse)
+        elif isinstance(x, Cast):
+            rec(x.arg)
+        elif isinstance(x, InList):
+            rec(x.arg)
+        elif isinstance(x, AggExpr) and x.arg is not None:
+            rec(x.arg)
+        elif isinstance(x, SemiJoinMark):
+            if x.probe_expr is not None:
+                rec(x.probe_expr)
+            for outer_c, _ in x.correlated:
+                out.add(outer_c)
+        elif isinstance(x, ScalarSubquery):
+            for outer_c, _ in x.correlated:
+                out.add(outer_c)
+
+    rec(e)
+    return frozenset(out)
+
+
+def substitute(e: Expr, mapping: dict) -> Expr:
+    """Replace Col(name) by mapping[name] expressions."""
+    if isinstance(e, Col):
+        return mapping.get(e.name, e)
+    if isinstance(e, Call):
+        return Call(e.fn, *[substitute(a, mapping) for a in e.args])
+    if isinstance(e, Case):
+        return Case(
+            tuple((substitute(c, mapping), substitute(v, mapping)) for c, v in e.whens),
+            substitute(e.orelse, mapping) if e.orelse is not None else None,
+        )
+    if isinstance(e, Cast):
+        return Cast(substitute(e.arg, mapping), e.to)
+    if isinstance(e, InList):
+        return InList(substitute(e.arg, mapping), e.values, e.negated)
+    if isinstance(e, AggExpr):
+        return AggExpr(
+            e.fn, substitute(e.arg, mapping) if e.arg is not None else None, e.distinct
+        )
+    if isinstance(e, SemiJoinMark):
+        return SemiJoinMark(
+            e.plan, e.correlated,
+            substitute(e.probe_expr, mapping) if e.probe_expr is not None else None,
+            e.inner_col, e.negated,
+        )
+    return e
+
+
+def and_all(conjuncts) -> Expr:
+    conjuncts = list(conjuncts)
+    if not conjuncts:
+        return Lit(True)
+    e = conjuncts[0]
+    for c in conjuncts[1:]:
+        e = Call("and", e, c)
+    return e
+
+
+# --- 1. filter pushdown ------------------------------------------------------
+
+
+def pushdown_filters(plan: LogicalPlan) -> LogicalPlan:
+    return _push(plan, [])
+
+
+def _push(plan: LogicalPlan, preds: list) -> LogicalPlan:
+    """preds: conjuncts from above to place as deep as possible."""
+    if isinstance(plan, LFilter):
+        return _push(plan.child, preds + list(_conjuncts(plan.predicate)))
+
+    if isinstance(plan, LProject):
+        mapping = dict(plan.exprs)
+        inlined, stay = [], []
+        for p in preds:
+            if _has_marker(p) or any(
+                _contains_agg_expr(mapping.get(c, Lit(0))) for c in expr_cols(p)
+            ):
+                stay.append(p)
+            else:
+                inlined.append(substitute(p, mapping))
+        child = _push(plan.child, inlined)
+        out = LProject(child, plan.exprs)
+        return _wrap(out, stay)
+
+    if isinstance(plan, LJoin):
+        lcols = frozenset(plan.left.output_names())
+        rcols = frozenset(plan.right.output_names())
+        lpreds, rpreds, stay, markers = [], [], [], []
+        join_conjuncts = (
+            list(_conjuncts(plan.condition)) if plan.condition is not None else []
+        )
+        pool = preds + (join_conjuncts if plan.kind in ("inner", "cross") else [])
+        for p in pool:
+            cols = expr_cols(p)
+            outer_free = {c for c in cols if c.startswith("@outer.")}
+            cols = cols - outer_free
+            if _has_marker(p):
+                # subquery markers must stay in a Filter for the rewriter;
+                # never fold them into a join condition
+                markers.append(p)
+            elif cols <= lcols and not outer_free:
+                lpreds.append(p)
+            elif cols <= rcols and not outer_free and plan.kind in (
+                "inner", "cross", "semi", "anti"
+            ):
+                # NOT pushable for "left": right-side predicates from above a
+                # left join would wrongly filter NULL-extended rows below it
+                rpreds.append(p)
+            else:
+                stay.append(p)
+        join_cond = plan.condition
+        if plan.kind == "left" and join_cond is not None:
+            # ON conjuncts referencing only the right side pre-filter the
+            # build side (valid: they run before NULL-extension)
+            keep = []
+            for c in _conjuncts(join_cond):
+                cc = expr_cols(c)
+                if cc <= rcols and not _has_marker(c):
+                    rpreds.append(c)
+                else:
+                    keep.append(c)
+            join_cond = and_all(keep) if keep else None
+        left = _push(plan.left, lpreds)
+        right = _push(plan.right, rpreds)
+        if plan.kind in ("inner", "cross"):
+            if not stay:
+                return _wrap(LJoin(left, right, plan.kind, None), markers)
+            return _wrap(LJoin(left, right, "inner", and_all(stay)), markers)
+        out = LJoin(left, right, plan.kind, join_cond)
+        return _wrap(out, stay + markers)
+
+    if isinstance(plan, LAggregate):
+        group_names = {n for n, _ in plan.group_by}
+        mapping = dict(plan.group_by)
+        down, stay = [], []
+        for p in preds:
+            if not _has_marker(p) and expr_cols(p) <= group_names:
+                down.append(substitute(p, mapping))
+            else:
+                stay.append(p)
+        child = _push(plan.child, down)
+        return _wrap(LAggregate(child, plan.group_by, plan.aggs), stay)
+
+    if isinstance(plan, (LSort, LLimit)):
+        # a pure sort is transparent to filters, but a fused TopN (or LIMIT)
+        # is not: filtering before "pick k rows" changes which rows survive
+        if isinstance(plan, LSort) and plan.limit is None:
+            child = _push(plan.child, preds)
+            return LSort(child, plan.keys, None)
+        child = _push(plan.child, [])
+        return _wrap(dataclasses.replace(plan, child=child), preds)
+
+    # leaf (LScan)
+    return _wrap(plan, preds)
+
+
+def _wrap(plan: LogicalPlan, preds: list) -> LogicalPlan:
+    if not preds:
+        return plan
+    return LFilter(plan, and_all(preds))
+
+
+def _has_marker(e: Expr) -> bool:
+    if isinstance(e, (ScalarSubquery, SemiJoinMark)):
+        return True
+    if isinstance(e, Call):
+        return any(_has_marker(a) for a in e.args)
+    if isinstance(e, Case):
+        return any(_has_marker(c) or _has_marker(v) for c, v in e.whens) or (
+            e.orelse is not None and _has_marker(e.orelse)
+        )
+    if isinstance(e, Cast):
+        return _has_marker(e.arg)
+    if isinstance(e, InList):
+        return _has_marker(e.arg)
+    return False
+
+
+def _contains_agg_expr(e: Expr) -> bool:
+    if isinstance(e, AggExpr):
+        return True
+    if isinstance(e, Call):
+        return any(_contains_agg_expr(a) for a in e.args)
+    return False
+
+
+# --- 2. subquery rewrites ----------------------------------------------------
+
+
+def rewrite_subqueries(plan: LogicalPlan, catalog) -> LogicalPlan:
+    if isinstance(plan, LFilter):
+        child = rewrite_subqueries(plan.child, catalog)
+        conjuncts = list(_conjuncts(plan.predicate))
+        plain, markers = [], []
+        for c in conjuncts:
+            (markers if _has_marker(c) else plain).append(c)
+        out = _wrap(child, plain)
+        for m in markers:
+            out = _apply_marker(out, m, catalog)
+        return out
+
+    new_children = tuple(rewrite_subqueries(c, catalog) for c in plan.children)
+    return _replace_children(plan, new_children)
+
+
+def _replace_children(plan, new_children):
+    if isinstance(plan, LFilter):
+        return LFilter(new_children[0], plan.predicate)
+    if isinstance(plan, LProject):
+        return LProject(new_children[0], plan.exprs)
+    if isinstance(plan, LJoin):
+        return LJoin(new_children[0], new_children[1], plan.kind, plan.condition)
+    if isinstance(plan, LAggregate):
+        return LAggregate(new_children[0], plan.group_by, plan.aggs)
+    if isinstance(plan, LSort):
+        return LSort(new_children[0], plan.keys, plan.limit)
+    if isinstance(plan, LLimit):
+        return LLimit(new_children[0], plan.limit, plan.offset)
+    if isinstance(plan, LScan):
+        return plan
+    raise TypeError(type(plan))
+
+
+def _strip_correlation(plan: LogicalPlan, removed: list | None = None) -> LogicalPlan:
+    """Remove filter conjuncts referencing @outer columns.
+
+    When `removed` is given, the stripped conjuncts are appended to it so the
+    caller can re-attach non-equi correlated predicates as join residuals."""
+    if isinstance(plan, LFilter):
+        child = _strip_correlation(plan.child, removed)
+        keep = []
+        for c in _conjuncts(plan.predicate):
+            if any(x.startswith("@outer.") for x in expr_cols(c)):
+                if removed is not None:
+                    removed.append(c)
+            else:
+                keep.append(c)
+        return _wrap(child, keep)
+    return _replace_children(
+        plan, tuple(_strip_correlation(c, removed) for c in plan.children)
+    )
+
+
+def _unouter(e: Expr) -> Expr:
+    """Rewrite Col('@outer.x') -> Col('x') (used once the subquery joins the
+    outer plan, so outer columns are in scope)."""
+    if isinstance(e, Col) and e.name.startswith("@outer."):
+        return Col(e.name[len("@outer."):])
+    if isinstance(e, Call):
+        return Call(e.fn, *[_unouter(a) for a in e.args])
+    if isinstance(e, Cast):
+        return Cast(_unouter(e.arg), e.to)
+    if isinstance(e, Case):
+        return Case(
+            tuple((_unouter(c), _unouter(v)) for c, v in e.whens),
+            _unouter(e.orelse) if e.orelse is not None else None,
+        )
+    if isinstance(e, InList):
+        return InList(_unouter(e.arg), e.values, e.negated)
+    return e
+
+
+def _expose_columns(plan: LogicalPlan, cols) -> LogicalPlan:
+    """Ensure `cols` appear in the plan's output (for semi-join keys that
+    reference columns below the subquery's top projection)."""
+    missing = [c for c in cols if c not in plan.output_names()]
+    if not missing:
+        return plan
+    if isinstance(plan, (LSort, LLimit)):
+        return _replace_children(plan, (_expose_columns(plan.child, cols),))
+    if isinstance(plan, LProject):
+        child_out = plan.child.output_names()
+        if all(c in child_out for c in missing):
+            return LProject(
+                plan.child, plan.exprs + tuple((c, Col(c)) for c in missing)
+            )
+    raise NotImplementedError(
+        f"cannot expose correlated columns {missing} through {plan!r}"
+    )
+
+
+def _apply_marker(outer_plan: LogicalPlan, conjunct: Expr, catalog) -> LogicalPlan:
+    """Turn a marker conjunct into a join against the subquery plan."""
+    # Plain NOT around a marker flips it
+    if (
+        isinstance(conjunct, Call)
+        and conjunct.fn == "not"
+        and isinstance(conjunct.args[0], SemiJoinMark)
+    ):
+        m = conjunct.args[0]
+        conjunct = SemiJoinMark(
+            m.plan, m.correlated, m.probe_expr, m.inner_col, not m.negated
+        )
+    # Case A: bare SemiJoinMark (EXISTS / IN subquery)
+    if isinstance(conjunct, SemiJoinMark):
+        m = conjunct
+        removed: list = []
+        sub = _strip_correlation(m.plan, removed)
+        sub = rewrite_subqueries(sub, catalog)
+        # equality pairs become join keys; other correlated conjuncts
+        # (e.g. TPC-H Q21's l2.l_suppkey <> l1.l_suppkey) become residual
+        # predicates on the semi/anti join
+        corr_set = {
+            (oc, ic) for oc, ic in m.correlated
+        }
+        residuals = []
+        inner_names = [ic for _, ic in m.correlated]
+        for c in removed:
+            if (
+                isinstance(c, Call) and c.fn == "eq" and len(c.args) == 2
+                and isinstance(c.args[0], Col) and isinstance(c.args[1], Col)
+                and (
+                    (c.args[0].name[len("@outer."):], c.args[1].name) in corr_set
+                    or (c.args[1].name[len("@outer."):], c.args[0].name) in corr_set
+                )
+            ):
+                continue  # this is one of the extracted equi pairs
+            resid = _unouter(c)
+            residuals.append(resid)
+            outer_out = frozenset(outer_plan.output_names())
+            inner_names.extend(
+                n for n in expr_cols(resid) if n not in outer_out
+            )
+        if m.inner_col is not None:
+            inner_names.append(m.inner_col)
+        sub = _expose_columns(sub, inner_names)
+        outer_keys = [Col(oc) for oc, _ in m.correlated]
+        inner_keys = [Col(ic) for _, ic in m.correlated]
+        if m.probe_expr is not None:
+            outer_keys.append(m.probe_expr)
+            inner_keys.append(Col(m.inner_col))
+        if not outer_keys:
+            raise NotImplementedError("uncorrelated EXISTS not supported yet")
+        cond = and_all(
+            [Call("eq", ok, ik) for ok, ik in zip(outer_keys, inner_keys)]
+            + residuals
+        )
+        return LJoin(outer_plan, sub, "anti" if m.negated else "semi", cond)
+
+    # Case B: comparison containing a correlated ScalarSubquery:
+    #   expr CMP (select agg(...) from ... where inner = @outer.col ...)
+    marker = _find_scalar_marker(conjunct)
+    if marker is None:
+        raise NotImplementedError(f"unsupported subquery pattern: {conjunct!r}")
+    if not marker.correlated:
+        # uncorrelated scalar: leave in place; the executor evaluates it first
+        return LFilter(outer_plan, conjunct)
+
+    sub = _strip_correlation(marker.plan)
+    sub = rewrite_subqueries(sub, catalog)
+    # locate the aggregate inside (LProject over LAggregate with no group keys)
+    if not (
+        isinstance(sub, LProject)
+        and isinstance(sub.child, LAggregate)
+        and not sub.child.group_by
+        and len(sub.exprs) == 1
+    ):
+        raise NotImplementedError(
+            "correlated scalar subquery must be a single aggregate"
+        )
+    agg = sub.child
+    inner_cols = tuple(ic for _, ic in marker.correlated)
+    outer_cols = tuple(oc for oc, _ in marker.correlated)
+    group_by = tuple((f"corr_{i}", Col(ic)) for i, ic in enumerate(inner_cols))
+    grouped = LAggregate(agg.child, group_by, agg.aggs)
+    val_name = "subq_val"
+    proj = LProject(
+        grouped,
+        tuple((f"corr_{i}", Col(f"corr_{i}")) for i in range(len(inner_cols)))
+        + ((val_name, sub.exprs[0][1]),),
+    )
+    cond = and_all(
+        Call("eq", Col(oc), Col(f"corr_{i}")) for i, oc in enumerate(outer_cols)
+    )
+    joined = LJoin(outer_plan, proj, "left", cond)
+    new_pred = _replace_scalar_marker(conjunct, marker, Col(val_name))
+    filtered = LFilter(joined, new_pred)
+    # drop the helper columns again
+    keep = tuple((n, Col(n)) for n in outer_plan.output_names())
+    return LProject(filtered, keep)
+
+
+def _find_scalar_marker(e: Expr):
+    if isinstance(e, ScalarSubquery):
+        return e
+    if isinstance(e, Call):
+        for a in e.args:
+            m = _find_scalar_marker(a)
+            if m is not None:
+                return m
+    if isinstance(e, Cast):
+        return _find_scalar_marker(e.arg)
+    return None
+
+
+def _replace_scalar_marker(e: Expr, marker, replacement: Expr) -> Expr:
+    if e is marker:
+        return replacement
+    if isinstance(e, Call):
+        return Call(e.fn, *[_replace_scalar_marker(a, marker, replacement) for a in e.args])
+    if isinstance(e, Cast):
+        return Cast(_replace_scalar_marker(e.arg, marker, replacement), e.to)
+    return e
+
+
+# --- 3. join reordering ------------------------------------------------------
+
+
+def estimate_rows(plan: LogicalPlan, catalog) -> float:
+    if isinstance(plan, LScan):
+        t = catalog.get_table(plan.table)
+        return float(t.row_count if t is not None else 1000)
+    if isinstance(plan, LFilter):
+        return 0.25 * estimate_rows(plan.child, catalog)
+    if isinstance(plan, LProject):
+        return estimate_rows(plan.child, catalog)
+    if isinstance(plan, LAggregate):
+        return max(1.0, estimate_rows(plan.child, catalog) / 10.0)
+    if isinstance(plan, LJoin):
+        l = estimate_rows(plan.left, catalog)
+        r = estimate_rows(plan.right, catalog)
+        if plan.kind in ("semi", "anti"):
+            return l * 0.5
+        return max(l, r)
+    if isinstance(plan, (LSort, LLimit)):
+        return estimate_rows(plan.child, catalog)
+    return 1000.0
+
+
+def reorder_joins(plan: LogicalPlan, catalog) -> LogicalPlan:
+    if isinstance(plan, LJoin) and plan.kind in ("inner", "cross"):
+        rels, conjuncts = [], []
+        _flatten_join_region(plan, rels, conjuncts)
+        rels = [reorder_joins(r, catalog) for r in rels]
+        if len(rels) > 1:
+            return _greedy_order(rels, conjuncts, catalog)
+    new_children = tuple(reorder_joins(c, catalog) for c in plan.children)
+    return _replace_children(plan, new_children)
+
+
+def _flatten_join_region(plan, rels, conjuncts):
+    if isinstance(plan, LJoin) and plan.kind in ("inner", "cross"):
+        _flatten_join_region(plan.left, rels, conjuncts)
+        _flatten_join_region(plan.right, rels, conjuncts)
+        if plan.condition is not None:
+            conjuncts.extend(_conjuncts(plan.condition))
+    else:
+        rels.append(plan)
+
+
+def _greedy_order(rels, conjuncts, catalog) -> LogicalPlan:
+    sizes = [estimate_rows(r, catalog) for r in rels]
+    colsets = [frozenset(r.output_names()) for r in rels]
+    remaining = set(range(len(rels)))
+    # seed: the largest relation (fact table) is the probe root
+    cur = max(remaining, key=lambda i: sizes[i])
+    remaining.discard(cur)
+    plan = rels[cur]
+    plan_cols = set(colsets[cur])
+    pending = list(conjuncts)
+
+    while remaining:
+        # candidates connected by an equality conjunct
+        def connects(i):
+            for c in pending:
+                if (
+                    isinstance(c, Call)
+                    and c.fn == "eq"
+                    and expr_cols(c) <= (plan_cols | colsets[i])
+                    and expr_cols(c) & plan_cols
+                    and expr_cols(c) & colsets[i]
+                ):
+                    return True
+            return False
+
+        cands = [i for i in remaining if connects(i)]
+        if cands:
+            nxt = min(cands, key=lambda i: sizes[i])
+        else:
+            nxt = min(remaining, key=lambda i: sizes[i])
+        remaining.discard(nxt)
+        new_cols = plan_cols | colsets[nxt]
+        ready = [c for c in pending if expr_cols(c) <= new_cols]
+        pending = [c for c in pending if not (expr_cols(c) <= new_cols)]
+        plan = LJoin(plan, rels[nxt], "inner" if ready else "cross",
+                     and_all(ready) if ready else None)
+        plan_cols = new_cols
+    if pending:
+        plan = LFilter(plan, and_all(pending))
+    return plan
+
+
+# --- 5. column pruning -------------------------------------------------------
+
+
+def prune_columns(plan: LogicalPlan, required: frozenset | None = None) -> LogicalPlan:
+    if required is None:
+        required = frozenset(plan.output_names())
+
+    if isinstance(plan, LScan):
+        keep = tuple(
+            c for c in plan.columns if f"{plan.alias}.{c}" in required
+        )
+        if not keep:
+            keep = plan.columns[:1]  # keep at least one column for row count
+        return LScan(plan.table, plan.alias, keep)
+
+    if isinstance(plan, LFilter):
+        need = required | expr_cols(plan.predicate)
+        need = frozenset(n for n in need if not n.startswith("@outer."))
+        return LFilter(prune_columns(plan.child, need), plan.predicate)
+
+    if isinstance(plan, LProject):
+        kept = tuple((n, e) for n, e in plan.exprs if n in required)
+        if not kept:
+            kept = plan.exprs[:1]
+        need = frozenset().union(*[expr_cols(e) for _, e in kept]) if kept else frozenset()
+        need = frozenset(n for n in need if not n.startswith("@outer."))
+        return LProject(prune_columns(plan.child, need), kept)
+
+    if isinstance(plan, LJoin):
+        need = set(required)
+        if plan.condition is not None:
+            need |= expr_cols(plan.condition)
+        need = {n for n in need if not n.startswith("@outer.")}
+        lcols = frozenset(plan.left.output_names())
+        rcols = frozenset(plan.right.output_names())
+        left = prune_columns(plan.left, frozenset(need) & lcols)
+        right = prune_columns(plan.right, frozenset(need) & rcols)
+        return LJoin(left, right, plan.kind, plan.condition)
+
+    if isinstance(plan, LAggregate):
+        kept_groups = plan.group_by
+        kept_aggs = tuple((n, a) for n, a in plan.aggs if n in required)
+        if not kept_aggs and plan.aggs:
+            kept_aggs = plan.aggs[:1]
+        need = set()
+        for _, g in kept_groups:
+            need |= expr_cols(g)
+        for _, a in kept_aggs:
+            if a.arg is not None:
+                need |= expr_cols(a.arg)
+        if not need:
+            # count(*) etc: keep one child column
+            need = set(plan.child.output_names()[:1])
+        return LAggregate(
+            prune_columns(plan.child, frozenset(need)), kept_groups, kept_aggs
+        )
+
+    if isinstance(plan, LSort):
+        need = set(required)
+        for e, _, _ in plan.keys:
+            need |= expr_cols(e)
+        return LSort(prune_columns(plan.child, frozenset(need)), plan.keys, plan.limit)
+
+    if isinstance(plan, LLimit):
+        return LLimit(prune_columns(plan.child, required), plan.limit, plan.offset)
+
+    raise TypeError(type(plan))

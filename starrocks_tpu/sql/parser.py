@@ -1,0 +1,624 @@
+"""Recursive-descent SQL parser.
+
+Reference behavior: fe SqlParser (fe-core/.../sql/parser/SqlParser.java:70,
+grammar fe/fe-grammar/StarRocks.g4). Produces ast.py statements with exprs.ir
+scalar expressions (unresolved RawCol/RawFunc forms).
+"""
+
+from __future__ import annotations
+
+from ..exprs.ir import AggExpr, Call, Case, Cast, Expr, InList, Lit
+from .. import types as T
+from . import ast
+from .lexer import Token, tokenize
+
+
+class ParseError(ValueError):
+    pass
+
+
+AGG_FUNCS = {"sum", "count", "avg", "min", "max"}
+
+# scalar function name -> registry name (None = same)
+SCALAR_FUNCS = {
+    "year": "year", "month": "month", "day": "day",
+    "substr": "substr", "substring": "substr",
+    "upper": "upper", "lower": "lower", "abs": "abs",
+    "coalesce": "coalesce", "if": "if", "mod": "mod",
+    "starts_with": "starts_with", "concat": "concat",
+    "date_add_days": "date_add_days", "date_add_months": "date_add_months",
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # --- token helpers -------------------------------------------------------
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *words) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.value in words
+
+    def at_op(self, *ops) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def accept_kw(self, *words) -> bool:
+        if self.at_kw(*words):
+            self.next()
+            return True
+        return False
+
+    def accept_op(self, *ops) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, word):
+        if not self.accept_kw(word):
+            raise ParseError(f"expected {word.upper()} at {self.peek().value!r} (pos {self.peek().pos})")
+
+    def expect_op(self, op):
+        if not self.accept_op(op):
+            raise ParseError(f"expected {op!r} at {self.peek().value!r} (pos {self.peek().pos})")
+
+    def expect_ident(self) -> str:
+        t = self.peek()
+        # permit non-reserved keywords as identifiers where unambiguous
+        if t.kind in ("ident",) or (t.kind == "kw" and t.value in ("year", "month", "day", "date", "first", "last")):
+            self.next()
+            return t.value
+        raise ParseError(f"expected identifier at {t.value!r} (pos {t.pos})")
+
+    # --- entry ---------------------------------------------------------------
+    def parse_statement(self):
+        if self.at_kw("explain"):
+            self.next()
+            analyze = self.accept_kw("analyze")
+            return ast.Explain(self.parse_statement(), analyze)
+        if self.at_kw("select", "with"):
+            s = self.parse_select()
+            self.accept_op(";")
+            return s
+        if self.at_kw("create"):
+            return self.parse_create()
+        if self.at_kw("insert"):
+            return self.parse_insert()
+        if self.at_kw("drop"):
+            return self.parse_drop()
+        raise ParseError(f"unsupported statement start {self.peek().value!r}")
+
+    # --- SELECT --------------------------------------------------------------
+    def parse_select(self) -> ast.Select:
+        ctes = ()
+        if self.accept_kw("with"):
+            items = []
+            while True:
+                name = self.expect_ident()
+                self.expect_kw("as") if self.at_kw("as") else None
+                self.expect_op("(")
+                sub = self.parse_select()
+                self.expect_op(")")
+                items.append((name, sub))
+                if not self.accept_op(","):
+                    break
+            ctes = tuple(items)
+        self.expect_kw("select")
+        distinct = self.accept_kw("distinct")
+        self.accept_kw("all")
+        items = [self.parse_select_item()]
+        while self.accept_op(","):
+            items.append(self.parse_select_item())
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self.parse_table_refs()
+        where = None
+        if self.accept_kw("where"):
+            where = self.parse_expr()
+        group_by = ()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            g = [self.parse_expr()]
+            while self.accept_op(","):
+                g.append(self.parse_expr())
+            group_by = tuple(g)
+        having = None
+        if self.accept_kw("having"):
+            having = self.parse_expr()
+        order_by = ()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            o = [self.parse_order_item()]
+            while self.accept_op(","):
+                o.append(self.parse_order_item())
+            order_by = tuple(o)
+        limit = None
+        offset = 0
+        if self.accept_kw("limit"):
+            limit = int(self.next().value)
+            if self.accept_op(","):
+                offset = limit
+                limit = int(self.next().value)
+            elif self.accept_kw("offset"):
+                offset = int(self.next().value)
+        return ast.Select(
+            tuple(items), from_, where, group_by, having, tuple(order_by),
+            limit, offset, distinct, ctes,
+        )
+
+    def parse_select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectItem(ast.Star())
+        # qualified star: ident.*
+        if (
+            self.peek().kind == "ident"
+            and self.peek(1).kind == "op"
+            and self.peek(1).value == "."
+            and self.peek(2).kind == "op"
+            and self.peek(2).value == "*"
+        ):
+            t = self.next().value
+            self.next()
+            self.next()
+            return ast.SelectItem(ast.Star(t))
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return ast.SelectItem(e, alias)
+
+    def parse_order_item(self) -> ast.OrderItem:
+        e = self.parse_expr()
+        asc = True
+        if self.accept_kw("desc"):
+            asc = False
+        else:
+            self.accept_kw("asc")
+        nulls_first = None
+        if self.accept_kw("nulls"):
+            if self.accept_kw("first"):
+                nulls_first = True
+            else:
+                self.expect_kw("last")
+                nulls_first = False
+        return ast.OrderItem(e, asc, nulls_first)
+
+    # --- FROM ----------------------------------------------------------------
+    def parse_table_refs(self):
+        left = self.parse_table_primary()
+        while True:
+            if self.accept_op(","):
+                right = self.parse_table_primary()
+                left = ast.JoinRef(left, right, "cross", None)
+                continue
+            kind = None
+            if self.accept_kw("inner"):
+                kind = "inner"
+                self.expect_kw("join")
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                kind = "left"
+                self.expect_kw("join")
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                kind = "right"
+                self.expect_kw("join")
+            elif self.accept_kw("cross"):
+                kind = "cross"
+                self.expect_kw("join")
+            elif self.accept_kw("join"):
+                kind = "inner"
+            if kind is None:
+                return left
+            right = self.parse_table_primary()
+            on = None
+            if kind != "cross":
+                self.expect_kw("on")
+                on = self.parse_expr()
+            left = ast.JoinRef(left, right, kind, on)
+
+    def parse_table_primary(self):
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                self.accept_kw("as")
+                alias = self.expect_ident()
+                return ast.SubqueryRef(sub, alias)
+            refs = self.parse_table_refs()
+            self.expect_op(")")
+            return refs
+        name = self.expect_ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return ast.TableRef(name, alias)
+
+    # --- expressions (precedence climbing) ------------------------------------
+    def parse_expr(self) -> Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> Expr:
+        e = self.parse_and()
+        while self.accept_kw("or"):
+            e = Call("or", e, self.parse_and())
+        return e
+
+    def parse_and(self) -> Expr:
+        e = self.parse_not()
+        while self.accept_kw("and"):
+            e = Call("and", e, self.parse_not())
+        return e
+
+    def parse_not(self) -> Expr:
+        if self.accept_kw("not"):
+            return Call("not", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> Expr:
+        e = self.parse_additive()
+        while True:
+            if self.at_op("=", "<>", "<", "<=", ">", ">="):
+                op = self.next().value
+                rhs = self.parse_additive()
+                name = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le",
+                        ">": "gt", ">=": "ge"}[op]
+                # ANY/ALL-less subquery comparison: = (select ...)
+                e = Call(name, e, rhs)
+                continue
+            negated = False
+            save = self.i
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("between"):
+                lo = self.parse_additive()
+                self.expect_kw("and")
+                hi = self.parse_additive()
+                between = Call("and", Call("ge", e, lo), Call("le", e, hi))
+                e = Call("not", between) if negated else between
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    sub = self.parse_select()
+                    self.expect_op(")")
+                    e = ast.InSubquery(e, sub, negated)
+                else:
+                    vals = [self.parse_literal_value()]
+                    while self.accept_op(","):
+                        vals.append(self.parse_literal_value())
+                    self.expect_op(")")
+                    e = InList(e, tuple(vals), negated)
+                continue
+            if self.accept_kw("like"):
+                pat = self.parse_additive()
+                e = Call("not_like" if negated else "like", e, pat)
+                continue
+            if negated:
+                self.i = save
+            if self.accept_kw("is"):
+                neg = self.accept_kw("not")
+                self.expect_kw("null")
+                e = Call("is_not_null" if neg else "is_null", e)
+                continue
+            return e
+
+    def parse_literal_value(self):
+        """Value inside an IN list (python scalar)."""
+        t = self.peek()
+        if t.kind == "string":
+            self.next()
+            return t.value
+        if t.kind == "number":
+            self.next()
+            return float(t.value) if "." in t.value or "e" in t.value.lower() else int(t.value)
+        if t.kind == "kw" and t.value == "null":
+            self.next()
+            return None
+        if t.kind == "op" and t.value == "-":
+            self.next()
+            v = self.parse_literal_value()
+            return -v
+        raise ParseError(f"expected literal in IN list at {t.value!r}")
+
+    def parse_additive(self) -> Expr:
+        e = self.parse_multiplicative()
+        while True:
+            if self.accept_op("+"):
+                rhs = self.parse_multiplicative()
+                e = self._plus_minus(e, rhs, "add")
+            elif self.accept_op("-"):
+                rhs = self.parse_multiplicative()
+                e = self._plus_minus(e, rhs, "subtract")
+            else:
+                return e
+
+    @staticmethod
+    def _plus_minus(lhs, rhs, op):
+        # date +/- INTERVAL folds into date_add_days/months
+        if isinstance(rhs, Call) and rhs.fn == "__interval__":
+            n, unit = rhs.args[0].value, rhs.args[1].value
+            sign = 1 if op == "add" else -1
+            if unit == "day":
+                return Call("date_add_days", lhs, Lit(sign * n))
+            if unit == "month":
+                return Call("date_add_months", lhs, Lit(sign * n))
+            if unit == "year":
+                return Call("date_add_months", lhs, Lit(sign * 12 * n))
+            raise ParseError(f"unsupported interval unit {unit}")
+        return Call(op, lhs, rhs)
+
+    def parse_multiplicative(self) -> Expr:
+        e = self.parse_unary()
+        while True:
+            if self.accept_op("*"):
+                e = Call("multiply", e, self.parse_unary())
+            elif self.accept_op("/"):
+                e = Call("divide", e, self.parse_unary())
+            elif self.accept_op("%"):
+                e = Call("mod", e, self.parse_unary())
+            else:
+                return e
+
+    def parse_unary(self) -> Expr:
+        if self.accept_op("-"):
+            e = self.parse_unary()
+            if isinstance(e, Lit) and isinstance(e.value, (int, float)):
+                return Lit(-e.value, e.type)
+            return Call("negate", e)
+        if self.accept_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            v = float(t.value) if "." in t.value or "e" in t.value.lower() else int(t.value)
+            return Lit(v)
+        if t.kind == "string":
+            self.next()
+            return Lit(t.value)
+        if t.kind == "kw":
+            if t.value == "null":
+                self.next()
+                return Lit(None)
+            if t.value in ("true", "false"):
+                self.next()
+                return Lit(t.value == "true")
+            if t.value == "date":
+                self.next()
+                s = self.next()
+                if s.kind != "string":
+                    raise ParseError("DATE literal expects a string")
+                return Lit(s.value, T.DATE)
+            if t.value == "interval":
+                self.next()
+                v = self.next()
+                n = int(v.value)
+                unit_t = self.next()
+                unit = unit_t.value.rstrip("s") if unit_t.value else ""
+                return Call("__interval__", Lit(n), Lit(unit))
+            if t.value == "case":
+                return self.parse_case()
+            if t.value == "cast":
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                self.expect_kw("as")
+                to = self.parse_type_name()
+                self.expect_op(")")
+                return Cast(e, to)
+            if t.value == "extract":
+                self.next()
+                self.expect_op("(")
+                unit = self.next().value
+                self.expect_kw("from") if self.at_kw("from") else self.expect_ident()
+                e = self.parse_expr()
+                self.expect_op(")")
+                if unit not in ("year", "month", "day"):
+                    raise ParseError(f"EXTRACT({unit}) unsupported")
+                return Call(unit, e)
+            if t.value == "exists":
+                self.next()
+                self.expect_op("(")
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ast.Exists(sub)
+            if t.value in ("year", "month", "day", "if", "substring"):
+                # function-style keywords
+                if self.peek(1).kind == "op" and self.peek(1).value == "(":
+                    return self.parse_func_call(self.next().value)
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            if self.at_kw("select", "with"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ast.Subquery(sub)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "ident":
+            # func call / qualified col / bare col
+            if self.peek(1).kind == "op" and self.peek(1).value == "(":
+                return self.parse_func_call(self.next().value)
+            name = self.next().value
+            if self.accept_op("."):
+                col2 = self.expect_ident()
+                return ast.RawCol(name, col2)
+            return ast.RawCol(None, name)
+        raise ParseError(f"unexpected token {t.value!r} (pos {t.pos})")
+
+    def parse_func_call(self, name: str) -> Expr:
+        name = name.lower()
+        self.expect_op("(")
+        distinct = self.accept_kw("distinct")
+        args = []
+        if self.at_op("*"):
+            self.next()
+            args = [ast.Star()]
+        elif not self.at_op(")"):
+            args.append(self.parse_expr())
+            while self.accept_op(","):
+                args.append(self.parse_expr())
+        self.expect_op(")")
+        if name in AGG_FUNCS:
+            if name == "count" and args and isinstance(args[0], ast.Star):
+                return AggExpr("count", None, distinct)
+            return AggExpr(name, args[0] if args else None, distinct)
+        reg = SCALAR_FUNCS.get(name)
+        if reg is not None:
+            return Call(reg, *args)
+        return ast.RawFunc(name, tuple(args), distinct)
+
+    def parse_case(self) -> Expr:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.parse_expr()
+        whens = []
+        while self.accept_kw("when"):
+            c = self.parse_expr()
+            self.expect_kw("then")
+            v = self.parse_expr()
+            if operand is not None:
+                c = Call("eq", operand, c)
+            whens.append((c, v))
+        orelse = None
+        if self.accept_kw("else"):
+            orelse = self.parse_expr()
+        self.expect_kw("end")
+        return Case(tuple(whens), orelse)
+
+    def parse_type_name(self) -> T.LogicalType:
+        name = self.next().value.lower()
+        if name in ("int", "integer"):
+            return T.INT
+        if name == "bigint":
+            return T.BIGINT
+        if name in ("smallint",):
+            return T.SMALLINT
+        if name in ("tinyint",):
+            return T.TINYINT
+        if name in ("float",):
+            return T.FLOAT
+        if name in ("double",):
+            return T.DOUBLE
+        if name in ("boolean", "bool"):
+            return T.BOOLEAN
+        if name in ("date",):
+            return T.DATE
+        if name in ("datetime", "timestamp"):
+            return T.DATETIME
+        if name in ("varchar", "char", "string", "text"):
+            if self.accept_op("("):
+                self.next()
+                self.expect_op(")")
+            return T.VARCHAR
+        if name in ("decimal", "numeric"):
+            p, s = 18, 0
+            if self.accept_op("("):
+                p = int(self.next().value)
+                if self.accept_op(","):
+                    s = int(self.next().value)
+                self.expect_op(")")
+            return T.DECIMAL(p, s)
+        raise ParseError(f"unknown type {name!r}")
+
+    # --- DDL / DML -----------------------------------------------------------
+    def parse_create(self):
+        self.expect_kw("create")
+        self.expect_kw("table")
+        name = self.expect_ident()
+        self.expect_op("(")
+        cols = []
+        while True:
+            cname = self.expect_ident()
+            t = self.parse_type_name()
+            nullable = True
+            if self.accept_kw("not"):
+                self.expect_kw("null")
+                nullable = False
+            else:
+                self.accept_kw("null")
+            cols.append(ast.ColumnDef(cname, t, nullable))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        dist = ()
+        buckets = 0
+        if self.accept_kw("distributed"):
+            self.expect_kw("by")
+            self.expect_kw("hash")
+            self.expect_op("(")
+            d = [self.expect_ident()]
+            while self.accept_op(","):
+                d.append(self.expect_ident())
+            self.expect_op(")")
+            dist = tuple(d)
+            if self.accept_kw("buckets"):
+                buckets = int(self.next().value)
+        self.accept_op(";")
+        return ast.CreateTable(name, tuple(cols), dist, buckets)
+
+    def parse_insert(self):
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        name = self.expect_ident()
+        cols = ()
+        if self.accept_op("("):
+            c = [self.expect_ident()]
+            while self.accept_op(","):
+                c.append(self.expect_ident())
+            self.expect_op(")")
+            cols = tuple(c)
+        if self.accept_kw("values"):
+            rows = []
+            while True:
+                self.expect_op("(")
+                row = [self.parse_expr()]
+                while self.accept_op(","):
+                    row.append(self.parse_expr())
+                self.expect_op(")")
+                rows.append(tuple(row))
+                if not self.accept_op(","):
+                    break
+            self.accept_op(";")
+            return ast.Insert(name, cols, None, tuple(rows))
+        sel = self.parse_select()
+        return ast.Insert(name, cols, sel, ())
+
+    def parse_drop(self):
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        if_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        name = self.expect_ident()
+        self.accept_op(";")
+        return ast.DropTable(name, if_exists)
+
+
+def parse(sql: str):
+    p = Parser(sql)
+    stmt = p.parse_statement()
+    t = p.peek()
+    if t.kind != "eof":
+        raise ParseError(f"unexpected trailing input at {t.value!r} (pos {t.pos})")
+    return stmt

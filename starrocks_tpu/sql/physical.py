@@ -1,0 +1,326 @@
+"""Physical planning + compilation to a jittable chunk program.
+
+Reference behavior: fe sql/plan/PlanFragmentBuilder.java:268 (physical plan ->
+fragments) + BE pipeline building (exec/runtime/pipeline_builder_context.h:106).
+The TPU analog: the whole (single-chip) physical plan compiles into ONE jit
+program Chunk inputs -> result Chunk; operator capacities (group counts, join
+expansion sizes) are static knobs with true-count "checks" returned so the
+host executor can recompile on overflow — the compiled replacement for the
+reference's runtime adaptivity (SURVEY §2.4 item 7).
+
+Planning decisions made here:
+- join implementation: unique-build gather join when the build side is
+  provably unique on the join keys (catalog unique_keys + plan derivation),
+  else run-length expansion join;
+- multi-key packing bit widths from catalog column stats via provenance;
+- residual (non-equi) join predicates applied as post-join filters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..exprs.ir import AggExpr, Call, Col, Expr, Lit
+from ..ops import (
+    INNER, LEFT_ANTI, LEFT_OUTER, LEFT_SEMI,
+    filter_chunk, hash_aggregate, hash_join_expand, hash_join_unique,
+    limit_chunk, project, sort_chunk,
+)
+from ..column.column import pad_capacity
+from .analyzer import _conjuncts
+from .logical import (
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LogicalPlan,
+)
+from .optimizer import and_all, expr_cols
+
+
+class PlanError(ValueError):
+    pass
+
+
+# --- plan properties ---------------------------------------------------------
+
+
+def unique_sets(plan: LogicalPlan, catalog) -> set:
+    """Column-name sets that are unique per output row."""
+    if isinstance(plan, LScan):
+        t = catalog.get_table(plan.table)
+        out = set()
+        if t is not None:
+            for keys in t.unique_keys:
+                qk = tuple(f"{plan.alias}.{k}" for k in keys)
+                if all(k in plan.output_names() for k in qk):
+                    out.add(frozenset(qk))
+        return out
+    if isinstance(plan, LFilter):
+        return unique_sets(plan.child, catalog)
+    if isinstance(plan, (LSort, LLimit)):
+        return unique_sets(plan.child, catalog)
+    if isinstance(plan, LProject):
+        child = unique_sets(plan.child, catalog)
+        passthrough = {
+            e.name: n for n, e in plan.exprs if isinstance(e, Col)
+        }
+        out = set()
+        for s in child:
+            if all(c in passthrough for c in s):
+                out.add(frozenset(passthrough[c] for c in s))
+        return out
+    if isinstance(plan, LAggregate):
+        if plan.group_by:
+            return {frozenset(n for n, _ in plan.group_by)}
+        return set()
+    if isinstance(plan, LJoin):
+        if plan.kind in ("semi", "anti"):
+            return unique_sets(plan.left, catalog)
+        return set()
+    return set()
+
+
+def col_origin(plan: LogicalPlan, name: str):
+    """Trace a column to its base (table, column) if it's a pure passthrough."""
+    if isinstance(plan, LScan):
+        alias, base = name.split(".", 1)
+        if alias == plan.alias and base in plan.columns:
+            return plan.table, base
+        return None
+    if isinstance(plan, (LFilter, LSort, LLimit)):
+        return col_origin(plan.child, name)
+    if isinstance(plan, LProject):
+        for n, e in plan.exprs:
+            if n == name and isinstance(e, Col):
+                return col_origin(plan.child, e.name)
+        return None
+    if isinstance(plan, LAggregate):
+        for n, e in plan.group_by:
+            if n == name and isinstance(e, Col):
+                return col_origin(plan.child, e.name)
+        return None
+    if isinstance(plan, LJoin):
+        if name in plan.left.output_names():
+            return col_origin(plan.left, name)
+        if plan.kind not in ("semi", "anti") and name in plan.right.output_names():
+            return col_origin(plan.right, name)
+        return None
+    return None
+
+
+def _key_bit_width(plan, key: Expr, catalog) -> Optional[int]:
+    if not isinstance(key, Col):
+        return None
+    origin = col_origin(plan, key.name)
+    if origin is None:
+        return None
+    t = catalog.get_table(origin[0])
+    if t is None:
+        return None
+    st = t.column_stats(origin[1])
+    if st.max is None or (st.min is not None and st.min < 0):
+        return None
+    return max(int(st.max).bit_length() + 1, 2)
+
+
+# --- compilation -------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Caps:
+    """Mutable capacity knobs, filled with defaults during compile; the
+    executor bumps entries after overflow checks and recompiles."""
+
+    values: dict
+
+    def get(self, key: str, default: int) -> int:
+        return self.values.setdefault(key, default)
+
+
+class Compiled:
+    def __init__(self, fn, scans, checks_meta, out_names):
+        self.fn = fn  # (chunks tuple) -> (chunk, checks tuple)
+        self.scans = scans  # list[(table, alias, columns)]
+        self.checks_meta = checks_meta  # list[(cap_key,)] parallel to checks
+        self.out_names = out_names
+
+
+def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
+    scans: list = []
+    checks_meta: list = []
+
+    scan_index: dict = {}
+
+    def collect_scans(p):
+        if isinstance(p, LScan):
+            # keyed by node identity: the same table+alias may be scanned by
+            # independent plan nodes (outer query vs subquery) with different
+            # column sets
+            scan_index[id(p)] = len(scans)
+            scans.append((p.table, p.alias, p.columns))
+        for c in p.children:
+            collect_scans(c)
+
+    collect_scans(plan)
+
+    def emit(p: LogicalPlan, inputs):
+        """Returns (chunk, checks list) — called at trace time."""
+        if isinstance(p, LScan):
+            return inputs[scan_index[id(p)]], []
+        if isinstance(p, LFilter):
+            c, ch = emit(p.child, inputs)
+            return filter_chunk(c, p.predicate), ch
+        if isinstance(p, LProject):
+            c, ch = emit(p.child, inputs)
+            return project(c, [e for _, e in p.exprs], [n for n, _ in p.exprs]), ch
+        if isinstance(p, LSort):
+            c, ch = emit(p.child, inputs)
+            return sort_chunk(c, p.keys, p.limit), ch
+        if isinstance(p, LLimit):
+            c, ch = emit(p.child, inputs)
+            return limit_chunk(c, p.limit, p.offset), ch
+        if isinstance(p, LAggregate):
+            c, ch = emit(p.child, inputs)
+            key = f"agg_{id(p)}"
+            cap = caps.get(key, 1024)
+            out, ng = hash_aggregate(c, p.group_by, p.aggs, cap)
+            checks_meta.append(key)
+            return out, ch + [ng]
+        if isinstance(p, LJoin):
+            return emit_join(p, inputs)
+        raise PlanError(f"cannot compile {type(p).__name__}")
+
+    def emit_join(p: LJoin, inputs):
+        lc, lch = emit(p.left, inputs)
+        rc, rch = emit(p.right, inputs)
+        checks = lch + rch
+        lcols = frozenset(p.left.output_names())
+        rcols = frozenset(p.right.output_names())
+
+        probe_keys, build_keys, residual = [], [], []
+        for conj in (_conjuncts(p.condition) if p.condition is not None else []):
+            pair = _equi_pair(conj, lcols, rcols)
+            if pair is not None:
+                probe_keys.append(pair[0])
+                build_keys.append(pair[1])
+            else:
+                residual.append(conj)
+
+        kind = {
+            "inner": INNER, "left": LEFT_OUTER, "semi": LEFT_SEMI,
+            "anti": LEFT_ANTI, "cross": INNER,
+        }[p.kind]
+
+        if not probe_keys:
+            # cross join: constant key matches everything
+            probe_keys = [Lit(0)]
+            build_keys = [Lit(0)]
+            bit_widths = (2,)
+            unique = False
+        else:
+            bit_widths = None
+            if len(probe_keys) > 1:
+                widths = []
+                for pk, bk in zip(probe_keys, build_keys):
+                    w1 = _key_bit_width(p.left, pk, catalog)
+                    w2 = _key_bit_width(p.right, bk, catalog)
+                    if w1 is None or w2 is None:
+                        widths = None
+                        break
+                    widths.append(max(w1, w2))
+                if widths is None or sum(widths) > 63:
+                    raise PlanError(
+                        "multi-key join without packable stats unsupported"
+                    )
+                bit_widths = tuple(widths)
+            build_key_names = frozenset(
+                k.name for k in build_keys if isinstance(k, Col)
+            )
+            unique = len(build_key_names) == len(build_keys) and any(
+                s <= build_key_names for s in unique_sets(p.right, catalog)
+            )
+
+        payload = (
+            [] if p.kind in ("semi", "anti") else list(p.right.output_names())
+        )
+
+        if residual and p.kind in ("semi", "anti"):
+            # Residual-capable (anti)semi join: tag probe rows with a rowid,
+            # inner-expand on the equi keys, filter by the residual, derive
+            # the set of matched rowids, then (anti)semi-join on rowid.
+            # (TPC-H Q21's correlated <> predicates take this path.)
+            import jax.numpy as jnp
+
+            from ..column.column import Field
+            from .. import types as T
+
+            rid = f"__rowid_{id(p)}"
+            rowid = jnp.arange(lc.capacity, dtype=jnp.int64)
+            lc2 = lc.with_columns(
+                [Field(rid, T.BIGINT, False)], [rowid], [None]
+            )
+            key = f"join_{id(p)}"
+            cap = caps.get(key, pad_capacity(lc.capacity))
+            expanded, total = hash_join_expand(
+                lc2, rc, tuple(probe_keys), tuple(build_keys), cap, INNER,
+                payload=list(p.right.output_names()), bit_widths=bit_widths,
+            )
+            checks_meta.append(key)
+            checks = checks + [total]
+            matched = filter_chunk(expanded, and_all(residual))
+            ids, _ = hash_aggregate(
+                matched, ((rid, Col(rid)),), (), lc.capacity
+            )
+            out = hash_join_unique(
+                lc2, ids, (Col(rid),), (Col(rid),),
+                LEFT_SEMI if p.kind == "semi" else LEFT_ANTI,
+                payload=[],
+            )
+            return out, checks
+
+        if unique and p.kind in ("inner", "left", "semi", "anti"):
+            if residual and p.kind != "inner":
+                raise PlanError(f"residual predicate on {p.kind} join unsupported")
+            out = hash_join_unique(
+                lc, rc, tuple(probe_keys), tuple(build_keys), kind,
+                payload=payload, bit_widths=bit_widths,
+            )
+            if residual:
+                out = filter_chunk(out, and_all(residual))
+            return out, checks
+        # expansion join
+        if residual and p.kind not in ("inner", "cross"):
+            raise PlanError(f"residual predicate on {p.kind} join unsupported")
+        key = f"join_{id(p)}"
+        default = pad_capacity(lc.capacity)
+        cap = caps.get(key, default)
+        out, total = hash_join_expand(
+            lc, rc, tuple(probe_keys), tuple(build_keys), cap, kind,
+            payload=payload, bit_widths=bit_widths,
+        )
+        if p.kind in ("semi", "anti"):
+            return out, checks  # no expansion: no overflow possible
+        checks_meta.append(key)
+        checks = checks + [total]
+        if residual:
+            out = filter_chunk(out, and_all(residual))
+        return out, checks
+
+    def run(inputs):
+        chunk, checks = emit(plan, inputs)
+        return chunk, tuple(checks)
+
+    return Compiled(run, scans, checks_meta, plan.output_names())
+
+
+def _equi_pair(conj: Expr, lcols: frozenset, rcols: frozenset):
+    """conj == 'eq(a, b)' with a from left and b from right (or swapped)."""
+    if not (isinstance(conj, Call) and conj.fn == "eq" and len(conj.args) == 2):
+        return None
+    a, b = conj.args
+    ca, cb = expr_cols(a), expr_cols(b)
+    if not ca or not cb:
+        return None
+    if ca <= lcols and cb <= rcols:
+        return a, b
+    if ca <= rcols and cb <= lcols:
+        return b, a
+    return None

@@ -1,0 +1,90 @@
+"""In-memory catalog: table registry + basic statistics.
+
+Reference behavior: fe catalog/ (Database/OlapTable/Column) +
+statistic/ (row counts, column stats used by the CBO). Persistence of
+catalog metadata (edit-log/image) arrives with the storage layer; this
+in-memory registry is the analyzer/optimizer-facing surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..column import HostTable, Schema
+
+
+@dataclasses.dataclass
+class ColumnStats:
+    min: Optional[int] = None
+    max: Optional[int] = None
+    n_distinct: Optional[int] = None
+
+
+class TableHandle:
+    def __init__(self, name: str, table: HostTable, unique_keys=()):
+        self.name = name
+        self.table = table
+        # tuple of key-column tuples each of which is unique per row
+        self.unique_keys = tuple(tuple(k) for k in unique_keys)
+        self._stats: dict = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self.table.schema
+
+    @property
+    def row_count(self) -> int:
+        return self.table.num_rows
+
+    def column_stats(self, col: str) -> ColumnStats:
+        """Lazily computed min/max (used for multi-key packing bit widths)."""
+        if col not in self._stats:
+            a = self.table.arrays[col]
+            st = ColumnStats()
+            if a.dtype.kind in "iu" and len(a):
+                st.min = int(a.min())
+                st.max = int(a.max())
+            self._stats[col] = st
+        return self._stats[col]
+
+
+class Catalog:
+    def __init__(self):
+        self.tables: dict = {}
+
+    def register(self, name: str, table: HostTable, unique_keys=()):
+        self.tables[name.lower()] = TableHandle(name.lower(), table, unique_keys)
+
+    def drop(self, name: str, if_exists: bool = False):
+        if name.lower() not in self.tables:
+            if if_exists:
+                return
+            raise KeyError(f"unknown table {name}")
+        del self.tables[name.lower()]
+
+    def get_table(self, name: str) -> Optional[TableHandle]:
+        return self.tables.get(name.lower())
+
+
+TPCH_UNIQUE_KEYS = {
+    "region": [("r_regionkey",)],
+    "nation": [("n_nationkey",)],
+    "supplier": [("s_suppkey",)],
+    "customer": [("c_custkey",)],
+    "part": [("p_partkey",)],
+    "partsupp": [("ps_partkey", "ps_suppkey")],
+    "orders": [("o_orderkey",)],
+    "lineitem": [("l_orderkey", "l_linenumber")],
+}
+
+
+def tpch_catalog(sf: float = 0.01, seed: int = 42) -> Catalog:
+    from .datagen.tpch import gen_tpch
+
+    cat = Catalog()
+    for name, ht in gen_tpch(sf=sf, seed=seed).items():
+        cat.register(name, ht, TPCH_UNIQUE_KEYS.get(name, ()))
+    return cat

@@ -75,9 +75,26 @@ def _type_col(t1, t2, t3):
     return d, codes
 
 
+P_NAME_WORDS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+    "blanched", "blue", "blush", "brown", "burlywood", "burnished", "chartreuse",
+    "chiffon", "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan",
+    "dark", "deep", "dim", "dodger", "drab", "firebrick", "floral", "forest",
+    "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey", "honeydew",
+    "hot", "indian", "ivory", "khaki", "lace", "lavender", "lawn", "lemon",
+    "light", "lime", "linen", "magenta", "maroon", "medium", "metallic",
+    "midnight", "mint", "misty", "moccasin", "navajo", "navy", "olive",
+]
+
+
 def _pname_col(p_key):
-    d = StringDict.from_values(sorted({f"part {i}" for i in range(997)}))
-    codes = d.encode([f"part {k}" for k in (p_key % 997)])
+    # TPC-H p_name = a few color-ish words; Q9/Q20 filter on LIKE '%green%'
+    n = len(P_NAME_WORDS)
+    w1 = (p_key * 7) % n
+    w2 = (p_key * 13 + 3) % n
+    vals = sorted({f"{a} {b}" for a in P_NAME_WORDS for b in P_NAME_WORDS})
+    d = StringDict.from_values(vals)
+    codes = d.encode([f"{P_NAME_WORDS[a]} {P_NAME_WORDS[b]}" for a, b in zip(w1, w2)])
     return d, codes.astype(np.int32)
 
 

@@ -56,7 +56,7 @@ def test_dist_aggregate_vs_pandas(mesh, via):
         check_vma=False,
     )
     def run(local):
-        out, ng, _mb = dist_aggregate(
+        out, ng, _mb, _png = dist_aggregate(
             local,
             group_by=(("k", col("k")),),
             aggs=(("s", AggExpr("sum", col("v"))), ("c", AggExpr("count", None)),
@@ -124,13 +124,6 @@ def test_shuffle_exact_full_bucket_no_collision(mesh):
     ht = HostTable.from_pydict({"k": [7] * 48, "v": list(range(48))})
     g = shard_host_table(ht, mesh)  # 48 live rows + dead padding per shard
 
-    run = jax.jit(
-        shard_map(
-            lambda local: shuffle_chunk(local, (col("k"),), "d", 8, 64),
-            mesh=mesh, in_specs=(chunk_pspec(g),),
-            out_specs=(P("d"), P("d")), check_vma=False,
-        )
-    )
     # per-shard scalars need a shard dim: wrap
     run = jax.jit(
         shard_map(

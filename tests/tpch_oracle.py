@@ -1,0 +1,304 @@
+"""Pandas implementations of the 22 TPC-H queries — the differential oracle
+for the SQL tier (reference analog: test/ SQL-tester R files)."""
+
+import numpy as np
+import pandas as pd
+
+
+def _d(s):
+    return pd.Timestamp(s)
+
+
+def load_frames(catalog):
+    out = {}
+    for name in ("lineitem", "orders", "customer", "supplier", "part",
+                 "partsupp", "nation", "region"):
+        out[name] = catalog.get_table(name).table.to_pandas()
+    return out
+
+
+def q1(f):
+    li = f["lineitem"]
+    x = li[li.l_shipdate <= _d("1998-09-02")].assign(
+        disc_price=lambda r: r.l_extendedprice * (1 - r.l_discount),
+        charge=lambda r: r.l_extendedprice * (1 - r.l_discount) * (1 + r.l_tax),
+    )
+    g = x.groupby(["l_returnflag", "l_linestatus"], as_index=False).agg(
+        sum_qty=("l_quantity", "sum"), sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"), sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"), avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"), count_order=("l_quantity", "size"),
+    )
+    return g.sort_values(["l_returnflag", "l_linestatus"])
+
+
+def q2(f):
+    p, s, ps, n, r = f["part"], f["supplier"], f["partsupp"], f["nation"], f["region"]
+    eu = n.merge(r[r.r_name == "EUROPE"], left_on="n_regionkey", right_on="r_regionkey")
+    sup = s.merge(eu, left_on="s_nationkey", right_on="n_nationkey")
+    j = ps.merge(sup, left_on="ps_suppkey", right_on="s_suppkey")
+    pp = p[(p.p_size == 15) & p.p_type.str.endswith("BRASS")]
+    j = j.merge(pp, left_on="ps_partkey", right_on="p_partkey")
+    mn = j.groupby("ps_partkey")["ps_supplycost"].transform("min")
+    j = j[j.ps_supplycost == mn]
+    return j[["s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr",
+              "s_address", "s_phone", "s_comment"]].sort_values(
+        ["s_acctbal", "n_name", "s_name", "p_partkey"],
+        ascending=[False, True, True, True]).head(100)
+
+
+def q3(f):
+    c, o, li = f["customer"], f["orders"], f["lineitem"]
+    j = (c[c.c_mktsegment == "BUILDING"]
+         .merge(o[o.o_orderdate < _d("1995-03-15")], left_on="c_custkey", right_on="o_custkey")
+         .merge(li[li.l_shipdate > _d("1995-03-15")], left_on="o_orderkey", right_on="l_orderkey"))
+    j = j.assign(rev=j.l_extendedprice * (1 - j.l_discount))
+    g = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"], as_index=False).agg(
+        revenue=("rev", "sum"))
+    g = g[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
+    return g.sort_values(["revenue", "o_orderdate"], ascending=[False, True]).head(10)
+
+
+def q4(f):
+    o, li = f["orders"], f["lineitem"]
+    ok = o[(o.o_orderdate >= _d("1993-07-01")) & (o.o_orderdate < _d("1993-10-01"))]
+    lk = li[li.l_commitdate < li.l_receiptdate].l_orderkey.unique()
+    x = ok[ok.o_orderkey.isin(lk)]
+    return x.groupby("o_orderpriority", as_index=False).agg(
+        order_count=("o_orderkey", "size")).sort_values("o_orderpriority")
+
+
+def q5(f):
+    c, o, li, s, n, r = (f["customer"], f["orders"], f["lineitem"],
+                         f["supplier"], f["nation"], f["region"])
+    j = (c.merge(o, left_on="c_custkey", right_on="o_custkey")
+         .merge(li, left_on="o_orderkey", right_on="l_orderkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey"))
+    j = j[j.c_nationkey == j.s_nationkey]
+    j = j.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    j = j.merge(r[r.r_name == "ASIA"], left_on="n_regionkey", right_on="r_regionkey")
+    j = j[(j.o_orderdate >= _d("1994-01-01")) & (j.o_orderdate < _d("1995-01-01"))]
+    j = j.assign(rev=j.l_extendedprice * (1 - j.l_discount))
+    return j.groupby("n_name", as_index=False).agg(revenue=("rev", "sum")).sort_values(
+        "revenue", ascending=False)
+
+
+def q6(f):
+    li = f["lineitem"]
+    x = li[(li.l_shipdate >= _d("1994-01-01")) & (li.l_shipdate < _d("1995-01-01"))
+           & (li.l_discount >= 0.05) & (li.l_discount <= 0.07) & (li.l_quantity < 24)]
+    return pd.DataFrame({"revenue": [(x.l_extendedprice * x.l_discount).sum()]})
+
+
+def q7(f):
+    s, li, o, c, n = f["supplier"], f["lineitem"], f["orders"], f["customer"], f["nation"]
+    j = (s.merge(li, left_on="s_suppkey", right_on="l_suppkey")
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(n.rename(columns={"n_nationkey": "nk1", "n_name": "supp_nation"})[["nk1", "supp_nation"]],
+                left_on="s_nationkey", right_on="nk1")
+         .merge(n.rename(columns={"n_nationkey": "nk2", "n_name": "cust_nation"})[["nk2", "cust_nation"]],
+                left_on="c_nationkey", right_on="nk2"))
+    j = j[(((j.supp_nation == "FRANCE") & (j.cust_nation == "GERMANY"))
+           | ((j.supp_nation == "GERMANY") & (j.cust_nation == "FRANCE")))
+          & (j.l_shipdate >= _d("1995-01-01")) & (j.l_shipdate <= _d("1996-12-31"))]
+    j = j.assign(l_year=j.l_shipdate.dt.year, volume=j.l_extendedprice * (1 - j.l_discount))
+    return j.groupby(["supp_nation", "cust_nation", "l_year"], as_index=False).agg(
+        revenue=("volume", "sum")).sort_values(["supp_nation", "cust_nation", "l_year"])
+
+
+def q8(f):
+    p, s, li, o, c, n, r = (f["part"], f["supplier"], f["lineitem"], f["orders"],
+                            f["customer"], f["nation"], f["region"])
+    j = (p[p.p_type == "ECONOMY ANODIZED STEEL"]
+         .merge(li, left_on="p_partkey", right_on="l_partkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(n.rename(columns={"n_nationkey": "nk1", "n_regionkey": "rk1"})[["nk1", "rk1"]],
+                left_on="c_nationkey", right_on="nk1")
+         .merge(r[r.r_name == "AMERICA"], left_on="rk1", right_on="r_regionkey")
+         .merge(n.rename(columns={"n_nationkey": "nk2", "n_name": "nation"})[["nk2", "nation"]],
+                left_on="s_nationkey", right_on="nk2"))
+    j = j[(j.o_orderdate >= _d("1995-01-01")) & (j.o_orderdate <= _d("1996-12-31"))]
+    j = j.assign(o_year=j.o_orderdate.dt.year, volume=j.l_extendedprice * (1 - j.l_discount))
+    g = j.groupby("o_year").apply(
+        lambda x: (x.volume * (x.nation == "BRAZIL")).sum() / x.volume.sum(),
+        include_groups=False,
+    ).reset_index(name="mkt_share")
+    return g.sort_values("o_year")
+
+
+def q9(f):
+    p, s, li, ps, o, n = (f["part"], f["supplier"], f["lineitem"], f["partsupp"],
+                          f["orders"], f["nation"])
+    j = (p[p.p_name.str.contains("green")]
+         .merge(li, left_on="p_partkey", right_on="l_partkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(ps, left_on=["l_partkey", "l_suppkey"], right_on=["ps_partkey", "ps_suppkey"])
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(n, left_on="s_nationkey", right_on="n_nationkey"))
+    j = j.assign(
+        o_year=j.o_orderdate.dt.year,
+        amount=j.l_extendedprice * (1 - j.l_discount) - j.ps_supplycost * j.l_quantity,
+    )
+    g = j.groupby(["n_name", "o_year"], as_index=False).agg(sum_profit=("amount", "sum"))
+    g = g.rename(columns={"n_name": "nation"})
+    return g.sort_values(["nation", "o_year"], ascending=[True, False])
+
+
+def q10(f):
+    c, o, li, n = f["customer"], f["orders"], f["lineitem"], f["nation"]
+    j = (c.merge(o[(o.o_orderdate >= _d("1993-10-01")) & (o.o_orderdate < _d("1994-01-01"))],
+                 left_on="c_custkey", right_on="o_custkey")
+         .merge(li[li.l_returnflag == "R"], left_on="o_orderkey", right_on="l_orderkey")
+         .merge(n, left_on="c_nationkey", right_on="n_nationkey"))
+    j = j.assign(rev=j.l_extendedprice * (1 - j.l_discount))
+    g = j.groupby(["c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+                   "c_address", "c_comment"], as_index=False).agg(revenue=("rev", "sum"))
+    g = g[["c_custkey", "c_name", "revenue", "c_acctbal", "n_name", "c_address",
+           "c_phone", "c_comment"]]
+    return g.sort_values("revenue", ascending=False).head(20)
+
+
+def q11(f):
+    ps, s, n = f["partsupp"], f["supplier"], f["nation"]
+    j = (ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey")
+         .merge(n[n.n_name == "GERMANY"], left_on="s_nationkey", right_on="n_nationkey"))
+    j = j.assign(v=j.ps_supplycost * j.ps_availqty)
+    total = j.v.sum() * 0.0001
+    g = j.groupby("ps_partkey", as_index=False).agg(value=("v", "sum"))
+    return g[g.value > total].sort_values("value", ascending=False)
+
+
+def q12(f):
+    o, li = f["orders"], f["lineitem"]
+    x = li[li.l_shipmode.isin(["MAIL", "SHIP"])
+           & (li.l_commitdate < li.l_receiptdate) & (li.l_shipdate < li.l_commitdate)
+           & (li.l_receiptdate >= _d("1994-01-01")) & (li.l_receiptdate < _d("1995-01-01"))]
+    j = o.merge(x, left_on="o_orderkey", right_on="l_orderkey")
+    hi = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    g = j.assign(high=hi.astype(int), low=(~hi).astype(int)).groupby(
+        "l_shipmode", as_index=False).agg(high_line_count=("high", "sum"),
+                                          low_line_count=("low", "sum"))
+    return g.sort_values("l_shipmode")
+
+
+def q13(f):
+    c, o = f["customer"], f["orders"]
+    ox = o[~o.o_comment.str.contains("special.*requests", regex=True)]
+    j = c.merge(ox, left_on="c_custkey", right_on="o_custkey", how="left")
+    g = j.groupby("c_custkey")["o_orderkey"].count().reset_index(name="c_count")
+    g2 = g.groupby("c_count", as_index=False).agg(custdist=("c_count", "size"))
+    return g2.sort_values(["custdist", "c_count"], ascending=[False, False])
+
+
+def q14(f):
+    li, p = f["lineitem"], f["part"]
+    x = li[(li.l_shipdate >= _d("1995-09-01")) & (li.l_shipdate < _d("1995-10-01"))]
+    j = x.merge(p, left_on="l_partkey", right_on="p_partkey")
+    rev = j.l_extendedprice * (1 - j.l_discount)
+    promo = rev * j.p_type.str.startswith("PROMO")
+    return pd.DataFrame({"promo_revenue": [100.0 * promo.sum() / rev.sum()]})
+
+
+def q15(f):
+    li, s = f["lineitem"], f["supplier"]
+    x = li[(li.l_shipdate >= _d("1996-01-01")) & (li.l_shipdate < _d("1996-04-01"))]
+    rev = x.assign(r=x.l_extendedprice * (1 - x.l_discount)).groupby(
+        "l_suppkey", as_index=False).agg(total_revenue=("r", "sum"))
+    mx = rev.total_revenue.max()
+    j = s.merge(rev[rev.total_revenue == mx], left_on="s_suppkey", right_on="l_suppkey")
+    return j[["s_suppkey", "s_name", "s_address", "s_phone", "total_revenue"]].sort_values("s_suppkey")
+
+
+def q16(f):
+    ps, p, s = f["partsupp"], f["part"], f["supplier"]
+    bad = s[s.s_comment.str.contains("Customer.*Complaints", regex=True)].s_suppkey
+    pp = p[(p.p_brand != "Brand#45") & ~p.p_type.str.startswith("MEDIUM POLISHED")
+           & p.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])]
+    j = ps[~ps.ps_suppkey.isin(bad)].merge(pp, left_on="ps_partkey", right_on="p_partkey")
+    g = j.groupby(["p_brand", "p_type", "p_size"], as_index=False).agg(
+        supplier_cnt=("ps_suppkey", "nunique"))
+    return g[["p_brand", "p_type", "p_size", "supplier_cnt"]].sort_values(
+        ["supplier_cnt", "p_brand", "p_type", "p_size"],
+        ascending=[False, True, True, True])
+
+
+def q17(f):
+    li, p = f["lineitem"], f["part"]
+    pp = p[(p.p_brand == "Brand#23") & (p.p_container == "MED BOX")]
+    j = li.merge(pp, left_on="l_partkey", right_on="p_partkey")
+    avg02 = li.groupby("l_partkey")["l_quantity"].mean() * 0.2
+    j = j[j.l_quantity < j.l_partkey.map(avg02)]
+    return pd.DataFrame({"avg_yearly": [j.l_extendedprice.sum() / 7.0]})
+
+
+def q18(f):
+    c, o, li = f["customer"], f["orders"], f["lineitem"]
+    big = li.groupby("l_orderkey")["l_quantity"].sum()
+    big = big[big > 300].index
+    j = (c.merge(o[o.o_orderkey.isin(big)], left_on="c_custkey", right_on="o_custkey")
+         .merge(li, left_on="o_orderkey", right_on="l_orderkey"))
+    g = j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice"],
+                  as_index=False).agg(s=("l_quantity", "sum"))
+    return g.sort_values(["o_totalprice", "o_orderdate"], ascending=[False, True]).head(100)
+
+
+def q19(f):
+    li, p = f["lineitem"], f["part"]
+    j = li.merge(p, left_on="l_partkey", right_on="p_partkey")
+    base = j.l_shipmode.isin(["AIR", "AIR REG"]) & (j.l_shipinstruct == "DELIVER IN PERSON")
+    c1 = ((j.p_brand == "Brand#12")
+          & j.p_container.isin(["SM CASE", "SM BOX", "SM PACK", "SM PKG"])
+          & (j.l_quantity >= 1) & (j.l_quantity <= 11) & (j.p_size >= 1) & (j.p_size <= 5))
+    c2 = ((j.p_brand == "Brand#23")
+          & j.p_container.isin(["MED BAG", "MED BOX", "MED PKG", "MED PACK"])
+          & (j.l_quantity >= 10) & (j.l_quantity <= 20) & (j.p_size >= 1) & (j.p_size <= 10))
+    c3 = ((j.p_brand == "Brand#34")
+          & j.p_container.isin(["LG CASE", "LG BOX", "LG PACK", "LG PKG"])
+          & (j.l_quantity >= 20) & (j.l_quantity <= 30) & (j.p_size >= 1) & (j.p_size <= 15))
+    x = j[base & (c1 | c2 | c3)]
+    return pd.DataFrame({"revenue": [(x.l_extendedprice * (1 - x.l_discount)).sum()]})
+
+
+def q20(f):
+    s, n, ps, p, li = f["supplier"], f["nation"], f["partsupp"], f["part"], f["lineitem"]
+    forest = p[p.p_name.str.startswith("forest")].p_partkey
+    x = li[(li.l_shipdate >= _d("1994-01-01")) & (li.l_shipdate < _d("1995-01-01"))]
+    qty = x.groupby(["l_partkey", "l_suppkey"])["l_quantity"].sum() * 0.5
+    psx = ps[ps.ps_partkey.isin(forest)].copy()
+    key = list(zip(psx.ps_partkey, psx.ps_suppkey))
+    psx["thresh"] = [qty.get(k, np.nan) for k in key]
+    good = psx[psx.ps_availqty > psx.thresh].ps_suppkey.unique()
+    j = s[s.s_suppkey.isin(good)].merge(
+        n[n.n_name == "CANADA"], left_on="s_nationkey", right_on="n_nationkey")
+    return j[["s_name", "s_address"]].sort_values("s_name")
+
+
+def q21(f):
+    s, li, o, n = f["supplier"], f["lineitem"], f["orders"], f["nation"]
+    l1 = li[li.l_receiptdate > li.l_commitdate]
+    multi = li.groupby("l_orderkey")["l_suppkey"].nunique()
+    late = l1.groupby("l_orderkey")["l_suppkey"].nunique()
+    j = (s.merge(l1, left_on="s_suppkey", right_on="l_suppkey")
+         .merge(o[o.o_orderstatus == "F"], left_on="l_orderkey", right_on="o_orderkey")
+         .merge(n[n.n_name == "SAUDI ARABIA"], left_on="s_nationkey", right_on="n_nationkey"))
+    j = j[(j.l_orderkey.map(multi) > 1) & (j.l_orderkey.map(late) == 1)]
+    g = j.groupby("s_name", as_index=False).agg(numwait=("l_orderkey", "size"))
+    return g.sort_values(["numwait", "s_name"], ascending=[False, True]).head(100)
+
+
+def q22(f):
+    c, o = f["customer"], f["orders"]
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    cc = c.assign(cntrycode=c.c_phone.str[:2])
+    cc = cc[cc.cntrycode.isin(codes)]
+    avg = cc[cc.c_acctbal > 0].c_acctbal.mean()
+    x = cc[(cc.c_acctbal > avg) & ~cc.c_custkey.isin(o.o_custkey)]
+    g = x.groupby("cntrycode", as_index=False).agg(
+        numcust=("c_acctbal", "size"), totacctbal=("c_acctbal", "sum"))
+    return g.sort_values("cntrycode")
+
+
+ORACLES = {i: globals()[f"q{i}"] for i in range(1, 23)}
