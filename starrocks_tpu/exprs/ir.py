@@ -86,6 +86,21 @@ class InList(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowExpr(Expr):
+    """fn(arg) OVER (PARTITION BY ... ORDER BY ...). fn is an aggregate name
+    or row_number/rank/dense_rank; arg is None for rank-family/count(*)."""
+
+    fn: str
+    arg: object  # Expr | None
+    partition_by: tuple = ()  # tuple[Expr]
+    order_by: tuple = ()  # tuple[(Expr, asc, nulls_first)]
+
+    def __repr__(self):
+        a = "" if self.arg is None else repr(self.arg)
+        return f"{self.fn}({a}) OVER(p={list(self.partition_by)}, o={[o[0] for o in self.order_by]})"
+
+
+@dataclasses.dataclass(frozen=True)
 class AggExpr(Expr):
     """Aggregate function reference used in aggregation specs."""
 
@@ -162,6 +177,13 @@ def walk(e: Expr):
     elif isinstance(e, AggExpr):
         if e.arg is not None:
             yield from walk(e.arg)
+    elif isinstance(e, WindowExpr):
+        if e.arg is not None:
+            yield from walk(e.arg)
+        for p in e.partition_by:
+            yield from walk(p)
+        for o, _, _ in e.order_by:
+            yield from walk(o)
 
 
 def referenced_columns(e: Expr) -> set:

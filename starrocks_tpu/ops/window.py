@@ -1,0 +1,244 @@
+"""Window (analytic) functions.
+
+Reference behavior: be/src/exec/analytor.h:54 + analytic_node — partitioned,
+frame-based analytic evaluation. TPU re-design: one lexsort by
+(partition keys, order keys), segment ids from partition boundaries, then
+- whole-partition aggregates  = segment reduction gathered back per row,
+- running aggregates (default RANGE UNBOUNDED PRECEDING..CURRENT ROW frame
+  with peers) = segmented cumulative sums with peer-group correction,
+- row_number / rank / dense_rank = positional arithmetic on the sorted order.
+The output chunk is in sorted order (SQL leaves intermediate order
+unspecified); new columns align with it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..column.column import Chunk, Field
+from ..exprs.compile import ExprCompiler
+from .common import boundaries, eval_keys
+from .sort import _descending
+
+
+def _seg_cummax_from_flags(vals, is_new):
+    """Segmented 'value at segment start' propagation: for each row, the most
+    recent value at a row where is_new was True (inclusive)."""
+    idx = jnp.where(is_new, jnp.arange(vals.shape[0]), 0)
+    start_idx = jax.lax.associative_scan(jnp.maximum, idx)
+    return vals[start_idx], start_idx
+
+
+def window_op(
+    chunk: Chunk,
+    partition_by: tuple,  # tuple[Expr]
+    order_by: tuple,  # tuple[(Expr, asc, nulls_first)]
+    funcs: tuple,  # tuple[(out_name, fn_name, arg_expr|None)]
+) -> Chunk:
+    cap = chunk.capacity
+    live = chunk.sel_mask()
+    pkeys = eval_keys(chunk, partition_by)
+    okeys = eval_keys(chunk, tuple(e for e, _, _ in order_by))
+
+    # sort: dead last, then partition keys, then order keys
+    ops = []
+    for k, (_, asc, nulls_first) in zip(reversed(okeys), reversed(list(order_by))):
+        d = k.data
+        if d.dtype == jnp.bool_:
+            d = jnp.asarray(d, jnp.int8)
+        ops.append(d if asc else _descending(d))
+        if k.valid is not None:
+            ops.append(jnp.asarray(k.valid if nulls_first else ~k.valid, jnp.int8))
+    for k in reversed(pkeys):
+        ops.append(k.data)
+        if k.valid is not None:
+            ops.append(jnp.asarray(~k.valid, jnp.int8))
+    ops.append(jnp.asarray(~live, jnp.int8))
+    order = jnp.lexsort(tuple(ops))
+
+    sorted_chunk = chunk.take(order)
+    live_s = live[order]
+    pos = jnp.arange(cap)
+
+    if pkeys:
+        part_new = boundaries(pkeys, live, order)
+    else:
+        part_new = jnp.zeros((cap,), jnp.bool_).at[0].set(jnp.any(live))
+    # peer groups: rows equal on partition+order keys
+    peer_new = boundaries(pkeys + okeys, live, order) if okeys else part_new
+
+    seg = jnp.cumsum(part_new) - 1  # partition id per sorted row
+    seg = jnp.clip(seg, 0, cap - 1)
+    part_start, _ = _seg_cummax_from_flags(pos, part_new)
+    row_in_part = pos - part_start
+
+    cc = ExprCompiler(sorted_chunk)
+    new_fields, new_data, new_valid = [], [], []
+    for out_name, fn, arg in funcs:
+        if fn == "row_number":
+            new_fields.append(Field(out_name, T.BIGINT, False))
+            new_data.append(row_in_part + 1)
+            new_valid.append(None)
+            continue
+        if fn in ("rank", "dense_rank"):
+            if fn == "rank":
+                peer_start, _ = _seg_cummax_from_flags(pos, peer_new | part_new)
+                r = peer_start - part_start + 1
+            else:
+                in_part_newpeer = (peer_new | part_new) & ~part_new
+                dr = jnp.cumsum(jnp.asarray(in_part_newpeer, jnp.int64))
+                dr_at_start, _ = _seg_cummax_from_flags(dr, part_new)
+                r = dr - dr_at_start + 1
+            new_fields.append(Field(out_name, T.BIGINT, False))
+            new_data.append(r)
+            new_valid.append(None)
+            continue
+
+        # aggregates over the partition
+        running = bool(okeys)  # default frame when ORDER BY present
+        if fn == "count" and arg is None:
+            vals = jnp.asarray(live_s, jnp.int64)
+            m = live_s
+            out_t = T.BIGINT
+            dict_ = None
+        else:
+            v = cc.eval(arg)
+            out_t = _agg_out_type(fn, v.type)
+            d = jnp.broadcast_to(jnp.asarray(v.data), (cap,))
+            m = live_s if v.valid is None else (live_s & jnp.broadcast_to(v.valid, (cap,)))
+            dict_ = v.dict
+            if fn == "count":
+                vals = jnp.asarray(m, jnp.int64)
+            elif fn in ("sum", "avg"):
+                vals = jnp.where(m, _cast_rep(d, v.type, out_t), 0)
+            else:  # min/max
+                ident = _mm_ident(v.type, fn == "min")
+                vals = jnp.where(m, d, jnp.asarray(ident, v.type.dtype))
+
+        if fn in ("min", "max"):
+            op = jnp.minimum if fn == "min" else jnp.maximum
+            if running:
+                run = _segmented_scan(vals, part_new, op)
+                res = _peer_extend(run, peer_new | part_new, pos)
+            else:
+                segmin = (jax.ops.segment_min if fn == "min" else jax.ops.segment_max)(
+                    vals, seg, num_segments=cap, indices_are_sorted=True
+                )
+                res = segmin[seg]
+            cnt = _part_count(m, seg, cap, running, part_new, peer_new, pos)
+            new_fields.append(Field(out_name, out_t, True, dict_))
+            new_data.append(res)
+            new_valid.append(cnt > 0)
+            continue
+
+        # sum / count / avg
+        if running:
+            csum = _segmented_scan(jnp.asarray(vals), part_new, jnp.add)
+            csum = _peer_extend(csum, peer_new | part_new, pos)
+            total = csum
+            ccnt = _segmented_scan(jnp.asarray(m, jnp.int64), part_new, jnp.add)
+            ccnt = _peer_extend(ccnt, peer_new | part_new, pos)
+        else:
+            total = jax.ops.segment_sum(vals, seg, num_segments=cap, indices_are_sorted=True)[seg]
+            ccnt = jax.ops.segment_sum(
+                jnp.asarray(m, jnp.int64), seg, num_segments=cap, indices_are_sorted=True
+            )[seg]
+        if fn == "count":
+            new_fields.append(Field(out_name, T.BIGINT, False))
+            new_data.append(ccnt)
+            new_valid.append(None)
+        elif fn == "sum":
+            new_fields.append(Field(out_name, out_t, True))
+            new_data.append(total)
+            new_valid.append(ccnt > 0)
+        elif fn == "avg":
+            denom = jnp.maximum(ccnt, 1)
+            if out_t.is_decimal:
+                res = jnp.asarray(total, jnp.float64) / (10 ** out_t.scale) / denom
+            else:
+                res = jnp.asarray(total, jnp.float64) / denom
+            new_fields.append(Field(out_name, T.DOUBLE, True))
+            new_data.append(res)
+            new_valid.append(ccnt > 0)
+        else:
+            raise NotImplementedError(f"window function {fn}")
+
+    return sorted_chunk.with_columns(new_fields, new_data, new_valid)
+
+
+def _segmented_scan(vals, seg_start_flags, op):
+    """Inclusive scan restarting at segment starts."""
+
+    def combine(a, b):
+        a_val, a_flag = a
+        b_val, b_flag = b
+        val = jnp.where(b_flag, b_val, op(a_val, b_val))
+        return val, a_flag | b_flag
+
+    out, _ = jax.lax.associative_scan(
+        combine, (vals, seg_start_flags)
+    )
+    return out
+
+
+def _carry_scan(vals, flags):
+    """out[i] = vals at the most recent flagged position <= i (carry scan)."""
+
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av), af | bf
+
+    out, _ = jax.lax.associative_scan(combine, (vals, flags))
+    return out
+
+
+def _peer_extend(run, peer_start_flags, pos):
+    """RANGE frames include the whole peer group: every row takes the running
+    value at the LAST row of its peer group (= position just before the next
+    peer start)."""
+    # row i's peer-group end = min{j >= i : next row j+1 starts a new peer}
+    nxt = jnp.concatenate([peer_start_flags[1:], jnp.ones((1,), jnp.bool_)])
+    end = _carry_scan(pos[::-1], nxt[::-1])[::-1]
+    return run[end]
+
+
+def _part_count(m, seg, cap, running, part_new, peer_new, pos):
+    if running:
+        c = _segmented_scan(jnp.asarray(m, jnp.int64), part_new, jnp.add)
+        return _peer_extend(c, peer_new | part_new, pos)
+    return jax.ops.segment_sum(
+        jnp.asarray(m, jnp.int64), seg, num_segments=cap, indices_are_sorted=True
+    )[seg]
+
+
+def _agg_out_type(fn, t):
+    if fn in ("min", "max"):
+        return t
+    if fn == "count":
+        return T.BIGINT
+    if t.is_decimal:
+        return T.DECIMAL(18, t.scale)
+    if t.is_float:
+        return T.DOUBLE
+    return T.BIGINT
+
+
+def _cast_rep(d, t, out_t):
+    if t.is_decimal and out_t.is_decimal:
+        x = jnp.asarray(d, jnp.int64)
+        if t.scale < out_t.scale:
+            x = x * (10 ** (out_t.scale - t.scale))
+        return x
+    return jnp.asarray(d, out_t.dtype)
+
+
+def _mm_ident(t, is_min):
+    if t.is_float:
+        return jnp.inf if is_min else -jnp.inf
+    if t.kind is T.TypeKind.BOOLEAN:
+        return True if is_min else False
+    info = jnp.iinfo(t.dtype)
+    return info.max if is_min else info.min

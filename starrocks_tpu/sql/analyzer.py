@@ -17,10 +17,10 @@ import dataclasses
 import itertools
 from typing import Optional
 
-from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit
+from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit, WindowExpr
 from . import ast
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LogicalPlan,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LWindow, LogicalPlan,
 )
 
 
@@ -108,6 +108,8 @@ class Analyzer:
 
         if sel.where is not None:
             pred = self._lower(sel.where, scope, ctes, allow_agg=False)
+            if any(isinstance(x, WindowExpr) for x in _walk_expr(pred)):
+                raise AnalyzerError("window functions are not allowed in WHERE")
             plan = LFilter(plan, pred)
 
         # --- aggregate detection --------------------------------------------
@@ -143,6 +145,10 @@ class Analyzer:
             if sel.having is not None
             else None
         )
+        if having is not None and any(
+            isinstance(x, WindowExpr) for x in _walk_expr(having)
+        ):
+            raise AnalyzerError("window functions are not allowed in HAVING")
         order_items = [
             (self._lower_order_expr(o.expr, lowered_items, scope, ctes), o.asc,
              o.nulls_first if o.nulls_first is not None else not o.asc)
@@ -162,6 +168,20 @@ class Analyzer:
             if having is not None:
                 plan = LFilter(plan, having)
 
+        visible_names = None
+        plan, lowered_items, order_items = self._extract_windows(
+            plan, lowered_items, order_items
+        )
+        order_only_wins = {
+            c
+            for e, _, _ in order_items
+            for c in _cols_of(e)
+            if c.startswith("win_") and not any(c == n for n, _ in lowered_items)
+        }
+        if order_only_wins:
+            visible_names = [n for n, _ in lowered_items]
+            lowered_items = lowered_items + [(c, Col(c)) for c in sorted(order_only_wins)]
+
         plan = LProject(plan, tuple(lowered_items))
 
         if sel.distinct:
@@ -178,6 +198,9 @@ class Analyzer:
                 plan = LLimit(plan, sel.limit, sel.offset)
         elif sel.limit is not None:
             plan = LLimit(plan, sel.limit, sel.offset)
+        if visible_names is not None:
+            # drop ORDER-BY-only window columns from the visible output
+            plan = LProject(plan, tuple((n, Col(n)) for n in visible_names))
         return plan
 
     def _analyze_relation(self, rel, outer, ctes):
@@ -246,6 +269,17 @@ class Analyzer:
             return e
         if isinstance(e, Lit):
             return e
+        if isinstance(e, WindowExpr):
+            arg = (
+                self._lower(e.arg, scope, ctes, allow_agg=False)
+                if e.arg is not None else None
+            )
+            part = tuple(self._lower(p, scope, ctes, allow_agg=False) for p in e.partition_by)
+            order = tuple(
+                (self._lower(o, scope, ctes, allow_agg=False), asc, nf)
+                for o, asc, nf in e.order_by
+            )
+            return WindowExpr(e.fn, arg, part, order)
         if isinstance(e, AggExpr):
             if not allow_agg:
                 raise AnalyzerError(f"aggregate {e} not allowed here")
@@ -358,6 +392,13 @@ class Analyzer:
                 return e
             if isinstance(e, Lit):
                 return e
+            if isinstance(e, WindowExpr):
+                return WindowExpr(
+                    e.fn,
+                    replace(e.arg) if e.arg is not None else None,
+                    tuple(replace(p) for p in e.partition_by),
+                    tuple((replace(o), a, nf) for o, a, nf in e.order_by),
+                )
             if isinstance(e, (ScalarSubquery, SemiJoinMark)):
                 return e
             raise AnalyzerError(f"cannot use {e!r} in aggregate query")
@@ -378,12 +419,77 @@ class Analyzer:
         agg_node = LAggregate(plan, tuple(group_named), tuple(aggs.items()))
         return agg_node, new_items, new_having, new_order
 
+    def _extract_windows(self, plan, items, order_items):
+        """Pull WindowExpr subtrees out of select/order expressions into
+        LWindow nodes (one per distinct (partition, order) spec)."""
+        specs = {}  # (partition, order) -> list[(name, fn, arg)]
+        mapping = {}  # WindowExpr -> Col name
+
+        def collect(e):
+            if isinstance(e, WindowExpr):
+                if e in mapping:
+                    return
+                name = f"win_{len(mapping)}"
+                mapping[e] = name
+                specs.setdefault((e.partition_by, e.order_by), []).append(
+                    (name, e.fn, e.arg)
+                )
+                return
+            if isinstance(e, Call):
+                for a in e.args:
+                    collect(a)
+            elif isinstance(e, Case):
+                for c, v in e.whens:
+                    collect(c)
+                    collect(v)
+                if e.orelse is not None:
+                    collect(e.orelse)
+            elif isinstance(e, Cast):
+                collect(e.arg)
+            elif isinstance(e, InList):
+                collect(e.arg)
+
+        for _, e in items:
+            collect(e)
+        for e, _, _ in order_items:
+            collect(e)
+        if not mapping:
+            return plan, items, order_items
+
+        def subst(e):
+            if isinstance(e, WindowExpr):
+                return Col(mapping[e])
+            if isinstance(e, Call):
+                return Call(e.fn, *[subst(a) for a in e.args])
+            if isinstance(e, Case):
+                return Case(
+                    tuple((subst(c), subst(v)) for c, v in e.whens),
+                    subst(e.orelse) if e.orelse is not None else None,
+                )
+            if isinstance(e, Cast):
+                return Cast(subst(e.arg), e.to)
+            if isinstance(e, InList):
+                return InList(subst(e.arg), e.values, e.negated)
+            return e
+
+        for (part, order), funcs in specs.items():
+            plan = LWindow(plan, part, order, tuple(funcs))
+        new_items = [(n, subst(e)) for n, e in items]
+        new_order = [(subst(e), a, nf) for e, a, nf in order_items]
+        return plan, new_items, new_order
+
     @staticmethod
     def _auto_name(e) -> str:
         if isinstance(e, ast.RawCol):
             return e.name
         r = repr(e)
         return r if len(r) <= 40 else r[:37] + "..."
+
+
+def _walk_expr(e: Expr):
+    from ..exprs.ir import walk
+
+    yield from walk(e)
 
 
 def _contains_agg(e: Expr) -> bool:
@@ -418,6 +524,13 @@ def _cols_of(e: Expr):
         yield from _cols_of(e.arg)
     elif isinstance(e, InList):
         yield from _cols_of(e.arg)
+    elif isinstance(e, WindowExpr):
+        if e.arg is not None:
+            yield from _cols_of(e.arg)
+        for p in e.partition_by:
+            yield from _cols_of(p)
+        for o, _, _ in e.order_by:
+            yield from _cols_of(o)
 
 
 def _extract_correlations(plan: LogicalPlan) -> tuple:

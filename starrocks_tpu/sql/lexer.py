@@ -18,6 +18,7 @@ KEYWORDS = {
     "table", "insert", "into", "values", "drop", "if", "true", "false",
     "nulls", "first", "last", "explain", "analyze", "year", "month", "day",
     "distributed", "hash", "buckets", "properties", "substring", "any",
+    "over", "partition", "rows", "range", "unbounded", "preceding", "current",
 }
 
 

@@ -109,6 +109,24 @@ class LAggregate(LogicalPlan):
 
 
 @dataclasses.dataclass(frozen=True)
+class LWindow(LogicalPlan):
+    child: LogicalPlan
+    partition_by: tuple  # tuple[Expr]
+    order_by: tuple  # tuple[(Expr, asc, nulls_first)]
+    funcs: tuple  # tuple[(out_name, fn, arg_expr|None)]
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def output_names(self):
+        return self.child.output_names() + tuple(n for n, _, _ in self.funcs)
+
+    def __repr__(self):
+        return f"Window[{[n for n, _, _ in self.funcs]} part={list(self.partition_by)}]"
+
+
+@dataclasses.dataclass(frozen=True)
 class LSort(LogicalPlan):
     child: LogicalPlan
     keys: tuple  # tuple[(Expr, asc, nulls_first)]

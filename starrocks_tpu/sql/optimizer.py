@@ -26,7 +26,7 @@ import dataclasses
 from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit
 from .analyzer import ScalarSubquery, SemiJoinMark, _conjuncts
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LogicalPlan,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LWindow, LogicalPlan,
 )
 
 
@@ -200,6 +200,14 @@ def _push(plan: LogicalPlan, preds: list) -> LogicalPlan:
         child = _push(plan.child, down)
         return _wrap(LAggregate(child, plan.group_by, plan.aggs), stay)
 
+    if isinstance(plan, LWindow):
+        # conservative: filters stay above the window (pushing below would be
+        # valid only for partition-key-only predicates)
+        child = _push(plan.child, [])
+        return _wrap(
+            LWindow(child, plan.partition_by, plan.order_by, plan.funcs), preds
+        )
+
     if isinstance(plan, (LSort, LLimit)):
         # a pure sort is transparent to filters, but a fused TopN (or LIMIT)
         # is not: filtering before "pick k rows" changes which rows survive
@@ -271,6 +279,8 @@ def _replace_children(plan, new_children):
         return LJoin(new_children[0], new_children[1], plan.kind, plan.condition)
     if isinstance(plan, LAggregate):
         return LAggregate(new_children[0], plan.group_by, plan.aggs)
+    if isinstance(plan, LWindow):
+        return LWindow(new_children[0], plan.partition_by, plan.order_by, plan.funcs)
     if isinstance(plan, LSort):
         return LSort(new_children[0], plan.keys, plan.limit)
     if isinstance(plan, LLimit):
@@ -325,7 +335,7 @@ def _expose_columns(plan: LogicalPlan, cols) -> LogicalPlan:
     missing = [c for c in cols if c not in plan.output_names()]
     if not missing:
         return plan
-    if isinstance(plan, (LSort, LLimit)):
+    if isinstance(plan, (LSort, LLimit, LWindow)):
         return _replace_children(plan, (_expose_columns(plan.child, cols),))
     if isinstance(plan, LProject):
         child_out = plan.child.output_names()
@@ -481,7 +491,7 @@ def estimate_rows(plan: LogicalPlan, catalog) -> float:
         if plan.kind in ("semi", "anti"):
             return l * 0.5
         return max(l, r)
-    if isinstance(plan, (LSort, LLimit)):
+    if isinstance(plan, (LSort, LLimit, LWindow)):
         return estimate_rows(plan.child, catalog)
     return 1000.0
 
@@ -604,6 +614,23 @@ def prune_columns(plan: LogicalPlan, required: frozenset | None = None) -> Logic
             need = set(plan.child.output_names()[:1])
         return LAggregate(
             prune_columns(plan.child, frozenset(need)), kept_groups, kept_aggs
+        )
+
+    if isinstance(plan, LWindow):
+        func_names = {n for n, _, _ in plan.funcs}
+        need = set(required) - func_names
+        for p in plan.partition_by:
+            need |= expr_cols(p)
+        for o, _, _ in plan.order_by:
+            need |= expr_cols(o)
+        for _, _, a in plan.funcs:
+            if a is not None:
+                need |= expr_cols(a)
+        if not need:
+            need = set(plan.child.output_names()[:1])
+        return LWindow(
+            prune_columns(plan.child, frozenset(need)),
+            plan.partition_by, plan.order_by, plan.funcs,
         )
 
     if isinstance(plan, LSort):

@@ -7,7 +7,7 @@ scalar expressions (unresolved RawCol/RawFunc forms).
 
 from __future__ import annotations
 
-from ..exprs.ir import AggExpr, Call, Case, Cast, Expr, InList, Lit
+from ..exprs.ir import AggExpr, Call, Case, Cast, Expr, InList, Lit, WindowExpr
 from .. import types as T
 from . import ast
 from .lexer import Token, tokenize
@@ -477,6 +477,8 @@ class Parser:
             while self.accept_op(","):
                 args.append(self.parse_expr())
         self.expect_op(")")
+        if self.at_kw("over"):
+            return self.parse_over(name, args, distinct)
         if name in AGG_FUNCS:
             if name == "count" and args and isinstance(args[0], ast.Star):
                 return AggExpr("count", None, distinct)
@@ -485,6 +487,38 @@ class Parser:
         if reg is not None:
             return Call(reg, *args)
         return ast.RawFunc(name, tuple(args), distinct)
+
+    WINDOW_ONLY = {"row_number", "rank", "dense_rank"}
+
+    def parse_over(self, name, args, distinct):
+        if distinct:
+            raise ParseError("DISTINCT in window functions unsupported")
+        if name not in AGG_FUNCS and name not in self.WINDOW_ONLY:
+            raise ParseError(f"{name!r} is not a window function")
+        self.expect_kw("over")
+        self.expect_op("(")
+        partition = []
+        order = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                o = self.parse_order_item()
+                nf = o.nulls_first if o.nulls_first is not None else not o.asc
+                order.append((o.expr, o.asc, nf))
+                if not self.accept_op(","):
+                    break
+        if self.at_kw("rows", "range"):
+            raise ParseError("explicit window frames unsupported (default frame only)")
+        self.expect_op(")")
+        arg = None
+        if args and not isinstance(args[0], ast.Star):
+            arg = args[0]
+        return WindowExpr(name, arg, tuple(partition), tuple(order))
 
     def parse_case(self) -> Expr:
         self.expect_kw("case")

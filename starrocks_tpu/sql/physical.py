@@ -27,10 +27,11 @@ from ..ops import (
     filter_chunk, hash_aggregate, hash_join_expand, hash_join_unique,
     limit_chunk, project, sort_chunk,
 )
+from ..ops.window import window_op
 from ..column.column import pad_capacity
 from .analyzer import _conjuncts
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LogicalPlan,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LWindow, LogicalPlan,
 )
 from .optimizer import and_all, expr_cols
 
@@ -55,7 +56,7 @@ def unique_sets(plan: LogicalPlan, catalog) -> set:
         return out
     if isinstance(plan, LFilter):
         return unique_sets(plan.child, catalog)
-    if isinstance(plan, (LSort, LLimit)):
+    if isinstance(plan, (LSort, LLimit, LWindow)):
         return unique_sets(plan.child, catalog)
     if isinstance(plan, LProject):
         child = unique_sets(plan.child, catalog)
@@ -85,7 +86,7 @@ def col_origin(plan: LogicalPlan, name: str):
         if alias == plan.alias and base in plan.columns:
             return plan.table, base
         return None
-    if isinstance(plan, (LFilter, LSort, LLimit)):
+    if isinstance(plan, (LFilter, LSort, LLimit, LWindow)):
         return col_origin(plan.child, name)
     if isinstance(plan, LProject):
         for n, e in plan.exprs:
@@ -177,6 +178,9 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
         if isinstance(p, LLimit):
             c, ch = emit(p.child, inputs)
             return limit_chunk(c, p.limit, p.offset), ch
+        if isinstance(p, LWindow):
+            c, ch = emit(p.child, inputs)
+            return window_op(c, p.partition_by, p.order_by, p.funcs), ch
         if isinstance(p, LAggregate):
             c, ch = emit(p.child, inputs)
             key = f"agg_{id(p)}"

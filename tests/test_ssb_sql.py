@@ -1,0 +1,97 @@
+"""SSB-flat 13-query differential suite vs a pandas oracle."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.storage.datagen.ssb import ssb_catalog
+
+from ssb_queries import FLAT_QUERIES
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session(ssb_catalog(sf=0.005))
+    s._flat = s.catalog.get_table("lineorder_flat").table.to_pandas()
+    return s
+
+
+def _oracle(df, qid):
+    y = "lo_orderdate_year"
+    if qid == "q1.1":
+        x = df[(df[y] == 1993) & df.lo_discount.between(1, 3) & (df.lo_quantity < 25)]
+        return [[(x.lo_extendedprice * x.lo_discount).sum()]]
+    if qid == "q1.2":
+        x = df[(df.lo_orderdate_yearmonthnum == 199401)
+               & df.lo_discount.between(4, 6) & df.lo_quantity.between(26, 35)]
+        return [[(x.lo_extendedprice * x.lo_discount).sum()]]
+    if qid == "q1.3":
+        x = df[(df.lo_orderdate_weeknuminyear == 6) & (df[y] == 1994)
+               & df.lo_discount.between(5, 7) & df.lo_quantity.between(26, 35)]
+        return [[(x.lo_extendedprice * x.lo_discount).sum()]]
+    if qid in ("q2.1", "q2.2", "q2.3"):
+        if qid == "q2.1":
+            x = df[(df.p_category == "MFGR#12") & (df.s_region == "AMERICA")]
+        elif qid == "q2.2":
+            x = df[(df.p_brand >= "MFGR#2221") & (df.p_brand <= "MFGR#2228")
+                   & (df.s_region == "ASIA")]
+        else:
+            x = df[(df.p_brand == "MFGR#2239") & (df.s_region == "EUROPE")]
+        g = x.groupby([y, "p_brand"], as_index=False).agg(r=("lo_revenue", "sum"))
+        g = g.sort_values([y, "p_brand"])
+        return [[r.r, getattr(r, y), r.p_brand] for r in g.itertuples(index=False)]
+    if qid in ("q3.1", "q3.2", "q3.3", "q3.4"):
+        if qid == "q3.1":
+            x = df[(df.c_region == "ASIA") & (df.s_region == "ASIA") & df[y].between(1992, 1997)]
+            keys = ["c_nation", "s_nation"]
+        elif qid == "q3.2":
+            x = df[(df.c_nation == "UNITED STATES") & (df.s_nation == "UNITED STATES")
+                   & df[y].between(1992, 1997)]
+            keys = ["c_city", "s_city"]
+        elif qid == "q3.3":
+            x = df[df.c_city.isin(["UNITED KI1", "UNITED KI5"])
+                   & df.s_city.isin(["UNITED KI1", "UNITED KI5"])
+                   & df[y].between(1992, 1997)]
+            keys = ["c_city", "s_city"]
+        else:
+            x = df[df.c_city.isin(["UNITED KI1", "UNITED KI5"])
+                   & df.s_city.isin(["UNITED KI1", "UNITED KI5"])
+                   & (df.lo_orderdate_yearmonth == "Dec1997")]
+            keys = ["c_city", "s_city"]
+        g = x.groupby(keys + [y], as_index=False).agg(r=("lo_revenue", "sum"))
+        g = g.sort_values([y, "r"], ascending=[True, False])
+        return [[*(getattr(r, k) for k in keys), getattr(r, y), r.r]
+                for r in g.itertuples(index=False)]
+    # q4.x
+    if qid == "q4.1":
+        x = df[(df.c_region == "AMERICA") & (df.s_region == "AMERICA")
+               & df.p_mfgr.isin(["MFGR#1", "MFGR#2"])]
+        keys = [y, "c_nation"]
+    elif qid == "q4.2":
+        x = df[(df.c_region == "AMERICA") & (df.s_region == "AMERICA")
+               & df[y].isin([1997, 1998]) & df.p_mfgr.isin(["MFGR#1", "MFGR#2"])]
+        keys = [y, "s_nation", "p_category"]
+    else:
+        x = df[(df.s_nation == "UNITED STATES") & df[y].isin([1997, 1998])
+               & (df.p_category == "MFGR#14")]
+        keys = [y, "s_city", "p_brand"]
+    g = x.assign(p=x.lo_revenue - x.lo_supplycost).groupby(keys, as_index=False).agg(
+        profit=("p", "sum"))
+    g = g.sort_values(keys)
+    return [[*(getattr(r, k.replace(".", "_")) for k in keys), r.profit]
+            for r in g.itertuples(index=False)]
+
+
+@pytest.mark.parametrize("qid", sorted(FLAT_QUERIES))
+def test_ssb_flat(sess, qid):
+    got = sess.sql(FLAT_QUERIES[qid]).rows()
+    exp = _oracle(sess._flat, qid)
+    assert len(got) == len(exp), f"{qid}: {len(got)} vs {len(exp)} rows"
+    for i, (g, e) in enumerate(zip(got, exp)):
+        for gv, ev in zip(g, e):
+            if isinstance(ev, (int, float, np.floating, np.integer)):
+                ok = (gv is None and (ev != ev)) or abs(float(gv) - float(ev)) <= max(abs(float(ev)), 1) * 1e-9
+                assert ok, f"{qid} row {i}: {gv} vs {ev}"
+            else:
+                assert str(gv) == str(ev), f"{qid} row {i}: {gv!r} vs {ev!r}"

@@ -1,0 +1,90 @@
+"""Window function tests vs pandas (reference analog: be/test/exec analytic
+tests) + a TPC-DS Q67-shaped query (rank over rollup-style aggregates)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.storage.catalog import tpch_catalog
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session()
+    rng = np.random.default_rng(7)
+    n = 500
+    g = rng.integers(0, 12, n)
+    x = rng.integers(0, 40, n)
+    v = np.round(rng.normal(10, 3, n), 2)
+    s.sql("create table w (g int, x int, v double)")
+    rows = ", ".join(f"({a}, {b}, {c})" for a, b, c in zip(g, x, v))
+    s.sql(f"insert into w values {rows}")
+    s._df = pd.DataFrame({"g": g, "x": x, "v": v})
+    return s
+
+
+def test_row_number_rank_vs_pandas(sess):
+    r = sess.sql("""select g, x, v,
+        row_number() over (partition by g order by x, v) rn,
+        rank() over (partition by g order by x) rk
+        from w order by g, x, v""")
+    got = pd.DataFrame(r.rows(), columns=["g", "x", "v", "rn", "rk"])
+    df = sess._df.sort_values(["g", "x", "v"]).reset_index(drop=True)
+    exp_rn = df.groupby("g").cumcount() + 1
+    exp_rk = df.groupby("g")["x"].rank(method="min").astype(int)
+    np.testing.assert_array_equal(got["rn"], exp_rn)
+    np.testing.assert_array_equal(got["rk"], exp_rk)
+
+
+def test_partition_agg_vs_pandas(sess):
+    r = sess.sql("""select g, v, sum(v) over (partition by g) t,
+        avg(v) over (partition by g) a,
+        count(*) over (partition by g) c,
+        max(v) over (partition by g) mx
+        from w order by g, v""")
+    got = pd.DataFrame(r.rows(), columns=["g", "v", "t", "a", "c", "mx"])
+    df = sess._df.sort_values(["g", "v"]).reset_index(drop=True)
+    np.testing.assert_allclose(got["t"], df.groupby("g")["v"].transform("sum"), rtol=1e-9)
+    np.testing.assert_allclose(got["a"], df.groupby("g")["v"].transform("mean"), rtol=1e-9)
+    np.testing.assert_array_equal(got["c"], df.groupby("g")["v"].transform("size"))
+    np.testing.assert_allclose(got["mx"], df.groupby("g")["v"].transform("max"), rtol=1e-12)
+
+
+def test_running_sum_vs_pandas(sess):
+    r = sess.sql("""select g, x, sum(x) over (partition by g order by x) rs
+        from w order by g, x""")
+    got = pd.DataFrame(r.rows(), columns=["g", "x", "rs"])
+    df = sess._df.sort_values(["g", "x"]).reset_index(drop=True)
+    # RANGE frame: peers (equal x) share the value -> groupby cumsum per peer
+    exp = df.groupby("g")["x"].cumsum()
+    peers = df.groupby(["g", "x"])["x"].transform("size")
+    # compute peer-extended cumsum: last cumsum within each (g, x) group
+    exp_ext = df.assign(cs=exp).groupby(["g", "x"])["cs"].transform("max")
+    np.testing.assert_array_equal(got["rs"], exp_ext)
+
+
+def test_q67_shape(sess):
+    """TPC-DS Q67 shape: rank over grouped sums, filter rank <= k."""
+    s = Session(tpch_catalog(sf=0.01))
+    r = s.sql("""
+      select * from (
+        select l_returnflag, l_suppkey, sumqty,
+               rank() over (partition by l_returnflag order by sumqty desc) rk
+        from (select l_returnflag, l_suppkey, sum(l_quantity) sumqty
+              from lineitem group by l_returnflag, l_suppkey) agg
+      ) ranked
+      where rk <= 3
+      order by l_returnflag, rk, l_suppkey""")
+    rows = r.rows()
+    df = s.catalog.get_table("lineitem").table.to_pandas()
+    g = df.groupby(["l_returnflag", "l_suppkey"], as_index=False).agg(
+        sumqty=("l_quantity", "sum"))
+    g["rk"] = g.groupby("l_returnflag")["sumqty"].rank(method="min", ascending=False).astype(int)
+    exp = g[g.rk <= 3].sort_values(["l_returnflag", "rk", "l_suppkey"])
+    assert len(rows) == len(exp)
+    for got_row, exp_row in zip(rows, exp.itertuples(index=False)):
+        assert got_row[0] == exp_row.l_returnflag
+        assert got_row[1] == exp_row.l_suppkey
+        assert abs(got_row[2] - exp_row.sumqty) < 1e-6
+        assert got_row[3] == exp_row.rk
