@@ -1,0 +1,82 @@
+"""Typed config registry.
+
+Reference behavior: be/src/common/configbase.h:104 (macro-declared typed
+fields, file-loadable, runtime-mutable subset, introspectable — 823 options
+in common/config.h) and the FE's ~700 session variables serialized per-query
+(qe/SessionVariable.java). Here: one process-wide registry of declared,
+typed, default-valued options; mutable flags enforced; env/file overrides;
+SQL surface later via information_schema-style listing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass
+class ConfigField:
+    name: str
+    default: Any
+    type: type
+    mutable: bool
+    description: str
+    value: Any = None
+
+
+class ConfigRegistry:
+    def __init__(self):
+        self._fields: dict = {}
+
+    def define(self, name, default, mutable=True, description=""):
+        f = ConfigField(name, default, type(default), mutable, description, default)
+        self._fields[name] = f
+        return f
+
+    def get(self, name: str):
+        return self._fields[name].value
+
+    def set(self, name: str, value, force: bool = False):
+        f = self._fields.get(name)
+        if f is None:
+            raise KeyError(f"unknown config {name!r}")
+        if not f.mutable and not force:
+            raise PermissionError(f"config {name!r} is not runtime-mutable")
+        if f.type is bool and isinstance(value, str):
+            value = value.lower() in ("1", "true", "on", "yes")
+        f.value = f.type(value)
+
+    def load_env(self, prefix: str = "SR_TPU_"):
+        for name, f in self._fields.items():
+            env = prefix + name.upper()
+            if env in os.environ:
+                self.set(name, os.environ[env], force=True)
+
+    def load_file(self, path: str):
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                k, _, v = line.partition("=")
+                self.set(k.strip(), v.strip(), force=True)
+
+    def items(self):
+        return [
+            (f.name, f.value, f.default, f.mutable, f.description)
+            for f in self._fields.values()
+        ]
+
+
+config = ConfigRegistry()
+
+# --- engine options (the session-variable / config.h analog subset) ----------
+config.define("chunk_align", 1024, False, "row-capacity alignment for device chunks")
+config.define("default_agg_groups", 1024, True, "initial group capacity before adaptive recompile")
+config.define("max_recompiles", 6, True, "adaptive capacity recompile limit per query")
+config.define("join_expand_headroom", 1.2, True, "growth factor applied on capacity overflow")
+config.define("enable_zonemap_pruning", True, True, "prune parquet rowsets by zonemap stats")
+config.define("bench_sf", 1.0, True, "scale factor used by bench.py")
+config.define("profile_queries", True, True, "collect RuntimeProfile for every query")
+config.load_env()

@@ -27,13 +27,14 @@ from ..sql.logical import (
 )
 from ..sql.optimizer import optimize
 from ..sql.physical import Caps, compile_plan
+from .config import config
+from .failpoint import fail_point
+from .metrics import QUERIES_TOTAL, QUERY_ERRORS, RECOMPILES, ROWS_RETURNED
+from .profile import RuntimeProfile
 
 
 class ExecError(RuntimeError):
     pass
-
-
-MAX_RECOMPILES = 6
 
 
 class DeviceCache:
@@ -83,6 +84,7 @@ class DeviceCache:
 class QueryResult:
     table: HostTable
     plan: LogicalPlan
+    profile: object = None
 
     def rows(self):
         return self.table.to_pylist()
@@ -101,14 +103,25 @@ class Executor:
         self.cache = device_cache or DeviceCache()
 
     # --- public --------------------------------------------------------------
-    def execute_logical(self, plan: LogicalPlan) -> QueryResult:
-        plan = optimize(plan, self.catalog)
-        plan = self._resolve_scalar_subqueries(plan)
-        out_chunk = self._run(plan)
-        ht = HostTable.from_chunk(out_chunk)
-        # strip alias qualifiers for final output names where unambiguous
-        ht = _prettify_names(ht)
-        return QueryResult(ht, plan)
+    def execute_logical(
+        self, plan: LogicalPlan, profile: RuntimeProfile | None = None
+    ) -> QueryResult:
+        profile = profile or RuntimeProfile("query")
+        QUERIES_TOTAL.inc()
+        try:
+            with profile.timer("optimize"):
+                plan = optimize(plan, self.catalog)
+                plan = self._resolve_scalar_subqueries(plan)
+            out_chunk = self._run(plan, profile)
+            with profile.timer("fetch_results"):
+                ht = HostTable.from_chunk(out_chunk)
+                # strip alias qualifiers for final output names where unambiguous
+                ht = _prettify_names(ht)
+            ROWS_RETURNED.inc(ht.num_rows)
+            return QueryResult(ht, plan, profile)
+        except Exception:
+            QUERY_ERRORS.inc()
+            raise
 
     # --- subqueries ----------------------------------------------------------
     def _resolve_scalar_subqueries(self, plan: LogicalPlan) -> LogicalPlan:
@@ -163,30 +176,54 @@ class Executor:
                 )
             if isinstance(p, LLimit):
                 return LLimit(rec(p.child), p.limit, p.offset)
+            from ..sql.logical import LWindow
+
+            if isinstance(p, LWindow):
+                return LWindow(
+                    rec(p.child),
+                    tuple(fix_expr(x) for x in p.partition_by),
+                    tuple((fix_expr(e), a, nf) for e, a, nf in p.order_by),
+                    tuple(
+                        (n, fn, fix_expr(a) if a is not None else None)
+                        for n, fn, a in p.funcs
+                    ),
+                )
             return p
 
         return rec(plan)
 
     # --- execution with adaptive recompile ------------------------------------
-    def _run(self, plan: LogicalPlan) -> Chunk:
+    def _run(self, plan: LogicalPlan, profile: RuntimeProfile | None = None) -> Chunk:
+        profile = profile or RuntimeProfile("query")
         caps = Caps({})
-        for attempt in range(MAX_RECOMPILES):
-            compiled = compile_plan(plan, self.catalog, caps)
-            inputs = tuple(
-                self.cache.chunk_for(self.catalog.get_table(t), a, cols)
-                for t, a, cols in compiled.scans
-            )
-            fn = jax.jit(compiled.fn)
-            out, checks = fn(inputs)
+        max_recompiles = config.get("max_recompiles")
+        headroom = config.get("join_expand_headroom")
+        fail_point("executor::before_run")
+        for attempt in range(max_recompiles):
+            p = profile.child(f"attempt_{attempt}")
+            with p.timer("compile_and_run"):
+                compiled = compile_plan(plan, self.catalog, caps)
+                with p.timer("scan_to_device"):
+                    inputs = tuple(
+                        self.cache.chunk_for(self.catalog.get_table(t), a, cols)
+                        for t, a, cols in compiled.scans
+                    )
+                fn = jax.jit(compiled.fn)
+                out, checks = fn(inputs)
+                jax.block_until_ready(out.data)
+            p.set_info("capacities", dict(caps.values))
             overflow = False
             for key, value in zip(compiled.checks_meta, checks):
                 v = int(value)
                 if v > caps.values[key]:
-                    caps.values[key] = pad_capacity(int(v * 1.2) + 1)
+                    caps.values[key] = pad_capacity(int(v * headroom) + 1)
                     overflow = True
             if not overflow:
+                profile.add_counter("recompiles", attempt)
                 return out
-        raise ExecError(f"capacity did not converge after {MAX_RECOMPILES} recompiles")
+            RECOMPILES.inc()
+            fail_point("executor::before_recompile")
+        raise ExecError(f"capacity did not converge after {max_recompiles} recompiles")
 
 
 def _prettify_names(ht: HostTable) -> HostTable:

@@ -1,0 +1,78 @@
+"""Failpoints: runtime-toggleable fault injection.
+
+Reference behavior: be/src/base/failpoint/fail_point.h:21 (named failpoints
+toggled at runtime via RPC, scripted by SQL regression suites). Here: a
+process-wide registry; `fail_point(name)` is compiled into host-side code
+paths and raises / calls the injected action when armed. Tests use
+`scoped(name, ...)`.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class FailPointError(RuntimeError):
+    pass
+
+
+class _Registry:
+    def __init__(self):
+        self._armed: dict = {}
+        self._hits: dict = {}
+        self._lock = threading.Lock()
+
+    def arm(self, name: str, action=None, times: int | None = None):
+        """action: None -> raise FailPointError; callable -> invoked."""
+        with self._lock:
+            self._armed[name] = {"action": action, "times": times}
+
+    def disarm(self, name: str):
+        with self._lock:
+            self._armed.pop(name, None)
+
+    def hit(self, name: str):
+        with self._lock:
+            self._hits[name] = self._hits.get(name, 0) + 1
+            ent = self._armed.get(name)
+            if ent is None:
+                return
+            if ent["times"] is not None:
+                if ent["times"] <= 0:
+                    return
+                ent["times"] -= 1
+        if ent["action"] is None:
+            raise FailPointError(f"failpoint {name!r} triggered")
+        ent["action"]()
+
+    def hits(self, name: str) -> int:
+        return self._hits.get(name, 0)
+
+    def list(self):
+        return sorted(self._armed)
+
+
+_registry = _Registry()
+
+
+def fail_point(name: str):
+    """Insert into host code paths: no-op unless armed."""
+    _registry.hit(name)
+
+
+def arm(name: str, action=None, times=None):
+    _registry.arm(name, action, times)
+
+
+def disarm(name: str):
+    _registry.disarm(name)
+
+
+@contextmanager
+def scoped(name: str, action=None, times=None):
+    arm(name, action, times)
+    try:
+        yield
+    finally:
+        disarm(name)

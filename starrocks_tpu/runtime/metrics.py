@@ -1,0 +1,68 @@
+"""Metric registry with Prometheus text rendering.
+
+Reference behavior: be/src/base/metrics.h:354 (MetricRegistry + typed
+counters/gauges, Prometheus endpoint http/action/metrics_action.h) and FE
+MetricRepo.java:120. Process-wide registry; the HTTP surface can serve
+`render_prometheus()` verbatim.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Counter:
+    def __init__(self, name, help_=""):
+        self.name = name
+        self.help = help_
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self):
+        return self._v
+
+
+class Gauge(Counter):
+    def set(self, v):
+        with self._lock:
+            self._v = v
+
+
+class MetricRegistry:
+    def __init__(self):
+        self._metrics: dict = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._metrics.setdefault(name, Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = Gauge(name, help_)
+        return m
+
+    def render_prometheus(self) -> str:
+        out = []
+        for name, m in sorted(self._metrics.items()):
+            kind = "gauge" if isinstance(m, Gauge) else "counter"
+            if m.help:
+                out.append(f"# HELP {name} {m.help}")
+            out.append(f"# TYPE {name} {kind}")
+            out.append(f"{name} {m.value}")
+        return "\n".join(out) + "\n"
+
+
+metrics = MetricRegistry()
+
+QUERIES_TOTAL = metrics.counter("sr_tpu_queries_total", "queries executed")
+QUERY_ERRORS = metrics.counter("sr_tpu_query_errors_total", "queries failed")
+ROWS_RETURNED = metrics.counter("sr_tpu_rows_returned_total", "result rows")
+RECOMPILES = metrics.counter(
+    "sr_tpu_capacity_recompiles_total", "adaptive capacity recompiles"
+)
+ROWS_LOADED = metrics.counter("sr_tpu_rows_loaded_total", "rows ingested")

@@ -1,0 +1,65 @@
+"""RuntimeProfile: hierarchical per-query counters/timers.
+
+Reference behavior: be/src/common/runtime_profile.h:101 (tree of counters and
+timers per operator instance, reported to the FE and rendered by
+SHOW PROFILE / EXPLAIN ANALYZE). In the compiled TPU world per-operator
+device timing lives inside one fused XLA program, so the profile tracks the
+phases that exist at host level — parse/analyze/optimize/compile (per
+recompile attempt)/execute/fetch — plus operator-level static facts
+(capacities, overflow retries, scan stats) and device step timings.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class RuntimeProfile:
+    def __init__(self, name: str):
+        self.name = name
+        self.counters: dict = {}
+        self.infos: dict = {}
+        self.children: list = []
+
+    def child(self, name: str) -> "RuntimeProfile":
+        c = RuntimeProfile(name)
+        self.children.append(c)
+        return c
+
+    def add_counter(self, name: str, value, unit: str = ""):
+        self.counters[name] = (self.counters.get(name, (0, unit))[0] + value, unit)
+
+    def set_info(self, name: str, value):
+        self.infos[name] = value
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_counter(name, time.perf_counter() - t0, "s")
+
+    def render(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        out = [f"{pad}{self.name}:"]
+        for k, v in self.infos.items():
+            out.append(f"{pad}  - {k}: {v}")
+        for k, (v, unit) in sorted(self.counters.items()):
+            if unit == "s":
+                out.append(f"{pad}  - {k}: {v * 1000:.2f}ms")
+            else:
+                out.append(f"{pad}  - {k}: {v}{unit}")
+        for c in self.children:
+            out.append(c.render(indent + 1))
+        return "\n".join(out)
+
+    def find(self, name: str):
+        if self.name == name:
+            return self
+        for c in self.children:
+            r = c.find(name)
+            if r is not None:
+                return r
+        return None

@@ -8,6 +8,8 @@ does (server/LocalMetastore.java:301), minus replication (storage layer).
 
 from __future__ import annotations
 
+from dataclasses import replace as dataclasses_replace
+
 import numpy as np
 
 from .. import types as T
@@ -22,9 +24,91 @@ from .executor import DeviceCache, Executor, QueryResult
 
 
 class Session:
-    def __init__(self, catalog: Catalog | None = None):
+    """data_dir=None -> in-memory tables only; with a data_dir, DDL and loads
+    persist through the TabletStore (bucketed parquet rowsets + edit log) and
+    the catalog is rebuilt by edit-log replay on startup (the
+    EditLog/loadImage analog, fe persist/EditLog.java:133)."""
+
+    def __init__(self, catalog: Catalog | None = None, data_dir: str | None = None):
         self.catalog = catalog or Catalog()
         self.cache = DeviceCache()
+        self.store = None
+        if data_dir is not None:
+            from ..storage.store import TabletStore, schema_from_json
+            from ..storage.catalog import StoredTableHandle
+
+            self.store = TabletStore(data_dir)
+            # replay: the manifest set is authoritative for current tables
+            for name in self.store.table_names():
+                m = self.store.read_manifest(name)
+                self.catalog.register_handle(
+                    StoredTableHandle(
+                        name, self.store, schema_from_json(m["schema"]),
+                        [tuple(k) for k in m.get("unique_keys", [])],
+                    )
+                )
+
+    def load_csv(self, table: str, path: str, **csv_opts) -> int:
+        """Stream-load a CSV file into a table (reference: stream load path,
+        http/action/stream_load.h:59 -> DeltaWriter). Simple unquoted CSVs go
+        through the native C++ parser; anything else falls back to pyarrow."""
+        handle = self.catalog.get_table(table)
+        if handle is None:
+            raise ValueError(f"unknown table {table}")
+        incoming = None
+        if not csv_opts:
+            incoming = self._load_csv_native(handle, path)
+        if incoming is None:
+            import pyarrow.csv as pacsv
+
+            names = [f.name for f in handle.schema]
+            opts = pacsv.ReadOptions(column_names=names, **csv_opts)
+            arrow = pacsv.read_csv(path, read_options=opts)
+            incoming = HostTable.from_arrow(arrow)
+        from .metrics import ROWS_LOADED
+
+        ROWS_LOADED.inc(incoming.num_rows)
+        return self._append(handle, incoming)
+
+    def _load_csv_native(self, handle, path: str):
+        from .. import native
+
+        type_map = []
+        for f in handle.schema:
+            if f.type.is_string:
+                type_map.append(native.CSV_STRING)
+            elif f.type.is_float or f.type.is_decimal:
+                type_map.append(native.CSV_FLOAT64)
+            elif f.type.kind is T.TypeKind.DATE:
+                type_map.append(native.CSV_DATE)
+            elif f.type.is_integer or f.type.kind is T.TypeKind.BOOLEAN:
+                type_map.append(native.CSV_INT64)
+            else:
+                return None
+        with open(path, "rb") as fh:
+            data = fh.read()
+        if b'"' in data:
+            return None  # quoted CSV -> pyarrow path
+        res = native.parse_csv(data, type_map)
+        if res is None:
+            return None
+        cols, masks, n = res
+        out, valids, types = {}, {}, {}
+        for f, c, m in zip(handle.schema, cols, masks):
+            types[f.name] = f.type
+            out[f.name] = c
+            if not m.all():
+                if not f.nullable:
+                    raise ValueError(
+                        f"CSV load: NULL value in NOT NULL column {f.name!r}"
+                    )
+                valids[f.name] = m
+        ht = HostTable.from_pydict(
+            {k: (list(v) if v.dtype == object else v) for k, v in out.items()},
+            types=types,
+        )
+        ht.valids.update(valids)
+        return ht
 
     def sql(self, text: str):
         stmt = parse(text)
@@ -35,8 +119,11 @@ class Session:
         if isinstance(stmt, ast.CreateTable):
             return self._create(stmt)
         if isinstance(stmt, ast.DropTable):
+            existed = self.catalog.get_table(stmt.name) is not None
             self.catalog.drop(stmt.name, stmt.if_exists)
             self.cache.invalidate(stmt.name.lower())
+            if self.store is not None and existed:
+                self.store.drop_table(stmt.name.lower())
             return None
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
@@ -44,11 +131,21 @@ class Session:
 
     # --- SELECT ---------------------------------------------------------------
     def _query(self, sel: ast.Select) -> QueryResult:
-        plan = Analyzer(self.catalog).analyze(sel)
-        return Executor(self.catalog, self.cache).execute_logical(plan)
+        from .profile import RuntimeProfile
+
+        profile = RuntimeProfile("query")
+        with profile.timer("analyze"):
+            plan = Analyzer(self.catalog).analyze(sel)
+        res = Executor(self.catalog, self.cache).execute_logical(plan, profile)
+        self.last_profile = res.profile
+        return res
 
     def _explain(self, stmt: ast.Explain) -> str:
         assert isinstance(stmt.stmt, ast.Select), "EXPLAIN supports SELECT"
+        if stmt.analyze:
+            res = self._query(stmt.stmt)
+            # res.plan is the actually-executed optimized plan
+            return plan_tree_str(res.plan) + "\n" + res.profile.render()
         plan = Analyzer(self.catalog).analyze(stmt.stmt)
         plan = optimize(plan, self.catalog)
         return plan_tree_str(plan)
@@ -61,10 +158,22 @@ class Session:
             d = StringDict.from_values([]) if t.is_string else None
             fields.append(Field(c.name, t, c.nullable, d))
             arrays[c.name] = np.zeros(0, dtype=t.np_dtype)
-        ht = HostTable(Schema(tuple(fields)), arrays, {})
+        schema = Schema(tuple(fields))
         # DISTRIBUTED BY HASH is bucketing, NOT a uniqueness guarantee, so it
         # must not feed unique_keys; key-model DDL (PRIMARY/UNIQUE KEY) will
-        self.catalog.register(stmt.name, ht, unique_keys=())
+        if self.store is not None:
+            from ..storage.catalog import StoredTableHandle
+
+            name = stmt.name.lower()
+            self.store.create_table(
+                name, schema, stmt.distributed_by, stmt.buckets or 1
+            )
+            self.catalog.register_handle(
+                StoredTableHandle(name, self.store, schema)
+            )
+        else:
+            ht = HostTable(schema, arrays, {})
+            self.catalog.register(stmt.name, ht, unique_keys=())
         return None
 
     def _insert(self, stmt: ast.Insert):
@@ -74,12 +183,46 @@ class Session:
         if stmt.select is not None:
             res = self._query(stmt.select)
             incoming = res.table
+            # INSERT .. SELECT maps columns positionally
+            target = stmt.columns or tuple(f.name for f in handle.schema)
+            if len(incoming.schema) != len(target):
+                raise ValueError(
+                    f"INSERT arity mismatch: {len(incoming.schema)} select "
+                    f"columns vs {len(target)} target columns"
+                )
+            incoming = HostTable(
+                Schema(tuple(
+                    dataclasses_replace(f, name=t)
+                    for f, t in zip(incoming.schema.fields, target)
+                )),
+                {t: incoming.arrays[f.name] for f, t in zip(incoming.schema.fields, target)},
+                {t: incoming.valids[f.name]
+                 for f, t in zip(incoming.schema.fields, target)
+                 if f.name in incoming.valids},
+            )
         else:
             incoming = self._values_to_table(handle, stmt)
-        merged = concat_tables(handle.table, incoming, target_schema=handle.schema)
-        self.catalog.register(handle.name, merged, handle.unique_keys)
+        return self._append(handle, incoming)
+
+    def _append(self, handle, incoming: HostTable) -> int:
+        from ..storage.catalog import StoredTableHandle
+
+        if self.store is not None and isinstance(handle, StoredTableHandle):
+            # conform incoming data to the declared schema before persisting
+            empty = HostTable(
+                handle.schema,
+                {f.name: np.zeros(0, dtype=f.type.np_dtype) for f in handle.schema},
+                {},
+            )
+            conformed = concat_tables(empty, incoming, target_schema=handle.schema)
+            n = self.store.insert(handle.name, conformed)
+            handle.invalidate()
+        else:
+            merged = concat_tables(handle.table, incoming, target_schema=handle.schema)
+            self.catalog.register(handle.name, merged, handle.unique_keys)
+            n = incoming.num_rows
         self.cache.invalidate(handle.name)
-        return incoming.num_rows
+        return n
 
     def _values_to_table(self, handle, stmt: ast.Insert) -> HostTable:
         cols = stmt.columns or tuple(f.name for f in handle.schema)

@@ -26,10 +26,14 @@ class ColumnStats:
 class TableHandle:
     def __init__(self, name: str, table: HostTable, unique_keys=()):
         self.name = name
-        self.table = table
+        self._table = table
         # tuple of key-column tuples each of which is unique per row
         self.unique_keys = tuple(tuple(k) for k in unique_keys)
         self._stats: dict = {}
+
+    @property
+    def table(self) -> HostTable:
+        return self._table
 
     @property
     def schema(self) -> Schema:
@@ -51,12 +55,40 @@ class TableHandle:
         return self._stats[col]
 
 
+class StoredTableHandle(TableHandle):
+    """Lazy handle over a TabletStore table (loads + caches on first read).
+
+    The declared schema is available without touching data files."""
+
+    def __init__(self, name: str, store, schema: Schema, unique_keys=()):
+        super().__init__(name, None, unique_keys)
+        self.store = store
+        self._schema = schema
+
+    @property
+    def table(self) -> HostTable:
+        if self._table is None:
+            self._table = self.store.load_table(self.name)
+        return self._table
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def invalidate(self):
+        self._table = None
+        self._stats = {}
+
+
 class Catalog:
     def __init__(self):
         self.tables: dict = {}
 
     def register(self, name: str, table: HostTable, unique_keys=()):
         self.tables[name.lower()] = TableHandle(name.lower(), table, unique_keys)
+
+    def register_handle(self, handle: TableHandle):
+        self.tables[handle.name] = handle
 
     def drop(self, name: str, if_exists: bool = False):
         if name.lower() not in self.tables:

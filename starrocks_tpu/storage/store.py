@@ -1,0 +1,391 @@
+"""Persistent tablet store: bucketed parquet rowsets + manifest + edit log.
+
+Reference behavior re-designed (SURVEY §2.1 storage rows):
+- StorageEngine/Tablet/Rowset (be/src/storage/storage_engine.h:133,
+  tablet.h:84, rowset/rowset.h:143): a table = N hash buckets ("tablets");
+  every INSERT produces an immutable *rowset* = one parquet file per
+  non-empty bucket. Parquet replaces the custom segment format (v2 columnar
+  encodings, dict pages, stats) — the lake-style object-store-first choice
+  from SURVEY §7 step 7.
+- zonemap indexes (storage/rowset/zone_map_index*): per-file min/max stats
+  recorded in the manifest; scans prune files by predicate.
+- FE EditLog/BDB-JE journal (fe persist/EditLog.java:133): an append-only
+  JSONL edit log records DDL/load ops; catalog state is rebuilt by replay
+  (image checkpointing can compact it later).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from .. import types as T
+from ..column import Field, HostTable, Schema, StringDict
+from ..exprs.ir import Call, Col, Expr, InList, Lit
+
+
+def _type_to_json(t: T.LogicalType) -> dict:
+    return {"kind": t.kind.value, "precision": t.precision, "scale": t.scale}
+
+
+def _type_from_json(d: dict) -> T.LogicalType:
+    return T.LogicalType(T.TypeKind(d["kind"]), d.get("precision"), d.get("scale"))
+
+
+def schema_to_json(schema: Schema) -> list:
+    return [
+        {"name": f.name, "type": _type_to_json(f.type), "nullable": f.nullable}
+        for f in schema
+    ]
+
+
+def schema_from_json(items: list) -> Schema:
+    fields = []
+    for it in items:
+        t = _type_from_json(it["type"])
+        d = StringDict.from_values([]) if t.is_string else None
+        fields.append(Field(it["name"], t, it["nullable"], d))
+    return Schema(tuple(fields))
+
+
+class TabletStore:
+    """Directory layout:
+    root/edit_log.jsonl
+    root/<table>/manifest.json
+    root/<table>/rowset_<n>_bucket_<b>.parquet
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.log_path = os.path.join(root, "edit_log.jsonl")
+
+    # --- edit log ------------------------------------------------------------
+    def log(self, op: dict):
+        with open(self.log_path, "a") as f:
+            f.write(json.dumps(op) + "\n")
+
+    def replay(self):
+        """Yield logged ops in order (catalog rebuild)."""
+        if not os.path.exists(self.log_path):
+            return
+        with open(self.log_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
+    # --- table lifecycle ------------------------------------------------------
+    def _tdir(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    def _manifest_path(self, name: str) -> str:
+        return os.path.join(self._tdir(name), "manifest.json")
+
+    def read_manifest(self, name: str) -> dict:
+        with open(self._manifest_path(name)) as f:
+            return json.load(f)
+
+    def _write_manifest(self, name: str, m: dict):
+        tmp = self._manifest_path(name) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(m, f, indent=1)
+        os.replace(tmp, self._manifest_path(name))
+
+    def create_table(
+        self, name: str, schema: Schema, distribution=(), buckets: int = 1,
+        unique_keys=(), record: bool = True,
+    ):
+        os.makedirs(self._tdir(name), exist_ok=True)
+        m = {
+            "name": name,
+            "schema": schema_to_json(schema),
+            "distribution": list(distribution),
+            "buckets": max(buckets, 1),
+            "unique_keys": [list(k) for k in unique_keys],
+            "rowsets": [],
+            "next_rowset": 0,
+        }
+        self._write_manifest(name, m)
+        if record:
+            self.log({"op": "create", "table": name, "schema": schema_to_json(schema),
+                      "distribution": list(distribution), "buckets": max(buckets, 1),
+                      "unique_keys": [list(k) for k in unique_keys]})
+
+    def drop_table(self, name: str, record: bool = True):
+        tdir = self._tdir(name)
+        if os.path.isdir(tdir):
+            for f in os.listdir(tdir):
+                os.remove(os.path.join(tdir, f))
+            os.rmdir(tdir)
+        if record:
+            self.log({"op": "drop", "table": name})
+
+    def table_names(self):
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isdir(self._tdir(d))
+            and os.path.exists(self._manifest_path(d))
+        )
+
+    # --- write path -----------------------------------------------------------
+    def insert(self, name: str, data: HostTable, record: bool = True) -> int:
+        """Append a rowset: hash-bucket rows, write one parquet per bucket,
+        record zonemaps. Mirrors MemTable flush -> segment files
+        (be/src/storage/memtable.h:77 -> rowset commit)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from ..native import hash_partition_i64
+
+        m = self.read_manifest(name)
+        nb = m["buckets"]
+        dist = m["distribution"]
+        n = data.num_rows
+        if dist and nb > 1:
+            if len(dist) == 1:
+                bucket = hash_partition_i64(
+                    np.asarray(data.arrays[dist[0]], dtype=np.int64), nb
+                ).astype(np.int64)
+            else:
+                h = np.zeros(n, dtype=np.uint64)
+                for c in dist:
+                    a = np.asarray(data.arrays[c], dtype=np.int64).view(np.uint64)
+                    am = a * np.uint64(0x9E3779B97F4A7C15)
+                    z = (am ^ (am >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+                    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+                    h = h ^ (z ^ (z >> np.uint64(31)))
+                bucket = (h % np.uint64(nb)).astype(np.int64)
+        else:
+            bucket = np.zeros(n, dtype=np.int64)
+
+        rid = m["next_rowset"]
+        files = []
+        table = _to_arrow(data)
+        for b in range(nb):
+            sel = bucket == b
+            rows = int(sel.sum())
+            if rows == 0:
+                continue
+            part = table.filter(pa.array(sel))
+            fname = f"rowset_{rid}_bucket_{b}.parquet"
+            pq.write_table(part, os.path.join(self._tdir(name), fname))
+            files.append({
+                "file": fname,
+                "bucket": b,
+                "rows": rows,
+                "zonemap": _zonemap(data, sel),
+            })
+        m["rowsets"].append({"id": rid, "files": files, "rows": n})
+        m["next_rowset"] = rid + 1
+        self._write_manifest(name, m)
+        if record:
+            self.log({"op": "insert", "table": name, "rowset": rid, "rows": n})
+        return n
+
+    # --- read path ------------------------------------------------------------
+    def load_table(
+        self, name: str, columns=None, predicate: Optional[Expr] = None
+    ) -> HostTable:
+        """Read the table (optionally only some columns), pruning files whose
+        zonemaps prove the predicate false (segment zonemap filtering analog)."""
+        import pyarrow.parquet as pq
+
+        from ..runtime.config import config
+
+        m = self.read_manifest(name)
+        schema = schema_from_json(m["schema"])
+        prune_enabled = config.get("enable_zonemap_pruning")
+        paths = []
+        total, pruned = 0, 0
+        for rs in m["rowsets"]:
+            for fmeta in rs["files"]:
+                total += 1
+                if prune_enabled and predicate is not None and _zonemap_excludes(
+                    fmeta["zonemap"], predicate
+                ):
+                    pruned += 1
+                    continue
+                paths.append(os.path.join(self._tdir(name), fmeta["file"]))
+        self.last_scan_stats = {"files": total, "pruned": pruned}
+        if not paths:
+            # empty table with correct schema
+            sub = schema if columns is None else Schema(
+                tuple(schema.field(c) for c in columns)
+            )
+            return HostTable(
+                sub, {f.name: np.zeros(0, dtype=f.type.np_dtype) for f in sub}, {}
+            )
+        import pyarrow as pa
+
+        tables = [pq.read_table(p, columns=list(columns) if columns else None)
+                  for p in paths]
+        merged = pa.concat_tables(tables, promote_options="default")
+        ht = HostTable.from_arrow(merged)
+        # re-type to declared schema (decimals/dates read back as declared)
+        return _conform(ht, schema, columns)
+
+
+def _to_arrow(data: HostTable):
+    import pyarrow as pa
+
+    arrays, names = [], []
+    for f in data.schema:
+        a = data.arrays[f.name]
+        v = data.valids.get(f.name)
+        mask = None if v is None else ~v
+        if f.type.is_string and f.dict is not None:
+            vals = f.dict.decode(a)
+            arrays.append(pa.array(vals.tolist(), type=pa.string(),
+                                   mask=mask))
+        elif f.type.is_decimal:
+            arrays.append(pa.array(a, type=pa.int64(), mask=mask))
+        elif f.type.kind is T.TypeKind.DATE:
+            arrays.append(pa.array(a, type=pa.date32(), mask=mask))
+        elif f.type.kind is T.TypeKind.DATETIME:
+            arrays.append(pa.array(a, type=pa.timestamp("us"), mask=mask))
+        else:
+            arrays.append(pa.array(a, mask=mask))
+        names.append(f.name)
+    return pa.table(dict(zip(names, arrays)))
+
+
+def _conform(ht: HostTable, schema: Schema, columns) -> HostTable:
+    fields = [schema.field(c) for c in (columns or schema.names)]
+    out_fields, arrays, valids = [], {}, {}
+    for f in fields:
+        got = ht.schema.field(f.name)
+        a = ht.arrays[f.name]
+        if f.type.is_string:
+            out_fields.append(Field(f.name, f.type, f.nullable, got.dict))
+        else:
+            # decimals were stored as raw scaled int64; keep as-is
+            out_fields.append(Field(f.name, f.type, f.nullable, None))
+            a = a.astype(f.type.np_dtype)
+        arrays[f.name] = a
+        if f.name in ht.valids:
+            valids[f.name] = ht.valids[f.name]
+    return HostTable(Schema(tuple(out_fields)), arrays, valids)
+
+
+# --- zonemaps ----------------------------------------------------------------
+
+
+def _zonemap(data: HostTable, sel: np.ndarray) -> dict:
+    """min/max per numeric/date column (+ dict-decoded strings lexicographic)."""
+    zm = {}
+    for f in data.schema:
+        a = data.arrays[f.name][sel]
+        if len(a) == 0:
+            continue
+        v = data.valids.get(f.name)
+        if v is not None:
+            mask = v[sel]
+            a = a[mask]
+            if len(a) == 0:
+                continue
+        if f.type.is_string and f.dict is not None:
+            lo = str(f.dict.values[int(a.min())]) if len(f.dict) else ""
+            hi = str(f.dict.values[int(a.max())]) if len(f.dict) else ""
+            zm[f.name] = {"min": lo, "max": hi, "str": True}
+        elif f.type.is_numeric or f.type.is_temporal:
+            ent = {"min": int(a.min()) if a.dtype.kind in "iub" else float(a.min()),
+                   "max": int(a.max()) if a.dtype.kind in "iub" else float(a.max())}
+            if f.type.is_decimal:
+                # stored values are scaled ints; record the scale so the
+                # comparator can scale logical literals before comparing
+                ent["scale"] = f.type.scale
+            zm[f.name] = ent
+    return zm
+
+
+def _lit_cmp_value(lit: Lit, ltype_hint=None):
+    v = lit.value
+    if isinstance(v, str):
+        return v
+    return v
+
+
+def _zonemap_excludes(zm: dict, predicate: Expr) -> bool:
+    """True only when the zonemap PROVES no row can satisfy the predicate.
+    Conservative: unknown shapes never exclude. Handles conjuncts of
+    col CMP literal (and literal CMP col) on zonemapped columns."""
+    for conj in _conjuncts_of(predicate):
+        if _conjunct_excludes(zm, conj):
+            return True
+    return False
+
+
+def _conjuncts_of(e: Expr):
+    if isinstance(e, Call) and e.fn == "and":
+        for a in e.args:
+            yield from _conjuncts_of(a)
+    else:
+        yield e
+
+
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
+
+
+def _conjunct_excludes(zm: dict, c: Expr) -> bool:
+    if isinstance(c, InList) and isinstance(c.arg, Col) and not c.negated:
+        ent = zm.get(_base(c.arg.name))
+        if ent is None:
+            return False
+        vals = [v for v in c.values if v is not None]
+        if not vals:
+            return False
+        if "scale" in ent:
+            if any(isinstance(v, str) for v in vals):
+                return False
+            vals = [v * (10 ** ent["scale"]) for v in vals]
+        try:
+            return all(v < ent["min"] or v > ent["max"] for v in vals)
+        except TypeError:
+            return False
+    if not (isinstance(c, Call) and c.fn in _FLIP and len(c.args) == 2):
+        return False
+    a, b = c.args
+    if isinstance(a, Lit) and isinstance(b, Col):
+        a, b = b, a
+        fn = _FLIP[c.fn]
+    elif isinstance(a, Col) and isinstance(b, Lit):
+        fn = c.fn
+    else:
+        return False
+    ent = zm.get(_base(a.name))
+    if ent is None or b.value is None:
+        return False
+    v = b.value
+    if b.type is not None and b.type.kind is T.TypeKind.DATE and isinstance(v, str):
+        import datetime
+
+        v = (datetime.date.fromisoformat(v) - datetime.date(1970, 1, 1)).days
+    if "scale" in ent:
+        # decimal zonemaps hold scaled ints; scale the logical literal
+        if isinstance(v, str):
+            return False
+        v = v * (10 ** ent["scale"])
+    lo, hi = ent["min"], ent["max"]
+    try:
+        if fn == "eq":
+            return v < lo or v > hi
+        if fn == "lt":
+            return lo >= v
+        if fn == "le":
+            return lo > v
+        if fn == "gt":
+            return hi <= v
+        if fn == "ge":
+            return hi < v
+    except TypeError:
+        return False
+    return False
+
+
+def _base(qualified: str) -> str:
+    return qualified.split(".", 1)[-1]

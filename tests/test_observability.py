@@ -1,0 +1,76 @@
+"""Profile / config / metrics / failpoint tests (reference analog:
+RuntimeProfile + configbase + metrics + failpoint behaviors, SURVEY §5)."""
+
+import pytest
+
+from starrocks_tpu.runtime import failpoint
+from starrocks_tpu.runtime.config import config
+from starrocks_tpu.runtime.metrics import QUERIES_TOTAL, metrics
+from starrocks_tpu.runtime.profile import RuntimeProfile
+from starrocks_tpu.runtime.session import Session
+
+
+def _sess():
+    s = Session()
+    s.sql("create table t (a int, b double)")
+    s.sql("insert into t values (1, 2.0), (2, 3.0), (1, 4.0)")
+    return s
+
+
+def test_profile_collected():
+    s = _sess()
+    r = s.sql("select a, sum(b) from t group by a")
+    prof = r.profile
+    assert prof is not None
+    assert "analyze" in prof.counters
+    assert prof.find("attempt_0") is not None
+    rendered = prof.render()
+    assert "compile_and_run" in rendered
+
+
+def test_explain_analyze():
+    s = _sess()
+    out = s.sql("explain analyze select a, sum(b) s from t group by a")
+    assert "Agg[" in out and "compile_and_run" in out
+
+
+def test_config_registry():
+    assert config.get("default_agg_groups") == 1024
+    config.set("max_recompiles", 4)
+    assert config.get("max_recompiles") == 4
+    config.set("max_recompiles", 6)
+    with pytest.raises(KeyError):
+        config.set("no_such_option", 1)
+    with pytest.raises(PermissionError):
+        config.set("chunk_align", 512)
+    items = dict((n, v) for n, v, *_ in config.items())
+    assert "enable_zonemap_pruning" in items
+
+
+def test_metrics_prometheus():
+    before = QUERIES_TOTAL.value
+    s = _sess()
+    s.sql("select count(*) c from t group by a > 0")
+    assert QUERIES_TOTAL.value > before
+    text = metrics.render_prometheus()
+    assert "# TYPE sr_tpu_queries_total counter" in text
+
+
+def test_failpoint_injection():
+    s = _sess()
+    with failpoint.scoped("executor::before_run"):
+        with pytest.raises(failpoint.FailPointError):
+            s.sql("select count(*) c from t group by a > 0")
+    # disarmed: works again
+    r = s.sql("select count(*) c from t group by a > 0")
+    assert r.rows() == [(3,)]
+    assert failpoint._registry.hits("executor::before_run") >= 2
+
+
+def test_failpoint_action_and_times():
+    calls = []
+    with failpoint.scoped("executor::before_run", action=lambda: calls.append(1), times=1):
+        s = _sess()
+        s.sql("select count(*) c from t group by a > 0")
+        s.sql("select count(*) c from t group by a > 0")
+    assert calls == [1]  # times=1 limited the injection

@@ -1,0 +1,139 @@
+"""Persistent storage tests: tablet store, edit-log replay, zonemap pruning,
+CSV load (reference analog: be/test/storage/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from starrocks_tpu import types as T
+from starrocks_tpu.column import HostTable
+from starrocks_tpu.exprs.ir import Call, Col, Lit
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.storage.store import TabletStore
+
+
+def test_create_insert_restart_roundtrip(tmp_path):
+    d = str(tmp_path / "db")
+    s = Session(data_dir=d)
+    s.sql("create table t (a int not null, b varchar, c decimal(10,2)) distributed by hash(a) buckets 4")
+    s.sql("insert into t values (1, 'x', 1.50), (2, 'y', 2.25), (3, 'x', 0.75)")
+    s.sql("insert into t values (4, 'z', 9.99)")
+    r = s.sql("select b, sum(c) sc from t group by b order by b")
+    assert r.rows() == [("x", 2.25), ("y", 2.25), ("z", 9.99)]
+
+    # restart: a fresh session over the same dir rebuilds the catalog
+    s2 = Session(data_dir=d)
+    r2 = s2.sql("select b, sum(c) sc from t group by b order by b")
+    assert r2.rows() == r.rows()
+    # files on disk are bucketed parquet rowsets
+    files = os.listdir(os.path.join(d, "t"))
+    assert any(f.endswith(".parquet") for f in files)
+    assert "manifest.json" in files
+
+    s2.sql("drop table t")
+    s3 = Session(data_dir=d)
+    with pytest.raises(Exception):
+        s3.sql("select * from t")
+
+
+def test_zonemap_pruning(tmp_path):
+    store = TabletStore(str(tmp_path / "z"))
+    ht1 = HostTable.from_pydict({"k": np.arange(0, 100), "v": np.arange(100) * 1.0})
+    ht2 = HostTable.from_pydict({"k": np.arange(1000, 1100), "v": np.arange(100) * 2.0})
+    from starrocks_tpu.column import Schema
+    store.create_table("t", ht1.schema, (), 1)
+    store.insert("t", ht1)
+    store.insert("t", ht2)
+
+    # predicate k > 500 excludes the first rowset by zonemap
+    pred = Call("gt", Col("t.k"), Lit(500))
+    out = store.load_table("t", predicate=pred)
+    assert store.last_scan_stats == {"files": 2, "pruned": 1}
+    assert out.num_rows == 100
+    assert int(out.arrays["k"].min()) == 1000
+
+    # eq inside range: nothing pruned
+    out2 = store.load_table("t", predicate=Call("eq", Col("t.k"), Lit(50)))
+    assert store.last_scan_stats["pruned"] == 1  # second rowset excluded
+    # impossible predicate prunes everything
+    out3 = store.load_table("t", predicate=Call("gt", Col("t.k"), Lit(10**6)))
+    assert store.last_scan_stats["pruned"] == 2
+    assert out3.num_rows == 0
+
+
+def test_nulls_and_strings_roundtrip(tmp_path):
+    d = str(tmp_path / "db2")
+    s = Session(data_dir=d)
+    s.sql("create table u (a int, b varchar)")
+    s.sql("insert into u values (1, 'aa'), (null, 'bb'), (3, null)")
+    s2 = Session(data_dir=d)
+    rows = s2.sql("select a, b from u order by a nulls first").rows()
+    assert rows == [(None, "bb"), (1, "aa"), (3, None)]
+
+
+def test_csv_load(tmp_path):
+    d = str(tmp_path / "db3")
+    csv = tmp_path / "data.csv"
+    csv.write_text("1,foo,2.5\n2,bar,3.5\n3,foo,4.5\n")
+    s = Session(data_dir=d)
+    s.sql("create table c (id int, name varchar, amt double)")
+    n = s.load_csv("c", str(csv))
+    assert n == 3
+    r = s.sql("select name, sum(amt) t from c group by name order by name")
+    assert r.rows() == [("bar", 3.5), ("foo", 7.0)]
+
+
+def test_insert_select_persisted(tmp_path):
+    d = str(tmp_path / "db4")
+    s = Session(data_dir=d)
+    s.sql("create table src (a int, b double)")
+    s.sql("insert into src values (1, 1.5), (2, 2.5), (3, 3.5)")
+    s.sql("create table dst (a int, b double)")
+    s.sql("insert into dst select a, b * 2 from src where a >= 2")
+    s2 = Session(data_dir=d)
+    assert s2.sql("select sum(b) s from dst group by a > 0").rows() == [(12.0,)]
+
+
+def test_native_kernels():
+    from starrocks_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    k = np.arange(100000, dtype=np.int64)
+    b = native.hash_partition_i64(k, 16)
+    counts = np.bincount(b, minlength=16)
+    assert counts.min() > 5000  # roughly uniform
+    # deterministic + matches the documented splitmix64 formula
+    z = k.view(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    np.testing.assert_array_equal(b, (z % np.uint64(16)).astype(np.int32))
+
+
+def test_native_csv_parse(tmp_path):
+    from starrocks_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    data = b"1,2.5,2020-01-02,hi\n2,,2021-03-04,yo\n"
+    cols, masks, n = native.parse_csv(
+        data, [native.CSV_INT64, native.CSV_FLOAT64, native.CSV_DATE, native.CSV_STRING]
+    )
+    assert n == 2
+    assert list(cols[0]) == [1, 2]
+    assert list(masks[1]) == [True, False]
+    assert list(cols[2]) == [18263, 18690]
+    assert list(cols[3]) == ["hi", "yo"]
+
+
+def test_csv_load_native_path(tmp_path):
+    d = str(tmp_path / "dbn")
+    csv = tmp_path / "n.csv"
+    csv.write_text("1,2020-01-02,2.5\n2,2020-01-03,\n")
+    s = Session(data_dir=d)
+    s.sql("create table n (id int, d date, amt double)")
+    assert s.load_csv("n", str(csv)) == 2
+    rows = s.sql("select id, d, amt from n order by id").rows()
+    assert rows == [(1, "2020-01-02", 2.5), (2, "2020-01-03", None)]
