@@ -38,7 +38,10 @@ class ExecError(RuntimeError):
 
 
 class DeviceCache:
-    """Per-(table, column) device arrays + valid masks (page-cache analog)."""
+    """Per-(table, column, placement) device arrays + valid masks (the page
+    cache analog). Placement None = single-device; (mesh, axis, "sharded"|
+    "replicated") = mesh placement for the distributed executor. One cache
+    instance per Session so DML invalidation covers every execution path."""
 
     def __init__(self):
         self._cols: dict = {}
@@ -46,19 +49,38 @@ class DeviceCache:
 
     def invalidate(self, table: str):
         self._cols = {k: v for k, v in self._cols.items() if k[0] != table}
-        self._caps.pop(table, None)
+        self._caps = {k: v for k, v in self._caps.items() if k[0] != table}
 
-    def chunk_for(self, handle, alias: str, columns) -> Chunk:
+    def chunk_for(self, handle, alias: str, columns, placement=None) -> Chunk:
         """Device chunk of the requested columns, renamed to alias-qualified."""
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         ht = handle.table
-        cap = self._caps.setdefault(handle.name, pad_capacity(ht.num_rows))
+        if placement is None:
+            tag, put, n_shards = "local", jnp.asarray, 1
+        else:
+            mesh, axis, mode = placement
+            tag = mode
+            n_shards = mesh.shape[axis] if mode == "sharded" else 1
+            spec = P(axis) if mode == "sharded" else P()
+            sharding = NamedSharding(mesh, spec)
+
+            def put(x):
+                return jax.device_put(x, sharding)
+
+        n = ht.num_rows
+        cap_key = (handle.name, tag)
+        if n_shards > 1:
+            default_cap = pad_capacity((n + n_shards - 1) // n_shards) * n_shards
+        else:
+            default_cap = pad_capacity(n)
+        cap = self._caps.setdefault(cap_key, default_cap)
         from ..column.column import Field, Schema
 
         fields, data, valid = [], [], []
         for c in columns:
-            key = (handle.name, c)
+            key = (handle.name, c, tag)
             if key not in self._cols:
                 a = ht.arrays[c]
                 if len(a) < cap:
@@ -66,17 +88,14 @@ class DeviceCache:
                 v = ht.valids.get(c)
                 if v is not None and len(v) < cap:
                     v = np.concatenate([v, np.zeros(cap - len(v), dtype=np.bool_)])
-                self._cols[key] = (
-                    jnp.asarray(a),
-                    None if v is None else jnp.asarray(v),
-                )
+                self._cols[key] = (put(a), None if v is None else put(v))
             d, v = self._cols[key]
             f = ht.schema.field(c)
             fields.append(dataclasses.replace(f, name=f"{alias}.{c}"))
             data.append(d)
             valid.append(v)
-        n = ht.num_rows
-        sel = None if n == cap else jnp.asarray(np.arange(cap) < n)
+        selv = np.arange(cap) < n
+        sel = put(selv) if (placement is not None or n != cap) else None
         return Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)
 
 
@@ -193,8 +212,11 @@ class Executor:
         return rec(plan)
 
     # --- execution with adaptive recompile ------------------------------------
-    def _run(self, plan: LogicalPlan, profile: RuntimeProfile | None = None) -> Chunk:
-        profile = profile or RuntimeProfile("query")
+    def _adaptive(self, profile: RuntimeProfile, attempt_fn) -> Chunk:
+        """Shared overflow-recompile loop (used by single-chip + distributed).
+
+        attempt_fn(caps, attempt_profile) -> (chunk, [(cap_key, true_count)]).
+        """
         caps = Caps({})
         max_recompiles = config.get("max_recompiles")
         headroom = config.get("join_expand_headroom")
@@ -202,19 +224,10 @@ class Executor:
         for attempt in range(max_recompiles):
             p = profile.child(f"attempt_{attempt}")
             with p.timer("compile_and_run"):
-                compiled = compile_plan(plan, self.catalog, caps)
-                with p.timer("scan_to_device"):
-                    inputs = tuple(
-                        self.cache.chunk_for(self.catalog.get_table(t), a, cols)
-                        for t, a, cols in compiled.scans
-                    )
-                fn = jax.jit(compiled.fn)
-                out, checks = fn(inputs)
-                jax.block_until_ready(out.data)
+                out, keyed_checks = attempt_fn(caps, p)
             p.set_info("capacities", dict(caps.values))
             overflow = False
-            for key, value in zip(compiled.checks_meta, checks):
-                v = int(value)
+            for key, v in keyed_checks:
                 if v > caps.values[key]:
                     caps.values[key] = pad_capacity(int(v * headroom) + 1)
                     overflow = True
@@ -224,6 +237,25 @@ class Executor:
             RECOMPILES.inc()
             fail_point("executor::before_recompile")
         raise ExecError(f"capacity did not converge after {max_recompiles} recompiles")
+
+    def _run(self, plan: LogicalPlan, profile: RuntimeProfile | None = None) -> Chunk:
+        profile = profile or RuntimeProfile("query")
+
+        def attempt(caps, p):
+            compiled = compile_plan(plan, self.catalog, caps)
+            with p.timer("scan_to_device"):
+                inputs = tuple(
+                    self.cache.chunk_for(self.catalog.get_table(t), a, cols)
+                    for t, a, cols in compiled.scans
+                )
+            fn = jax.jit(compiled.fn)
+            out, checks = fn(inputs)
+            jax.block_until_ready(out.data)
+            return out, [
+                (k, int(v)) for k, v in zip(compiled.checks_meta, checks)
+            ]
+
+        return self._adaptive(profile, attempt)
 
 
 def _prettify_names(ht: HostTable) -> HostTable:

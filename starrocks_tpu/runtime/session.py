@@ -29,10 +29,17 @@ class Session:
     the catalog is rebuilt by edit-log replay on startup (the
     EditLog/loadImage analog, fe persist/EditLog.java:133)."""
 
-    def __init__(self, catalog: Catalog | None = None, data_dir: str | None = None):
+    def __init__(
+        self,
+        catalog: Catalog | None = None,
+        data_dir: str | None = None,
+        dist_shards: int | None = None,
+    ):
         self.catalog = catalog or Catalog()
         self.cache = DeviceCache()
         self.store = None
+        self.dist_shards = dist_shards
+        self._dist_executor = None
         if data_dir is not None:
             from ..storage.store import TabletStore, schema_from_json
             from ..storage.catalog import StoredTableHandle
@@ -136,7 +143,17 @@ class Session:
         profile = RuntimeProfile("query")
         with profile.timer("analyze"):
             plan = Analyzer(self.catalog).analyze(sel)
-        res = Executor(self.catalog, self.cache).execute_logical(plan, profile)
+        if self.dist_shards:
+            from .dist_executor import DistExecutor
+
+            if self._dist_executor is None:
+                self._dist_executor = DistExecutor(
+                    self.catalog, n_shards=self.dist_shards,
+                    device_cache=self.cache,
+                )
+            res = self._dist_executor.execute_logical(plan, profile)
+        else:
+            res = Executor(self.catalog, self.cache).execute_logical(plan, profile)
         self.last_profile = res.profile
         return res
 

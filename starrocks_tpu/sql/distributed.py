@@ -1,0 +1,287 @@
+"""Distributed physical planning: logical plan -> one SPMD shard_map program.
+
+Reference behavior: the fragment/exchange machinery (SURVEY §2.4) — the FE
+cuts plans into fragments at exchange boundaries and schedules N instances
+across BEs (qe/CoordinatorPreprocessor.java:70, scheduler/dag/ExecutionDAG);
+BEs shuffle via bRPC transmit_chunk. The TPU re-design compiles the WHOLE
+distributed plan into a single jitted shard_map over the ICI mesh:
+
+- big tables are row-sharded over the mesh (the tablet->BE assignment
+  analog); small tables are replicated to every shard (colocate-by-copy);
+- join strategies: probe-sharded x build-replicated = local broadcast join
+  (no collective); sharded x sharded = hash-shuffle both sides
+  (lax.all_to_all) then local join — HASH_PARTITIONED exchange;
+- aggregation over sharded input = local PARTIAL -> all_gather ->
+  replicated FINAL (two-phase agg; low-cardinality benchmark group-bys make
+  gather the right default, SHUFFLE final is available via dist_ops);
+- sort/limit/window require whole-table view: inputs gather to replicated
+  first; every shard then computes the identical result (out_spec P()).
+
+Every node returns (chunk, mode) with mode in {SHARDED, REPLICATED}; checks
+carry per-shard true counts as [1]-arrays (out_spec P('d')) so the host
+overflow-recompile loop sees the max across shards.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..column.column import Field, pad_capacity
+from ..exprs.ir import Col, Lit
+from ..ops import (
+    INNER, LEFT_ANTI, LEFT_OUTER, LEFT_SEMI,
+    filter_chunk, hash_aggregate, hash_join_expand, hash_join_unique,
+    limit_chunk, project, sort_chunk,
+)
+from ..ops.aggregate import FINAL, PARTIAL, final_agg_exprs
+from ..ops.window import window_op
+from ..parallel.exchange import all_gather_chunk, shuffle_chunk
+from ..parallel.mesh import DATA_AXIS
+from .analyzer import _conjuncts
+from .logical import (
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LWindow,
+    LogicalPlan,
+)
+from .optimizer import and_all
+from .physical import Caps, PlanError, _equi_pair, _key_bit_width, unique_sets
+
+SHARDED = "sharded"
+REPLICATED = "replicated"
+
+# tables smaller than this are replicated rather than sharded
+SHARD_THRESHOLD_ROWS = 100_000
+
+
+class DistCompiled:
+    def __init__(self, fn, scans, scan_modes, checks_meta, out_names, n_shards):
+        self.fn = fn
+        self.scans = scans  # list[(table, alias, columns)]
+        self.scan_modes = scan_modes  # list[SHARDED|REPLICATED]
+        self.checks_meta = checks_meta
+        self.out_names = out_names
+        self.n_shards = n_shards
+
+
+def plan_scan_modes(plan: LogicalPlan, catalog) -> dict:
+    """Decide sharding per scan node id: shard big tables, replicate small."""
+    modes = {}
+
+    def rec(p):
+        if isinstance(p, LScan):
+            t = catalog.get_table(p.table)
+            rows = t.row_count if t is not None else 0
+            modes[id(p)] = SHARDED if rows >= SHARD_THRESHOLD_ROWS else REPLICATED
+        for c in p.children:
+            rec(c)
+
+    rec(plan)
+    return modes
+
+
+def compile_distributed(
+    plan: LogicalPlan, catalog, caps: Caps, n_shards: int,
+    axis: str = DATA_AXIS, scan_modes: dict | None = None,
+) -> DistCompiled:
+    scan_modes = scan_modes or plan_scan_modes(plan, catalog)
+    scans: list = []
+    scan_index: dict = {}
+    scan_mode_list: list = []
+    checks_meta: list = []
+
+    def collect(p):
+        if isinstance(p, LScan):
+            scan_index[id(p)] = len(scans)
+            scans.append((p.table, p.alias, p.columns))
+            scan_mode_list.append(scan_modes.get(id(p), REPLICATED))
+        for c in p.children:
+            collect(c)
+
+    collect(plan)
+
+    def gather(chunk, mode):
+        if mode == REPLICATED:
+            return chunk
+        return all_gather_chunk(chunk, axis)
+
+    def emit(p, inputs):
+        if isinstance(p, LScan):
+            i = scan_index[id(p)]
+            return inputs[i], [], scan_mode_list[i]
+        if isinstance(p, LFilter):
+            c, ch, m = emit(p.child, inputs)
+            return filter_chunk(c, p.predicate), ch, m
+        if isinstance(p, LProject):
+            c, ch, m = emit(p.child, inputs)
+            return (
+                project(c, [e for _, e in p.exprs], [n for n, _ in p.exprs]),
+                ch, m,
+            )
+        if isinstance(p, LWindow):
+            c, ch, m = emit(p.child, inputs)
+            c = gather(c, m)
+            return window_op(c, p.partition_by, p.order_by, p.funcs), ch, REPLICATED
+        if isinstance(p, LSort):
+            c, ch, m = emit(p.child, inputs)
+            c = gather(c, m)
+            return sort_chunk(c, p.keys, p.limit), ch, REPLICATED
+        if isinstance(p, LLimit):
+            c, ch, m = emit(p.child, inputs)
+            c = gather(c, m)
+            return limit_chunk(c, p.limit, p.offset), ch, REPLICATED
+        if isinstance(p, LAggregate):
+            return emit_agg(p, inputs)
+        if isinstance(p, LJoin):
+            return emit_join(p, inputs)
+        raise PlanError(f"cannot compile {type(p).__name__} distributed")
+
+    def emit_agg(p: LAggregate, inputs):
+        c, ch, m = emit(p.child, inputs)
+        key = f"agg_{id(p)}"
+        cap = caps.get(key, 1024)
+        if m == REPLICATED:
+            out, ng = hash_aggregate(c, p.group_by, p.aggs, cap)
+            checks_meta.append(key)
+            return out, ch + [ng[None]], REPLICATED
+        # two-phase: local partial -> all_gather -> final
+        part, png = hash_aggregate(c, p.group_by, p.aggs, cap, mode=PARTIAL)
+        merged = all_gather_chunk(part, axis)
+        final_group_by = tuple((n, Col(n)) for n, _ in p.group_by)
+        out, ng = hash_aggregate(
+            merged, final_group_by, final_agg_exprs(p.aggs), cap, mode=FINAL
+        )
+        checks_meta.append(key)
+        # both partial and final counts must fit the capacity
+        return out, ch + [jnp.maximum(png, ng)[None]], REPLICATED
+
+    def emit_join(p: LJoin, inputs):
+        lc, lch, lm = emit(p.left, inputs)
+        rc, rch, rm = emit(p.right, inputs)
+        checks = lch + rch
+        lcols = frozenset(p.left.output_names())
+        rcols = frozenset(p.right.output_names())
+
+        probe_keys, build_keys, residual = [], [], []
+        for conj in (_conjuncts(p.condition) if p.condition is not None else []):
+            pair = _equi_pair(conj, lcols, rcols)
+            if pair is not None:
+                probe_keys.append(pair[0])
+                build_keys.append(pair[1])
+            else:
+                residual.append(conj)
+
+        kind = {
+            "inner": INNER, "left": LEFT_OUTER, "semi": LEFT_SEMI,
+            "anti": LEFT_ANTI, "cross": INNER,
+        }[p.kind]
+
+        if not probe_keys:
+            probe_keys, build_keys = [Lit(0)], [Lit(0)]
+            bit_widths = (2,)
+            unique = False
+            if lm == SHARDED and rm == SHARDED:
+                # shuffling a constant key would funnel everything onto one
+                # shard; gather the build side and cross-join locally instead
+                rc = all_gather_chunk(rc, axis)
+                rm = REPLICATED
+        else:
+            bit_widths = None
+            if len(probe_keys) > 1:
+                widths = []
+                for pk, bk in zip(probe_keys, build_keys):
+                    w1 = _key_bit_width(p.left, pk, catalog)
+                    w2 = _key_bit_width(p.right, bk, catalog)
+                    if w1 is None or w2 is None:
+                        widths = None
+                        break
+                    widths.append(max(w1, w2))
+                if widths is None or sum(widths) > 63:
+                    raise PlanError("multi-key join without packable stats")
+                bit_widths = tuple(widths)
+            build_key_names = frozenset(
+                k.name for k in build_keys if isinstance(k, Col)
+            )
+            unique = len(build_key_names) == len(build_keys) and any(
+                s <= build_key_names for s in unique_sets(p.right, catalog)
+            )
+
+        # --- distribution strategy ---
+        if rm == SHARDED and lm == SHARDED:
+            # shuffle both sides by join key onto the mesh (HASH_PARTITIONED)
+            kb = f"shufL_{id(p)}"
+            cap_l = caps.get(kb, pad_capacity(lc.capacity // max(n_shards // 2, 1)))
+            lc, mxl = shuffle_chunk(lc, tuple(probe_keys), axis, n_shards, cap_l, bit_widths)
+            checks_meta.append(kb)
+            checks = checks + [mxl[None]]
+            kb2 = f"shufR_{id(p)}"
+            cap_r = caps.get(kb2, pad_capacity(rc.capacity // max(n_shards // 2, 1)))
+            rc, mxr = shuffle_chunk(rc, tuple(build_keys), axis, n_shards, cap_r, bit_widths)
+            checks_meta.append(kb2)
+            checks = checks + [mxr[None]]
+            out_mode = SHARDED
+        elif rm == SHARDED:  # probe replicated, build sharded -> gather build
+            rc = all_gather_chunk(rc, axis)
+            out_mode = REPLICATED if lm == REPLICATED else SHARDED
+        else:
+            # build replicated: local (broadcast) join; output follows probe
+            out_mode = lm
+
+        payload = (
+            [] if p.kind in ("semi", "anti") else list(p.right.output_names())
+        )
+
+        if residual and p.kind in ("semi", "anti"):
+            rid = f"__rowid_{id(p)}"
+            rowid = jnp.arange(lc.capacity, dtype=jnp.int64)
+            lc2 = lc.with_columns([Field(rid, T.BIGINT, False)], [rowid], [None])
+            key = f"join_{id(p)}"
+            cap = caps.get(key, pad_capacity(lc.capacity))
+            expanded, total = hash_join_expand(
+                lc2, rc, tuple(probe_keys), tuple(build_keys), cap, INNER,
+                payload=list(p.right.output_names()), bit_widths=bit_widths,
+            )
+            checks_meta.append(key)
+            checks = checks + [total[None]]
+            matched = filter_chunk(expanded, and_all(residual))
+            ids, _ = hash_aggregate(matched, ((rid, Col(rid)),), (), lc.capacity)
+            out = hash_join_unique(
+                lc2, ids, (Col(rid),), (Col(rid),),
+                LEFT_SEMI if p.kind == "semi" else LEFT_ANTI, payload=[],
+            )
+            return out, checks, out_mode
+
+        if unique and p.kind in ("inner", "left", "semi", "anti"):
+            if residual and p.kind != "inner":
+                raise PlanError(f"residual on {p.kind} join unsupported")
+            out = hash_join_unique(
+                lc, rc, tuple(probe_keys), tuple(build_keys), kind,
+                payload=payload, bit_widths=bit_widths,
+            )
+            if residual:
+                out = filter_chunk(out, and_all(residual))
+            return out, checks, out_mode
+
+        if residual and p.kind not in ("inner", "cross"):
+            raise PlanError(f"residual on {p.kind} join unsupported")
+        key = f"join_{id(p)}"
+        cap = caps.get(key, pad_capacity(lc.capacity))
+        out, total = hash_join_expand(
+            lc, rc, tuple(probe_keys), tuple(build_keys), cap, kind,
+            payload=payload, bit_widths=bit_widths,
+        )
+        if p.kind not in ("semi", "anti"):
+            checks_meta.append(key)
+            checks = checks + [total[None]]
+        if residual:
+            out = filter_chunk(out, and_all(residual))
+        return out, checks, out_mode
+
+    def step(inputs):
+        chunk, checks, mode = emit(plan, inputs)
+        if mode == SHARDED:
+            chunk = all_gather_chunk(chunk, axis)
+        return chunk, tuple(checks)
+
+    return DistCompiled(
+        step, scans, scan_mode_list, checks_meta, plan.output_names(), n_shards
+    )
