@@ -1,0 +1,48 @@
+"""Set operations: UNION ALL chunk concatenation.
+
+Reference behavior: be/src/exec/union_node.h + pipeline union operators —
+concatenate child outputs positionally. On TPU: static concat of padded
+chunks; string dictionaries (trace-time constants) merge via constant remap
+gathers; numeric children must be pre-cast by the analyzer to a common type.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..column.column import Chunk, Field, Schema
+from ..column.dict_encoding import StringDict
+
+
+def union_all(a: Chunk, b: Chunk) -> Chunk:
+    """Concatenate two chunks positionally; output names follow `a`."""
+    assert len(a.schema) == len(b.schema), "UNION arity mismatch"
+    fields, data, valid = [], [], []
+    for i, (fa, fb) in enumerate(zip(a.schema.fields, b.schema.fields)):
+        da, db = a.data[i], b.data[i]
+        va, vb = a.valid[i], b.valid[i]
+        dict_ = fa.dict
+        if fa.type.is_string or fb.type.is_string:
+            assert fa.type.is_string and fb.type.is_string, "UNION type mismatch"
+            if fa.dict is not None and fb.dict is not None and fa.dict is not fb.dict:
+                merged, ra, rb = fa.dict.merge(fb.dict)
+                na = max(len(fa.dict), 1)
+                nb = max(len(fb.dict), 1)
+                da = jnp.asarray(ra)[jnp.clip(da, 0, na - 1)] if len(fa.dict) else da
+                db = jnp.asarray(rb)[jnp.clip(db, 0, nb - 1)] if len(fb.dict) else db
+                dict_ = merged
+        elif da.dtype != db.dtype:
+            raise AssertionError(
+                f"UNION column {i}: dtype {da.dtype} vs {db.dtype} — "
+                "analyzer must insert casts"
+            )
+        data.append(jnp.concatenate([da, db]))
+        if va is None and vb is None:
+            valid.append(None)
+        else:
+            va2 = jnp.ones((a.capacity,), jnp.bool_) if va is None else va
+            vb2 = jnp.ones((b.capacity,), jnp.bool_) if vb is None else vb
+            valid.append(jnp.concatenate([va2, vb2]))
+        fields.append(Field(fa.name, fa.type, True, dict_))
+    sel = jnp.concatenate([a.sel_mask(), b.sel_mask()])
+    return Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)

@@ -121,7 +121,7 @@ class Session:
         stmt = parse(text)
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt)
-        if isinstance(stmt, ast.Select):
+        if isinstance(stmt, (ast.Select, ast.SetOp)):
             return self._query(stmt)
         if isinstance(stmt, ast.CreateTable):
             return self._create(stmt)
@@ -134,10 +134,20 @@ class Session:
             return None
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
+        if isinstance(stmt, ast.ShowTables):
+            return sorted(self.catalog.tables)
+        if isinstance(stmt, ast.Describe):
+            h = self.catalog.get_table(stmt.table)
+            if h is None:
+                raise ValueError(f"unknown table {stmt.table}")
+            return [
+                (f.name, repr(f.type), "YES" if f.nullable else "NO")
+                for f in h.schema
+            ]
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
 
     # --- SELECT ---------------------------------------------------------------
-    def _query(self, sel: ast.Select) -> QueryResult:
+    def _query(self, sel) -> QueryResult:
         from .profile import RuntimeProfile
 
         profile = RuntimeProfile("query")
@@ -158,7 +168,7 @@ class Session:
         return res
 
     def _explain(self, stmt: ast.Explain) -> str:
-        assert isinstance(stmt.stmt, ast.Select), "EXPLAIN supports SELECT"
+        assert isinstance(stmt.stmt, (ast.Select, ast.SetOp)), "EXPLAIN supports SELECT"
         if stmt.analyze:
             res = self._query(stmt.stmt)
             # res.plan is the actually-executed optimized plan

@@ -20,7 +20,8 @@ from typing import Optional
 from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit, WindowExpr
 from . import ast
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LWindow, LogicalPlan,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion, LWindow,
+    LogicalPlan,
 )
 
 
@@ -92,8 +93,59 @@ class Analyzer:
         self._ids = itertools.count()
 
     # --- relations -----------------------------------------------------------
-    def analyze(self, sel: ast.Select) -> LogicalPlan:
+    def analyze(self, sel) -> LogicalPlan:
+        if isinstance(sel, ast.SetOp):
+            return self._analyze_setop(sel, None, {})
         return self._analyze_select(sel, None, {})
+
+    def _analyze_setop(self, so: ast.SetOp, outer, ctes) -> LogicalPlan:
+        ctes = dict(ctes)
+        for name, sub in so.ctes:
+            ctes[name.lower()] = sub
+        plans = [self._analyze_select(s, outer, ctes) for s in so.selects]
+        arities = {len(p.output_names()) for p in plans}
+        if len(arities) != 1:
+            raise AnalyzerError(f"UNION inputs have different arities: {arities}")
+        # rename every child's outputs to the first child's names (positional)
+        names = [n.split(".", 1)[-1] for n in plans[0].output_names()]
+        aligned = []
+        for p in plans:
+            aligned.append(
+                LProject(p, tuple(
+                    (nm, Col(q)) for nm, q in zip(names, p.output_names())
+                ))
+            )
+        plan = LUnion(tuple(aligned))
+        if not so.all:
+            plan = LAggregate(
+                plan, tuple((n, Col(n)) for n in names), ()
+            )
+        order_items = [
+            (self._lower_order_expr_union(o, names), o.asc,
+             o.nulls_first if o.nulls_first is not None else not o.asc)
+            for o in so.order_by
+        ]
+        if order_items:
+            plan = LSort(plan, tuple(order_items),
+                         so.limit if so.offset == 0 else None)
+            if so.limit is not None and so.offset != 0:
+                plan = LLimit(plan, so.limit, so.offset)
+        elif so.limit is not None:
+            plan = LLimit(plan, so.limit, so.offset)
+        return plan
+
+    def _lower_order_expr_union(self, o, names):
+        e = o.expr
+        if isinstance(e, Lit) and isinstance(e.value, int):
+            idx = e.value - 1
+            if not (0 <= idx < len(names)):
+                raise AnalyzerError(f"ORDER BY ordinal {e.value} out of range")
+            return Col(names[idx])
+        if isinstance(e, ast.RawCol) and e.table is None and e.name in names:
+            return Col(e.name)
+        raise AnalyzerError(
+            "ORDER BY on a UNION must reference output columns by name/ordinal"
+        )
 
     def _analyze_select(
         self, sel: ast.Select, outer: Optional[Scope], ctes: dict
@@ -208,7 +260,11 @@ class Analyzer:
             name = rel.name.lower()
             if name in ctes:
                 alias = rel.alias or name
-                sub_plan = self._analyze_select(ctes[name], outer, ctes)
+                cdef = ctes[name]
+                if isinstance(cdef, ast.SetOp):
+                    sub_plan = self._analyze_setop(cdef, outer, ctes)
+                else:
+                    sub_plan = self._analyze_select(cdef, outer, ctes)
                 return self._aliased_subplan(sub_plan, alias)
             t = self.catalog.get_table(name)
             if t is None:
@@ -218,7 +274,10 @@ class Analyzer:
             scan = LScan(name, alias, cols)
             return scan, Scope([(alias, cols)], outer)
         if isinstance(rel, ast.SubqueryRef):
-            sub_plan = self._analyze_select(rel.select, outer, ctes)
+            if isinstance(rel.select, ast.SetOp):
+                sub_plan = self._analyze_setop(rel.select, outer, ctes)
+            else:
+                sub_plan = self._analyze_select(rel.select, outer, ctes)
             return self._aliased_subplan(sub_plan, rel.alias)
         if isinstance(rel, ast.JoinRef):
             lplan, lscope = self._analyze_relation(rel.left, outer, ctes)
@@ -345,7 +404,10 @@ class Analyzer:
         The subquery plan may contain Col("@outer.x") references; we pull
         equality predicates of the form inner_col = @outer.x out of filters
         (the optimizer turns them into join keys)."""
-        plan = self._analyze_select(sel, outer_scope, ctes)
+        if isinstance(sel, ast.SetOp):
+            plan = self._analyze_setop(sel, outer_scope, ctes)
+        else:
+            plan = self._analyze_select(sel, outer_scope, ctes)
         corr = _extract_correlations(plan)
         return plan, corr
 

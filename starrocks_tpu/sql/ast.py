@@ -130,6 +130,18 @@ class Select:
 
 
 @dataclasses.dataclass(frozen=True)
+class SetOp:
+    """UNION [ALL] chain; order/limit apply to the combined result."""
+
+    selects: tuple  # tuple[Select]
+    all: bool
+    order_by: tuple = ()
+    limit: object = None
+    offset: int = 0
+    ctes: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class ColumnDef:
     name: str
     type: object  # types.LogicalType
@@ -157,6 +169,16 @@ class Insert:
 class DropTable:
     name: str
     if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowTables:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Describe:
+    table: str
 
 
 @dataclasses.dataclass(frozen=True)

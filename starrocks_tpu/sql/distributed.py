@@ -40,7 +40,7 @@ from ..parallel.exchange import all_gather_chunk, shuffle_chunk
 from ..parallel.mesh import DATA_AXIS
 from .analyzer import _conjuncts
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LWindow,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion, LWindow,
     LogicalPlan,
 )
 from .optimizer import and_all
@@ -129,6 +129,16 @@ def compile_distributed(
             c, ch, m = emit(p.child, inputs)
             c = gather(c, m)
             return limit_chunk(c, p.limit, p.offset), ch, REPLICATED
+        if isinstance(p, LUnion):
+            from ..ops.setops import union_all
+
+            out, ch, m = emit(p.inputs[0], inputs)
+            out = gather(out, m)
+            for child in p.inputs[1:]:
+                c2, ch2, m2 = emit(child, inputs)
+                out = union_all(out, gather(c2, m2))
+                ch = ch + ch2
+            return out, ch, REPLICATED
         if isinstance(p, LAggregate):
             return emit_agg(p, inputs)
         if isinstance(p, LJoin):

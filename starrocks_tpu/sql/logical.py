@@ -127,6 +127,23 @@ class LWindow(LogicalPlan):
 
 
 @dataclasses.dataclass(frozen=True)
+class LUnion(LogicalPlan):
+    """UNION ALL of children (positional columns; names from the first)."""
+
+    inputs: tuple
+
+    @property
+    def children(self):
+        return self.inputs
+
+    def output_names(self):
+        return self.inputs[0].output_names()
+
+    def __repr__(self):
+        return f"UnionAll[{len(self.inputs)}]"
+
+
+@dataclasses.dataclass(frozen=True)
 class LSort(LogicalPlan):
     child: LogicalPlan
     keys: tuple  # tuple[(Expr, asc, nulls_first)]

@@ -26,11 +26,13 @@ import dataclasses
 from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit
 from .analyzer import ScalarSubquery, SemiJoinMark, _conjuncts
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LWindow, LogicalPlan,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion, LWindow,
+    LogicalPlan,
 )
 
 
 def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
+    plan = rewrite_distinct_aggs(plan)
     plan = pushdown_filters(plan)
     plan = rewrite_subqueries(plan, catalog)
     plan = pushdown_filters(plan)
@@ -38,6 +40,65 @@ def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
     plan = pushdown_filters(plan)
     plan = prune_columns(plan)
     return plan
+
+
+# --- 0. DISTINCT aggregate rewrite -------------------------------------------
+
+
+def rewrite_distinct_aggs(plan: LogicalPlan) -> LogicalPlan:
+    """agg(DISTINCT x) -> two-level aggregation (reference analog:
+    SplitAggregateRule / distinct multi-stage agg in fe sql/optimizer):
+
+    level 1 groups by (keys + x) — deduplicating x per group — and computes
+    partial states of the non-distinct aggregates; level 2 re-groups by keys,
+    merges partials, and evaluates the distinct agg over the deduped x."""
+    new_children = tuple(rewrite_distinct_aggs(c) for c in plan.children)
+    plan = _replace_children(plan, new_children)
+    if not isinstance(plan, LAggregate) or not any(
+        a.distinct for _, a in plan.aggs
+    ):
+        return plan
+
+    dargs = {a.arg for _, a in plan.aggs if a.distinct}
+    if len(dargs) != 1:
+        raise NotImplementedError(
+            "multiple DISTINCT aggregates with different arguments"
+        )
+    d_expr = next(iter(dargs))
+    if d_expr is None:
+        raise NotImplementedError("COUNT(DISTINCT *) is not meaningful")
+
+    l1_group = plan.group_by + (("__darg", d_expr),)
+    l1_aggs, l2_aggs, post = [], [], {}
+    for name, a in plan.aggs:
+        if a.distinct:
+            l2_aggs.append((name, AggExpr(a.fn, Col("__darg"))))
+        elif a.fn in ("count", "count_star"):
+            l1_aggs.append((name, a))
+            l2_aggs.append((name, AggExpr("sum", Col(name))))
+        elif a.fn == "sum":
+            l1_aggs.append((name, a))
+            l2_aggs.append((name, AggExpr("sum", Col(name))))
+        elif a.fn in ("min", "max"):
+            l1_aggs.append((name, a))
+            l2_aggs.append((name, AggExpr(a.fn, Col(name))))
+        elif a.fn == "avg":
+            l1_aggs.append((f"{name}__ds", AggExpr("sum", a.arg)))
+            l1_aggs.append((f"{name}__dc", AggExpr("count", a.arg)))
+            l2_aggs.append((f"{name}__ds", AggExpr("sum", Col(f"{name}__ds"))))
+            l2_aggs.append((f"{name}__dc", AggExpr("sum", Col(f"{name}__dc"))))
+            post[name] = Call("divide", Col(f"{name}__ds"), Col(f"{name}__dc"))
+        else:
+            raise NotImplementedError(f"aggregate {a.fn} with DISTINCT rewrite")
+
+    l1 = LAggregate(plan.child, l1_group, tuple(l1_aggs))
+    l2_group = tuple((n, Col(n)) for n, _ in plan.group_by)
+    l2 = LAggregate(l1, l2_group, tuple(l2_aggs))
+    # restore the original output name list (group cols then agg names)
+    out_exprs = [(n, Col(n)) for n, _ in plan.group_by]
+    for name, _ in plan.aggs:
+        out_exprs.append((name, post.get(name, Col(name))))
+    return LProject(l2, tuple(out_exprs))
 
 
 # --- expression helpers ------------------------------------------------------
@@ -208,6 +269,13 @@ def _push(plan: LogicalPlan, preds: list) -> LogicalPlan:
             LWindow(child, plan.partition_by, plan.order_by, plan.funcs), preds
         )
 
+    if isinstance(plan, LUnion):
+        # a filter over a union pushes into every input (same output names)
+        pushable = [p for p in preds if not _has_marker(p)]
+        stay = [p for p in preds if _has_marker(p)]
+        kids = tuple(_push(c, list(pushable)) for c in plan.inputs)
+        return _wrap(LUnion(kids), stay)
+
     if isinstance(plan, (LSort, LLimit)):
         # a pure sort is transparent to filters, but a fused TopN (or LIMIT)
         # is not: filtering before "pick k rows" changes which rows survive
@@ -281,6 +349,8 @@ def _replace_children(plan, new_children):
         return LAggregate(new_children[0], plan.group_by, plan.aggs)
     if isinstance(plan, LWindow):
         return LWindow(new_children[0], plan.partition_by, plan.order_by, plan.funcs)
+    if isinstance(plan, LUnion):
+        return LUnion(tuple(new_children))
     if isinstance(plan, LSort):
         return LSort(new_children[0], plan.keys, plan.limit)
     if isinstance(plan, LLimit):
@@ -365,6 +435,7 @@ def _apply_marker(outer_plan: LogicalPlan, conjunct: Expr, catalog) -> LogicalPl
         m = conjunct
         removed: list = []
         sub = _strip_correlation(m.plan, removed)
+        sub = rewrite_distinct_aggs(sub)
         sub = rewrite_subqueries(sub, catalog)
         # equality pairs become join keys; other correlated conjuncts
         # (e.g. TPC-H Q21's l2.l_suppkey <> l1.l_suppkey) become residual
@@ -415,6 +486,8 @@ def _apply_marker(outer_plan: LogicalPlan, conjunct: Expr, catalog) -> LogicalPl
         # uncorrelated scalar: leave in place; the executor evaluates it first
         return LFilter(outer_plan, conjunct)
 
+    # NOTE: no distinct-agg rewrite here — the pattern match below needs the
+    # original single-LAggregate shape; the rewrite applies to `grouped`.
     sub = _strip_correlation(marker.plan)
     sub = rewrite_subqueries(sub, catalog)
     # locate the aggregate inside (LProject over LAggregate with no group keys)
@@ -431,7 +504,7 @@ def _apply_marker(outer_plan: LogicalPlan, conjunct: Expr, catalog) -> LogicalPl
     inner_cols = tuple(ic for _, ic in marker.correlated)
     outer_cols = tuple(oc for oc, _ in marker.correlated)
     group_by = tuple((f"corr_{i}", Col(ic)) for i, ic in enumerate(inner_cols))
-    grouped = LAggregate(agg.child, group_by, agg.aggs)
+    grouped = rewrite_distinct_aggs(LAggregate(agg.child, group_by, agg.aggs))
     val_name = "subq_val"
     proj = LProject(
         grouped,
@@ -493,6 +566,8 @@ def estimate_rows(plan: LogicalPlan, catalog) -> float:
         return max(l, r)
     if isinstance(plan, (LSort, LLimit, LWindow)):
         return estimate_rows(plan.child, catalog)
+    if isinstance(plan, LUnion):
+        return sum(estimate_rows(c, catalog) for c in plan.inputs)
     return 1000.0
 
 
@@ -641,5 +716,9 @@ def prune_columns(plan: LogicalPlan, required: frozenset | None = None) -> Logic
 
     if isinstance(plan, LLimit):
         return LLimit(prune_columns(plan.child, required), plan.limit, plan.offset)
+
+    if isinstance(plan, LUnion):
+        # children expose identical names; prune each by the same set
+        return LUnion(tuple(prune_columns(c, required) for c in plan.inputs))
 
     raise TypeError(type(plan))

@@ -75,7 +75,11 @@ class Parser:
     def expect_ident(self) -> str:
         t = self.peek()
         # permit non-reserved keywords as identifiers where unambiguous
-        if t.kind in ("ident",) or (t.kind == "kw" and t.value in ("year", "month", "day", "date", "first", "last")):
+        if t.kind in ("ident",) or (
+            t.kind == "kw"
+            and t.value in ("year", "month", "day", "date", "first", "last",
+                            "tables", "values", "show")
+        ):
             self.next()
             return t.value
         raise ParseError(f"expected identifier at {t.value!r} (pos {t.pos})")
@@ -96,10 +100,49 @@ class Parser:
             return self.parse_insert()
         if self.at_kw("drop"):
             return self.parse_drop()
+        if self.accept_kw("show"):
+            self.expect_kw("tables")
+            self.accept_op(";")
+            return ast.ShowTables()
+        if self.at_kw("describe", "desc"):
+            self.next()
+            name = self.parse_table_name()
+            self.accept_op(";")
+            return ast.Describe(name)
         raise ParseError(f"unsupported statement start {self.peek().value!r}")
 
+    def parse_table_name(self) -> str:
+        name = self.expect_ident()
+        if self.accept_op("."):
+            name = f"{name}.{self.expect_ident()}"
+        return name
+
     # --- SELECT --------------------------------------------------------------
-    def parse_select(self) -> ast.Select:
+    def parse_select(self):
+        """SELECT core optionally followed by UNION [ALL] chains."""
+        first = self.parse_select_core()
+        if not self.at_kw("union"):
+            return first
+        selects = [first]
+        all_flags = []
+        while self.accept_kw("union"):
+            all_flags.append(self.accept_kw("all"))
+            selects.append(self.parse_select_core())
+        if len(set(all_flags)) > 1:
+            raise ParseError("mixing UNION and UNION ALL is unsupported")
+        # order/limit parsed into the LAST core bind to the whole union
+        last = selects[-1]
+        order_by, limit, offset = last.order_by, last.limit, last.offset
+        selects[-1] = ast.Select(
+            last.items, last.from_, last.where, last.group_by, last.having,
+            (), None, 0, last.distinct, last.ctes,
+        )
+        return ast.SetOp(
+            tuple(selects), all_flags[0], order_by, limit, offset,
+            selects[0].ctes,
+        )
+
+    def parse_select_core(self) -> ast.Select:
         ctes = ()
         if self.accept_kw("with"):
             items = []
@@ -241,7 +284,7 @@ class Parser:
             refs = self.parse_table_refs()
             self.expect_op(")")
             return refs
-        name = self.expect_ident()
+        name = self.parse_table_name()
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()

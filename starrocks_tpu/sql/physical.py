@@ -31,7 +31,8 @@ from ..ops.window import window_op
 from ..column.column import pad_capacity
 from .analyzer import _conjuncts
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LWindow, LogicalPlan,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion, LWindow,
+    LogicalPlan,
 )
 from .optimizer import and_all, expr_cols
 
@@ -181,6 +182,15 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
         if isinstance(p, LWindow):
             c, ch = emit(p.child, inputs)
             return window_op(c, p.partition_by, p.order_by, p.funcs), ch
+        if isinstance(p, LUnion):
+            from ..ops.setops import union_all
+
+            out, ch = emit(p.inputs[0], inputs)
+            for child in p.inputs[1:]:
+                c2, ch2 = emit(child, inputs)
+                out = union_all(out, c2)
+                ch = ch + ch2
+            return out, ch
         if isinstance(p, LAggregate):
             c, ch = emit(p.child, inputs)
             key = f"agg_{id(p)}"

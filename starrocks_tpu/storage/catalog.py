@@ -75,6 +75,14 @@ class StoredTableHandle(TableHandle):
     def schema(self) -> Schema:
         return self._schema
 
+    @property
+    def row_count(self) -> int:
+        # cheap: manifests record rowset sizes; no data load needed
+        if self._table is not None:
+            return self._table.num_rows
+        m = self.store.read_manifest(self.name)
+        return sum(rs["rows"] for rs in m["rowsets"])
+
     def invalidate(self):
         self._table = None
         self._stats = {}
@@ -98,7 +106,53 @@ class Catalog:
         del self.tables[name.lower()]
 
     def get_table(self, name: str) -> Optional[TableHandle]:
-        return self.tables.get(name.lower())
+        name = name.lower()
+        if name.startswith("information_schema."):
+            return self._info_schema(name.split(".", 1)[1])
+        return self.tables.get(name)
+
+    def _info_schema(self, view: str) -> Optional[TableHandle]:
+        """Virtual tables over catalog state (reference analog: BE
+        schema_scanner/ + fe catalog/system/information/)."""
+        from .. import types as T
+        from ..column import Field, Schema, StringDict
+
+        def vtable(cols):
+            # build even when empty (from_pydict can't infer types of [])
+            fields, arrays = [], {}
+            for cname, ctype, values in cols:
+                if ctype.is_string:
+                    d, codes = StringDict.from_strings([str(v) for v in values])
+                    fields.append(Field(cname, T.VARCHAR, False, d))
+                    arrays[cname] = codes
+                else:
+                    fields.append(Field(cname, ctype, False))
+                    arrays[cname] = np.asarray(values, dtype=ctype.np_dtype)
+            return TableHandle(f"information_schema.{view}",
+                               HostTable(Schema(tuple(fields)), arrays, {}))
+
+        if view == "tables":
+            names = sorted(self.tables)
+            return vtable([
+                ("table_name", T.VARCHAR, names),
+                ("table_rows", T.BIGINT,
+                 [self.tables[n].row_count for n in names]),
+            ])
+        if view == "columns":
+            tn, cn, ty, nu = [], [], [], []
+            for n in sorted(self.tables):
+                for f in self.tables[n].schema:
+                    tn.append(n)
+                    cn.append(f.name)
+                    ty.append(repr(f.type))
+                    nu.append(1 if f.nullable else 0)
+            return vtable([
+                ("table_name", T.VARCHAR, tn),
+                ("column_name", T.VARCHAR, cn),
+                ("data_type", T.VARCHAR, ty),
+                ("is_nullable", T.INT, nu),
+            ])
+        return None
 
 
 TPCH_UNIQUE_KEYS = {

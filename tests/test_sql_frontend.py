@@ -122,3 +122,56 @@ def test_no_filter_pushdown_through_topn():
     assert r.rows() == []
     r2 = s.sql("select a from (select a from t order by a desc limit 2) s where a > 10")
     assert sorted(r2.rows()) == [(40,), (50,)]
+
+
+def test_distinct_aggregates():
+    # regression: DISTINCT aggs were silently ignored pre-rewrite
+    s = Session()
+    s.sql("create table da (g int, x int, y double)")
+    s.sql("insert into da values (1,5,1.0),(1,5,2.0),(1,7,3.0),(2,9,4.0),(1,null,5.0)")
+    r = s.sql("""select g, count(distinct x) cd, sum(distinct x) sd,
+                 count(*) c, sum(y) sy, avg(y) ay, min(y) mn
+                 from da group by g order by g""")
+    assert r.rows() == [(1, 2, 12, 4, 11.0, 2.75, 1.0), (2, 1, 9, 1, 4.0, 4.0, 4.0)]
+
+
+def test_union_all_and_distinct():
+    s = Session()
+    s.sql("create table ua (x int, s varchar)")
+    s.sql("create table ub (x int, s varchar)")
+    s.sql("insert into ua values (1, 'p'), (2, 'q')")
+    s.sql("insert into ub values (2, 'q'), (3, 'r')")
+    assert s.sql("select x, s from ua union all select x, s from ub order by x").rows() == [
+        (1, "p"), (2, "q"), (2, "q"), (3, "r")]
+    assert s.sql("select x, s from ua union select x, s from ub order by x").rows() == [
+        (1, "p"), (2, "q"), (3, "r")]
+    r = s.sql("select s, count(*) c from (select x, s from ua union all select x, s from ub) u group by s order by s")
+    assert r.rows() == [("p", 1), ("q", 2), ("r", 1)]
+
+
+def test_show_describe_information_schema():
+    s = Session()
+    s.sql("create table meta1 (a int not null, b varchar)")
+    s.sql("insert into meta1 values (1, 'x')")
+    assert s.sql("show tables") == ["meta1"]
+    assert s.sql("describe meta1") == [("a", "INT", "NO"), ("b", "VARCHAR", "YES")]
+    rows = s.sql("select table_name, table_rows from information_schema.tables").rows()
+    assert ("meta1", 1) in rows
+    cols = s.sql(
+        "select column_name from information_schema.columns where table_name = 'meta1' order by column_name"
+    ).rows()
+    assert cols == [("a",), ("b",)]
+
+
+def test_distinct_in_correlated_subquery_and_union_in_subquery():
+    # regressions: distinct rewrite must reach marker subplans; IN-subquery
+    # may contain a UNION
+    s = Session()
+    s.sql("create table rt (a int)")
+    s.sql("create table ru (k int, x int)")
+    s.sql("insert into rt values (1), (2)")
+    s.sql("insert into ru values (1, 5), (1, 5), (1, 7), (2, 9)")
+    r = s.sql("select a from rt where a <= (select count(distinct x) from ru where ru.k = rt.a) order by a")
+    assert r.rows() == [(1,)]
+    r2 = s.sql("select a from rt where a in (select a from rt union select x from ru) order by a")
+    assert r2.rows() == [(1,), (2,)]
