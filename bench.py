@@ -9,6 +9,8 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
   numbers).
 
 Scale factor via SR_TPU_BENCH_SF (default 1.0 -> ~6M lineitem rows).
+SR_TPU_BENCH_QUERY selects the workload: q1 (default, hand-built plan) |
+sql_q1 .. sql_q22 (full SQL path) | ssb_q1.1 .. | tpcds_q67.
 """
 
 import json
@@ -17,9 +19,61 @@ import sys
 import time
 
 
+def run_sql_bench(query_key: str, sf: float, repeats: int):
+    """Benchmark a query through the full SQL path (parse->plan->jit)."""
+    from starrocks_tpu.runtime.session import Session
+
+    if query_key.startswith("sql_q"):
+        from starrocks_tpu.storage.catalog import tpch_catalog
+        from tests.tpch_queries import QUERIES
+
+        cat = tpch_catalog(sf=sf)
+        text = QUERIES[int(query_key[5:])]
+        rows_base = cat.get_table("lineitem").row_count
+    elif query_key.startswith("ssb_"):
+        from starrocks_tpu.storage.datagen.ssb import ssb_catalog
+        from tests.ssb_queries import FLAT_QUERIES
+
+        cat = ssb_catalog(sf=sf)
+        text = FLAT_QUERIES[query_key[4:]]
+        rows_base = cat.get_table("lineorder_flat").row_count
+    elif query_key == "tpcds_q67":
+        from starrocks_tpu.storage.datagen.tpcds import tpcds_catalog
+        from tests.test_tpcds_q67 import Q67
+
+        cat = tpcds_catalog(sf=sf)
+        text = Q67
+        rows_base = cat.get_table("store_sales").row_count
+    else:
+        raise ValueError(f"unknown bench query {query_key!r}")
+
+    s = Session(cat)
+    t0 = time.time()
+    s.sql(text)  # compile + first run
+    compile_s = time.time() - t0
+    best = float("inf")
+    for _ in range(repeats):
+        t1 = time.time()
+        s.sql(text)
+        best = min(best, time.time() - t1)
+    import jax
+
+    print(json.dumps({
+        "metric": f"{query_key}_sf{sf:g}_rows_per_sec",
+        "value": round(rows_base / best),
+        "unit": "rows/sec/chip",
+        "vs_baseline": 0.0,
+    }))
+    print(f"# backend={jax.default_backend()} rows={rows_base} "
+          f"compile={compile_s:.1f}s best={best*1000:.1f}ms", file=sys.stderr)
+
+
 def main():
     sf = float(os.environ.get("SR_TPU_BENCH_SF", "1.0"))
     repeats = int(os.environ.get("SR_TPU_BENCH_REPEATS", "5"))
+    query_key = os.environ.get("SR_TPU_BENCH_QUERY", "q1")
+    if query_key != "q1":
+        return run_sql_bench(query_key, sf, repeats)
 
     import jax
 

@@ -518,6 +518,14 @@ def _f_is_not_null(cc, a):
     return EVal(jnp.broadcast_to(a.valid, (cap,)), None, T.BOOLEAN)
 
 
+@function("null_of")
+def _f_null_of(cc, a):
+    # typed NULL column shaped like `a` (ROLLUP's grouping placeholder)
+    cap = cc.chunk.capacity
+    data = jnp.broadcast_to(jnp.asarray(a.data), (cap,)) if not isinstance(a.data, (str, int, float, bool)) else jnp.zeros((cap,), a.type.dtype)
+    return EVal(data, jnp.zeros((cap,), jnp.bool_), a.type, a.dict)
+
+
 @function("coalesce")
 def _f_coalesce(cc, *args):
     out = args[-1]

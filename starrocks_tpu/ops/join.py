@@ -62,6 +62,27 @@ def pack_keys(chunk: Chunk, key_exprs, bit_widths=None):
     return jnp.where(ok, packed, _I64MAX), ok
 
 
+def runtime_filter_mask(
+    probe: Chunk, build: Chunk, probe_keys, build_keys, bit_widths=None,
+    axis: str | None = None,
+):
+    """Build-side min/max runtime filter applied to the probe (reference:
+    be/src/exec_primitive/runtime_filter/ + global merge via
+    orchestration/runtime_filter_worker.h:41). In the compiled world the
+    "delivery" is dataflow: the build min/max feeds a probe mask in the same
+    program; with `axis` set the local bounds are merged across shards with
+    pmin/pmax — the global-runtime-filter collective. Only valid for
+    INNER/LEFT SEMI joins (probe rows may be dropped)."""
+    bk, b_ok = pack_keys(build, build_keys, bit_widths)
+    bmin = jnp.min(jnp.where(b_ok, bk, _I64MAX))
+    bmax = jnp.max(jnp.where(b_ok, bk, jnp.iinfo(jnp.int64).min))
+    if axis is not None:
+        bmin = jax.lax.pmin(bmin, axis)
+        bmax = jax.lax.pmax(bmax, axis)
+    pk, p_ok = pack_keys(probe, probe_keys, bit_widths)
+    return (pk >= bmin) & (pk <= bmax)
+
+
 def _merge_schemas(left: Chunk, right: Chunk, right_names) -> tuple:
     lnames = set(left.schema.names)
     out_fields = list(left.schema.fields)

@@ -77,6 +77,7 @@ config.define("default_agg_groups", 1024, True, "initial group capacity before a
 config.define("max_recompiles", 6, True, "adaptive capacity recompile limit per query")
 config.define("join_expand_headroom", 1.2, True, "growth factor applied on capacity overflow")
 config.define("enable_zonemap_pruning", True, True, "prune parquet rowsets by zonemap stats")
+config.define("enable_runtime_filters", True, True, "build-side min/max filters applied to join probes")
 config.define("bench_sf", 1.0, True, "scale factor used by bench.py")
 config.define("profile_queries", True, True, "collect RuntimeProfile for every query")
 config.load_env()

@@ -37,37 +37,44 @@ class DistExecutor(Executor):
         profile = profile or RuntimeProfile("dist-query")
 
         def attempt(caps, p):
-            compiled = compile_distributed(
-                plan, self.catalog, caps, self.n, self.axis
-            )
-            with p.timer("scan_to_device"):
-                inputs = tuple(
-                    self.cache.chunk_for(
-                        self.catalog.get_table(t), a, cols,
-                        placement=(self.mesh, self.axis, m),
+            def compile_cb():
+                compiled = compile_distributed(
+                    plan, self.catalog, caps, self.n, self.axis
+                )
+                scans_meta = tuple(zip(compiled.scans, compiled.scan_modes))
+                inputs0 = self._place(scans_meta)
+                in_specs = tuple(
+                    jax.tree_util.tree_map(
+                        lambda _, mm=m: P(self.axis) if mm == SHARDED else P(),
+                        chunk,
                     )
-                    for (t, a, cols), m in zip(compiled.scans, compiled.scan_modes)
+                    for chunk, (_, m) in zip(inputs0, scans_meta)
                 )
-            in_specs = tuple(
-                jax.tree_util.tree_map(
-                    lambda _: P(self.axis) if m == SHARDED else P(), chunk
+                fn = jax.jit(
+                    shard_map(
+                        compiled.fn, mesh=self.mesh,
+                        in_specs=(in_specs,),
+                        out_specs=(P(), P(self.axis)),
+                        check_vma=False,
+                    )
                 )
-                for chunk, m in zip(inputs, compiled.scan_modes)
+                return fn, scans_meta
+
+            out, checks = self._cached_attempt(
+                ("dist", self.n, plan), caps, p, compile_cb, self._place
             )
-            fn = jax.jit(
-                shard_map(
-                    compiled.fn, mesh=self.mesh,
-                    in_specs=(in_specs,),
-                    out_specs=(P(), P(self.axis)),
-                    check_vma=False,
-                )
-            )
-            out, checks = fn(inputs)
-            jax.block_until_ready(out.data)
             p.set_info("n_shards", self.n)
             return out, [
-                (k, int(np.asarray(v).max()))
-                for k, v in zip(compiled.checks_meta, checks)
+                (k, int(np.asarray(v).max())) for k, v in checks.items()
             ]
 
         return self._adaptive(profile, attempt)
+
+    def _place(self, scans_meta):
+        return tuple(
+            self.cache.chunk_for(
+                self.catalog.get_table(t), a, cols,
+                placement=(self.mesh, self.axis, m),
+            )
+            for (t, a, cols), m in scans_meta
+        )

@@ -43,9 +43,27 @@ class DeviceCache:
     "replicated") = mesh placement for the distributed executor. One cache
     instance per Session so DML invalidation covers every execution path."""
 
+    MAX_CACHED_PLANS = 64
+
     def __init__(self):
         self._cols: dict = {}
         self._caps: dict = {}
+        # compiled-program cache: (tag, plan) -> {"last": caps, "progs":
+        # {caps items: entry}}. Plans are frozen value-hashable trees, so
+        # identical SQL re-runs skip trace+compile entirely. LRU-bounded.
+        from collections import OrderedDict
+
+        self.programs: OrderedDict = OrderedDict()
+
+    def program_bucket(self, key):
+        b = self.programs.get(key)
+        if b is None:
+            b = self.programs[key] = {"last": None, "progs": {}}
+            while len(self.programs) > self.MAX_CACHED_PLANS:
+                self.programs.popitem(last=False)
+        else:
+            self.programs.move_to_end(key)
+        return b
 
     def invalidate(self, table: str):
         self._cols = {k: v for k, v in self._cols.items() if k[0] != table}
@@ -242,20 +260,48 @@ class Executor:
         profile = profile or RuntimeProfile("query")
 
         def attempt(caps, p):
-            compiled = compile_plan(plan, self.catalog, caps)
-            with p.timer("scan_to_device"):
-                inputs = tuple(
+            def compile_cb():
+                compiled = compile_plan(plan, self.catalog, caps)
+                return jax.jit(compiled.fn), compiled.scans
+
+            def place_cb(scans):
+                return tuple(
                     self.cache.chunk_for(self.catalog.get_table(t), a, cols)
-                    for t, a, cols in compiled.scans
+                    for t, a, cols in scans
                 )
-            fn = jax.jit(compiled.fn)
-            out, checks = fn(inputs)
-            jax.block_until_ready(out.data)
-            return out, [
-                (k, int(v)) for k, v in zip(compiled.checks_meta, checks)
-            ]
+
+            out, checks = self._cached_attempt(
+                ("local", plan), caps, p, compile_cb, place_cb
+            )
+            return out, [(k, int(v)) for k, v in checks.items()]
 
         return self._adaptive(profile, attempt)
+
+    def _cached_attempt(self, cache_key, caps, p, compile_cb, place_cb):
+        """Shared program-cache protocol for local + distributed attempts.
+
+        Caching is retrace-safe: the traced fns keep ALL mutable state inside
+        the traced function and return overflow checks as a statically-keyed
+        dict, so a cached fn simply retraces when input structure changes
+        (DML growing a table, new string dictionaries)."""
+        bucket = self.cache.program_bucket(cache_key)
+        if not caps.values and bucket["last"]:
+            # adopt the last successful capacities: skips re-discovering
+            # overflows (and usually any recompile) on repeated queries
+            caps.values.update(bucket["last"])
+        hit = bucket["progs"].get(tuple(sorted(caps.values.items())))
+        if hit is None:
+            fn, scans = compile_cb()
+        else:
+            fn, scans = hit
+        with p.timer("scan_to_device"):
+            inputs = place_cb(scans)
+        out, checks = fn(inputs)
+        jax.block_until_ready(out.data)
+        # caps defaults fill during the first trace; record entries after it
+        bucket["progs"].setdefault(tuple(sorted(caps.values.items())), (fn, scans))
+        bucket["last"] = dict(caps.values)
+        return out, checks
 
 
 def _prettify_names(ht: HostTable) -> HostTable:

@@ -217,6 +217,8 @@ class Analyzer:
             plan, lowered_items, having, order_items = self._build_aggregate(
                 plan, group_exprs, lowered_items, having, order_items
             )
+            if sel.rollup:
+                plan = self._rollup_expand(plan)
             if having is not None:
                 plan = LFilter(plan, having)
 
@@ -539,6 +541,47 @@ class Analyzer:
         new_items = [(n, subst(e)) for n, e in items]
         new_order = [(subst(e), a, nf) for e, a, nf in order_items]
         return plan, new_items, new_order
+
+    def _rollup_expand(self, agg) -> LogicalPlan:
+        """GROUP BY ROLLUP(k1..kn) -> UNION ALL of n+1 levels, each
+        re-aggregated from the finest level (shared subtree; the physical
+        emitters memoize node emission so the finest agg computes once).
+        Dropped keys become typed NULL columns via null_of()."""
+        if not isinstance(agg, LAggregate) or not agg.group_by:
+            return agg
+        for _, a in agg.aggs:
+            if a.fn == "avg":
+                raise AnalyzerError("AVG with ROLLUP is not supported yet")
+            if a.distinct:
+                raise AnalyzerError(
+                    "DISTINCT aggregates with ROLLUP are not supported yet"
+                )
+
+        def merge_of(name, a):
+            if a.fn in ("count", "count_star", "sum"):
+                return AggExpr("sum", Col(name))
+            if a.fn in ("min", "max"):
+                return AggExpr(a.fn, Col(name))
+            raise AnalyzerError(f"{a.fn} with ROLLUP is not supported yet")
+
+        n = len(agg.group_by)
+        out_names = agg.output_names()
+        levels = [LProject(agg, tuple((nm, Col(nm)) for nm in out_names))]
+        for k in range(n - 1, -1, -1):
+            keep = agg.group_by[:k]
+            dropped = agg.group_by[k:]
+            sub_group = tuple((nm, Col(nm)) for nm, _ in keep)
+            sub_aggs = tuple(
+                (nm, merge_of(nm, a)) for nm, a in agg.aggs
+            ) + tuple((nm, AggExpr("min", Col(nm))) for nm, _ in dropped)
+            lvl = LAggregate(agg, sub_group, sub_aggs)
+            proj = (
+                tuple((nm, Col(nm)) for nm, _ in keep)
+                + tuple((nm, Call("null_of", Col(nm))) for nm, _ in dropped)
+                + tuple((nm, Col(nm)) for nm, _ in agg.aggs)
+            )
+            levels.append(LProject(lvl, proj))
+        return LUnion(tuple(levels))
 
     @staticmethod
     def _auto_name(e) -> str:

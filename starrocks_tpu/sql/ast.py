@@ -127,6 +127,7 @@ class Select:
     offset: int = 0
     distinct: bool = False
     ctes: tuple = ()  # tuple[(name, Select)]
+    rollup: bool = False  # GROUP BY ROLLUP(...)
 
 
 @dataclasses.dataclass(frozen=True)

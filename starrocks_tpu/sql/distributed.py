@@ -85,15 +85,20 @@ def compile_distributed(
 ) -> DistCompiled:
     scan_modes = scan_modes or plan_scan_modes(plan, catalog)
     scans: list = []
+    node_ord: dict = {}
+
+    def ordinal(p) -> int:
+        return node_ord.setdefault(p, len(node_ord))
+
     scan_index: dict = {}
     scan_mode_list: list = []
-    checks_meta: list = []
 
     def collect(p):
         if isinstance(p, LScan):
-            scan_index[id(p)] = len(scans)
-            scans.append((p.table, p.alias, p.columns))
-            scan_mode_list.append(scan_modes.get(id(p), REPLICATED))
+            if id(p) not in scan_index:
+                scan_index[id(p)] = len(scans)
+                scans.append((p.table, p.alias, p.columns))
+                scan_mode_list.append(scan_modes.get(id(p), REPLICATED))
         for c in p.children:
             collect(c)
 
@@ -104,194 +109,213 @@ def compile_distributed(
             return chunk
         return all_gather_chunk(chunk, axis)
 
-    def emit(p, inputs):
-        if isinstance(p, LScan):
-            i = scan_index[id(p)]
-            return inputs[i], [], scan_mode_list[i]
-        if isinstance(p, LFilter):
-            c, ch, m = emit(p.child, inputs)
-            return filter_chunk(c, p.predicate), ch, m
-        if isinstance(p, LProject):
-            c, ch, m = emit(p.child, inputs)
-            return (
-                project(c, [e for _, e in p.exprs], [n for n, _ in p.exprs]),
-                ch, m,
+    def step(inputs):
+        """Traced SPMD program; all mutable trace state lives inside (see
+        compile_plan) so cached jitted versions retrace safely. Overflow
+        checks return as {key: [1]-array} merged across shards by the host."""
+        emit_memo: dict = {}
+        checks: dict = {}
+
+        def emit(p):
+            if p in emit_memo:
+                return emit_memo[p]
+            out = _emit(p)
+            emit_memo[p] = out
+            return out
+
+        def _emit(p):
+            if isinstance(p, LScan):
+                i = scan_index[id(p)]
+                return inputs[i], scan_mode_list[i]
+            if isinstance(p, LFilter):
+                c, m = emit(p.child)
+                return filter_chunk(c, p.predicate), m
+            if isinstance(p, LProject):
+                c, m = emit(p.child)
+                return (
+                    project(c, [e for _, e in p.exprs], [n for n, _ in p.exprs]),
+                    m,
+                )
+            if isinstance(p, LWindow):
+                c, m = emit(p.child)
+                c = gather(c, m)
+                return window_op(c, p.partition_by, p.order_by, p.funcs), REPLICATED
+            if isinstance(p, LSort):
+                c, m = emit(p.child)
+                return sort_chunk(gather(c, m), p.keys, p.limit), REPLICATED
+            if isinstance(p, LLimit):
+                c, m = emit(p.child)
+                return limit_chunk(gather(c, m), p.limit, p.offset), REPLICATED
+            if isinstance(p, LUnion):
+                from ..ops.setops import union_all
+
+                out, m = emit(p.inputs[0])
+                out = gather(out, m)
+                for child in p.inputs[1:]:
+                    c2, m2 = emit(child)
+                    out = union_all(out, gather(c2, m2))
+                return out, REPLICATED
+            if isinstance(p, LAggregate):
+                return emit_agg(p)
+            if isinstance(p, LJoin):
+                return emit_join(p)
+            raise PlanError(f"cannot compile {type(p).__name__} distributed")
+
+        def emit_agg(p: LAggregate):
+            c, m = emit(p.child)
+            key = f"agg_{ordinal(p)}"
+            cap = caps.get(key, 1024)
+            if m == REPLICATED:
+                out, ng = hash_aggregate(c, p.group_by, p.aggs, cap)
+                checks[key] = ng[None]
+                return out, REPLICATED
+            # two-phase: local partial -> all_gather -> final
+            part, png = hash_aggregate(c, p.group_by, p.aggs, cap, mode=PARTIAL)
+            merged = all_gather_chunk(part, axis)
+            final_group_by = tuple((n, Col(n)) for n, _ in p.group_by)
+            out, ng = hash_aggregate(
+                merged, final_group_by, final_agg_exprs(p.aggs), cap, mode=FINAL
             )
-        if isinstance(p, LWindow):
-            c, ch, m = emit(p.child, inputs)
-            c = gather(c, m)
-            return window_op(c, p.partition_by, p.order_by, p.funcs), ch, REPLICATED
-        if isinstance(p, LSort):
-            c, ch, m = emit(p.child, inputs)
-            c = gather(c, m)
-            return sort_chunk(c, p.keys, p.limit), ch, REPLICATED
-        if isinstance(p, LLimit):
-            c, ch, m = emit(p.child, inputs)
-            c = gather(c, m)
-            return limit_chunk(c, p.limit, p.offset), ch, REPLICATED
-        if isinstance(p, LUnion):
-            from ..ops.setops import union_all
+            # both partial and final counts must fit the capacity
+            checks[key] = jnp.maximum(png, ng)[None]
+            return out, REPLICATED
 
-            out, ch, m = emit(p.inputs[0], inputs)
-            out = gather(out, m)
-            for child in p.inputs[1:]:
-                c2, ch2, m2 = emit(child, inputs)
-                out = union_all(out, gather(c2, m2))
-                ch = ch + ch2
-            return out, ch, REPLICATED
-        if isinstance(p, LAggregate):
-            return emit_agg(p, inputs)
-        if isinstance(p, LJoin):
-            return emit_join(p, inputs)
-        raise PlanError(f"cannot compile {type(p).__name__} distributed")
+        def emit_join(p: LJoin):
+            lc, lm = emit(p.left)
+            rc, rm = emit(p.right)
+            lcols = frozenset(p.left.output_names())
+            rcols = frozenset(p.right.output_names())
 
-    def emit_agg(p: LAggregate, inputs):
-        c, ch, m = emit(p.child, inputs)
-        key = f"agg_{id(p)}"
-        cap = caps.get(key, 1024)
-        if m == REPLICATED:
-            out, ng = hash_aggregate(c, p.group_by, p.aggs, cap)
-            checks_meta.append(key)
-            return out, ch + [ng[None]], REPLICATED
-        # two-phase: local partial -> all_gather -> final
-        part, png = hash_aggregate(c, p.group_by, p.aggs, cap, mode=PARTIAL)
-        merged = all_gather_chunk(part, axis)
-        final_group_by = tuple((n, Col(n)) for n, _ in p.group_by)
-        out, ng = hash_aggregate(
-            merged, final_group_by, final_agg_exprs(p.aggs), cap, mode=FINAL
-        )
-        checks_meta.append(key)
-        # both partial and final counts must fit the capacity
-        return out, ch + [jnp.maximum(png, ng)[None]], REPLICATED
+            probe_keys, build_keys, residual = [], [], []
+            for conj in (_conjuncts(p.condition) if p.condition is not None else []):
+                pair = _equi_pair(conj, lcols, rcols)
+                if pair is not None:
+                    probe_keys.append(pair[0])
+                    build_keys.append(pair[1])
+                else:
+                    residual.append(conj)
 
-    def emit_join(p: LJoin, inputs):
-        lc, lch, lm = emit(p.left, inputs)
-        rc, rch, rm = emit(p.right, inputs)
-        checks = lch + rch
-        lcols = frozenset(p.left.output_names())
-        rcols = frozenset(p.right.output_names())
+            kind = {
+                "inner": INNER, "left": LEFT_OUTER, "semi": LEFT_SEMI,
+                "anti": LEFT_ANTI, "cross": INNER,
+            }[p.kind]
 
-        probe_keys, build_keys, residual = [], [], []
-        for conj in (_conjuncts(p.condition) if p.condition is not None else []):
-            pair = _equi_pair(conj, lcols, rcols)
-            if pair is not None:
-                probe_keys.append(pair[0])
-                build_keys.append(pair[1])
+            if not probe_keys:
+                probe_keys, build_keys = [Lit(0)], [Lit(0)]
+                bit_widths = (2,)
+                unique = False
+                if lm == SHARDED and rm == SHARDED:
+                    # shuffling a constant key would funnel everything onto one
+                    # shard; gather the build side and cross-join locally
+                    rc = all_gather_chunk(rc, axis)
+                    rm = REPLICATED
             else:
-                residual.append(conj)
+                bit_widths = None
+                if len(probe_keys) > 1:
+                    widths = []
+                    for pk, bk in zip(probe_keys, build_keys):
+                        w1 = _key_bit_width(p.left, pk, catalog)
+                        w2 = _key_bit_width(p.right, bk, catalog)
+                        if w1 is None or w2 is None:
+                            widths = None
+                            break
+                        widths.append(max(w1, w2))
+                    if widths is None or sum(widths) > 63:
+                        raise PlanError("multi-key join without packable stats")
+                    bit_widths = tuple(widths)
+                build_key_names = frozenset(
+                    k.name for k in build_keys if isinstance(k, Col)
+                )
+                unique = len(build_key_names) == len(build_keys) and any(
+                    s <= build_key_names for s in unique_sets(p.right, catalog)
+                )
 
-        kind = {
-            "inner": INNER, "left": LEFT_OUTER, "semi": LEFT_SEMI,
-            "anti": LEFT_ANTI, "cross": INNER,
-        }[p.kind]
+            # build-side min/max runtime filter; with a sharded build the local
+            # bounds merge across shards via pmin/pmax (global-RF collective)
+            from ..runtime.config import config as _cfg
+            from ..ops.join import runtime_filter_mask
 
-        if not probe_keys:
-            probe_keys, build_keys = [Lit(0)], [Lit(0)]
-            bit_widths = (2,)
-            unique = False
-            if lm == SHARDED and rm == SHARDED:
-                # shuffling a constant key would funnel everything onto one
-                # shard; gather the build side and cross-join locally instead
+            if p.kind in ("inner", "semi", "cross") and probe_keys and not (
+                len(probe_keys) == 1 and isinstance(probe_keys[0], Lit)
+            ) and _cfg.get("enable_runtime_filters"):
+                rf_axis = axis if rm == SHARDED else None
+                lc = lc.and_sel(
+                    runtime_filter_mask(lc, rc, tuple(probe_keys),
+                                        tuple(build_keys), bit_widths, rf_axis)
+                )
+
+            # --- distribution strategy ---
+            if rm == SHARDED and lm == SHARDED:
+                # shuffle both sides by join key onto the mesh
+                kb = f"shufL_{ordinal(p)}"
+                cap_l = caps.get(kb, pad_capacity(lc.capacity // max(n_shards // 2, 1)))
+                lc, mxl = shuffle_chunk(lc, tuple(probe_keys), axis, n_shards, cap_l, bit_widths)
+                checks[kb] = mxl[None]
+                kb2 = f"shufR_{ordinal(p)}"
+                cap_r = caps.get(kb2, pad_capacity(rc.capacity // max(n_shards // 2, 1)))
+                rc, mxr = shuffle_chunk(rc, tuple(build_keys), axis, n_shards, cap_r, bit_widths)
+                checks[kb2] = mxr[None]
+                out_mode = SHARDED
+            elif rm == SHARDED:  # probe replicated, build sharded -> gather build
                 rc = all_gather_chunk(rc, axis)
-                rm = REPLICATED
-        else:
-            bit_widths = None
-            if len(probe_keys) > 1:
-                widths = []
-                for pk, bk in zip(probe_keys, build_keys):
-                    w1 = _key_bit_width(p.left, pk, catalog)
-                    w2 = _key_bit_width(p.right, bk, catalog)
-                    if w1 is None or w2 is None:
-                        widths = None
-                        break
-                    widths.append(max(w1, w2))
-                if widths is None or sum(widths) > 63:
-                    raise PlanError("multi-key join without packable stats")
-                bit_widths = tuple(widths)
-            build_key_names = frozenset(
-                k.name for k in build_keys if isinstance(k, Col)
-            )
-            unique = len(build_key_names) == len(build_keys) and any(
-                s <= build_key_names for s in unique_sets(p.right, catalog)
+                out_mode = REPLICATED if lm == REPLICATED else SHARDED
+            else:
+                # build replicated: local (broadcast) join; output follows probe
+                out_mode = lm
+
+            payload = (
+                [] if p.kind in ("semi", "anti") else list(p.right.output_names())
             )
 
-        # --- distribution strategy ---
-        if rm == SHARDED and lm == SHARDED:
-            # shuffle both sides by join key onto the mesh (HASH_PARTITIONED)
-            kb = f"shufL_{id(p)}"
-            cap_l = caps.get(kb, pad_capacity(lc.capacity // max(n_shards // 2, 1)))
-            lc, mxl = shuffle_chunk(lc, tuple(probe_keys), axis, n_shards, cap_l, bit_widths)
-            checks_meta.append(kb)
-            checks = checks + [mxl[None]]
-            kb2 = f"shufR_{id(p)}"
-            cap_r = caps.get(kb2, pad_capacity(rc.capacity // max(n_shards // 2, 1)))
-            rc, mxr = shuffle_chunk(rc, tuple(build_keys), axis, n_shards, cap_r, bit_widths)
-            checks_meta.append(kb2)
-            checks = checks + [mxr[None]]
-            out_mode = SHARDED
-        elif rm == SHARDED:  # probe replicated, build sharded -> gather build
-            rc = all_gather_chunk(rc, axis)
-            out_mode = REPLICATED if lm == REPLICATED else SHARDED
-        else:
-            # build replicated: local (broadcast) join; output follows probe
-            out_mode = lm
+            if residual and p.kind in ("semi", "anti"):
+                rid = f"__rowid_{ordinal(p)}"
+                rowid = jnp.arange(lc.capacity, dtype=jnp.int64)
+                lc2 = lc.with_columns([Field(rid, T.BIGINT, False)], [rowid], [None])
+                key = f"join_{ordinal(p)}"
+                cap = caps.get(key, pad_capacity(lc.capacity))
+                expanded, total = hash_join_expand(
+                    lc2, rc, tuple(probe_keys), tuple(build_keys), cap, INNER,
+                    payload=list(p.right.output_names()), bit_widths=bit_widths,
+                )
+                checks[key] = total[None]
+                matched = filter_chunk(expanded, and_all(residual))
+                ids, _ = hash_aggregate(matched, ((rid, Col(rid)),), (), lc.capacity)
+                out = hash_join_unique(
+                    lc2, ids, (Col(rid),), (Col(rid),),
+                    LEFT_SEMI if p.kind == "semi" else LEFT_ANTI, payload=[],
+                )
+                return out, out_mode
 
-        payload = (
-            [] if p.kind in ("semi", "anti") else list(p.right.output_names())
-        )
+            if unique and p.kind in ("inner", "left", "semi", "anti"):
+                if residual and p.kind != "inner":
+                    raise PlanError(f"residual on {p.kind} join unsupported")
+                out = hash_join_unique(
+                    lc, rc, tuple(probe_keys), tuple(build_keys), kind,
+                    payload=payload, bit_widths=bit_widths,
+                )
+                if residual:
+                    out = filter_chunk(out, and_all(residual))
+                return out, out_mode
 
-        if residual and p.kind in ("semi", "anti"):
-            rid = f"__rowid_{id(p)}"
-            rowid = jnp.arange(lc.capacity, dtype=jnp.int64)
-            lc2 = lc.with_columns([Field(rid, T.BIGINT, False)], [rowid], [None])
-            key = f"join_{id(p)}"
-            cap = caps.get(key, pad_capacity(lc.capacity))
-            expanded, total = hash_join_expand(
-                lc2, rc, tuple(probe_keys), tuple(build_keys), cap, INNER,
-                payload=list(p.right.output_names()), bit_widths=bit_widths,
-            )
-            checks_meta.append(key)
-            checks = checks + [total[None]]
-            matched = filter_chunk(expanded, and_all(residual))
-            ids, _ = hash_aggregate(matched, ((rid, Col(rid)),), (), lc.capacity)
-            out = hash_join_unique(
-                lc2, ids, (Col(rid),), (Col(rid),),
-                LEFT_SEMI if p.kind == "semi" else LEFT_ANTI, payload=[],
-            )
-            return out, checks, out_mode
-
-        if unique and p.kind in ("inner", "left", "semi", "anti"):
-            if residual and p.kind != "inner":
+            if residual and p.kind not in ("inner", "cross"):
                 raise PlanError(f"residual on {p.kind} join unsupported")
-            out = hash_join_unique(
-                lc, rc, tuple(probe_keys), tuple(build_keys), kind,
+            key = f"join_{ordinal(p)}"
+            cap = caps.get(key, pad_capacity(lc.capacity))
+            out, total = hash_join_expand(
+                lc, rc, tuple(probe_keys), tuple(build_keys), cap, kind,
                 payload=payload, bit_widths=bit_widths,
             )
+            if p.kind not in ("semi", "anti"):
+                checks[key] = total[None]
             if residual:
                 out = filter_chunk(out, and_all(residual))
-            return out, checks, out_mode
+            return out, out_mode
 
-        if residual and p.kind not in ("inner", "cross"):
-            raise PlanError(f"residual on {p.kind} join unsupported")
-        key = f"join_{id(p)}"
-        cap = caps.get(key, pad_capacity(lc.capacity))
-        out, total = hash_join_expand(
-            lc, rc, tuple(probe_keys), tuple(build_keys), cap, kind,
-            payload=payload, bit_widths=bit_widths,
-        )
-        if p.kind not in ("semi", "anti"):
-            checks_meta.append(key)
-            checks = checks + [total[None]]
-        if residual:
-            out = filter_chunk(out, and_all(residual))
-        return out, checks, out_mode
-
-    def step(inputs):
-        chunk, checks, mode = emit(plan, inputs)
+        chunk, mode = emit(plan)
         if mode == SHARDED:
             chunk = all_gather_chunk(chunk, axis)
-        return chunk, tuple(checks)
+        return chunk, checks
 
     return DistCompiled(
-        step, scans, scan_mode_list, checks_meta, plan.output_names(), n_shards
+        step, scans, scan_mode_list, None, plan.output_names(), n_shards
     )

@@ -135,7 +135,7 @@ class Parser:
         order_by, limit, offset = last.order_by, last.limit, last.offset
         selects[-1] = ast.Select(
             last.items, last.from_, last.where, last.group_by, last.having,
-            (), None, 0, last.distinct, last.ctes,
+            (), None, 0, last.distinct, last.ctes, last.rollup,
         )
         return ast.SetOp(
             tuple(selects), all_flags[0], order_by, limit, offset,
@@ -169,11 +169,22 @@ class Parser:
         if self.accept_kw("where"):
             where = self.parse_expr()
         group_by = ()
+        rollup = False
         if self.accept_kw("group"):
             self.expect_kw("by")
-            g = [self.parse_expr()]
-            while self.accept_op(","):
-                g.append(self.parse_expr())
+            if (self.peek().kind == "ident" and self.peek().value.lower() == "rollup"
+                    and self.peek(1).kind == "op" and self.peek(1).value == "("):
+                self.next()
+                self.next()
+                rollup = True
+                g = [self.parse_expr()]
+                while self.accept_op(","):
+                    g.append(self.parse_expr())
+                self.expect_op(")")
+            else:
+                g = [self.parse_expr()]
+                while self.accept_op(","):
+                    g.append(self.parse_expr())
             group_by = tuple(g)
         having = None
         if self.accept_kw("having"):
@@ -196,7 +207,7 @@ class Parser:
                 offset = int(self.next().value)
         return ast.Select(
             tuple(items), from_, where, group_by, having, tuple(order_by),
-            limit, offset, distinct, ctes,
+            limit, offset, distinct, ctes, rollup,
         )
 
     def parse_select_item(self) -> ast.SelectItem:

@@ -147,7 +147,10 @@ class Compiled:
 
 def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
     scans: list = []
-    checks_meta: list = []
+    node_ord: dict = {}  # plan node (by value) -> deterministic ordinal
+
+    def ordinal(p) -> int:
+        return node_ord.setdefault(p, len(node_ord))
 
     scan_index: dict = {}
 
@@ -156,173 +159,190 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
             # keyed by node identity: the same table+alias may be scanned by
             # independent plan nodes (outer query vs subquery) with different
             # column sets
-            scan_index[id(p)] = len(scans)
-            scans.append((p.table, p.alias, p.columns))
+            if id(p) not in scan_index:
+                scan_index[id(p)] = len(scans)
+                scans.append((p.table, p.alias, p.columns))
         for c in p.children:
             collect_scans(c)
 
     collect_scans(plan)
 
-    def emit(p: LogicalPlan, inputs):
-        """Returns (chunk, checks list) — called at trace time."""
-        if isinstance(p, LScan):
-            return inputs[scan_index[id(p)]], []
-        if isinstance(p, LFilter):
-            c, ch = emit(p.child, inputs)
-            return filter_chunk(c, p.predicate), ch
-        if isinstance(p, LProject):
-            c, ch = emit(p.child, inputs)
-            return project(c, [e for _, e in p.exprs], [n for n, _ in p.exprs]), ch
-        if isinstance(p, LSort):
-            c, ch = emit(p.child, inputs)
-            return sort_chunk(c, p.keys, p.limit), ch
-        if isinstance(p, LLimit):
-            c, ch = emit(p.child, inputs)
-            return limit_chunk(c, p.limit, p.offset), ch
-        if isinstance(p, LWindow):
-            c, ch = emit(p.child, inputs)
-            return window_op(c, p.partition_by, p.order_by, p.funcs), ch
-        if isinstance(p, LUnion):
-            from ..ops.setops import union_all
+    def run(inputs):
+        """The traced program. ALL mutable trace state lives inside this
+        function so cached jitted versions retrace safely (shape changes
+        after DML) — closure-level accumulators would be poisoned by dead
+        tracers. Overflow checks return as a dict with static keys."""
+        emit_memo: dict = {}  # keyed by node VALUE so equal-but-copied
+        checks: dict = {}     # subtrees (ROLLUP levels) emit once
 
-            out, ch = emit(p.inputs[0], inputs)
-            for child in p.inputs[1:]:
-                c2, ch2 = emit(child, inputs)
-                out = union_all(out, c2)
-                ch = ch + ch2
-            return out, ch
-        if isinstance(p, LAggregate):
-            c, ch = emit(p.child, inputs)
-            key = f"agg_{id(p)}"
-            cap = caps.get(key, 1024)
-            out, ng = hash_aggregate(c, p.group_by, p.aggs, cap)
-            checks_meta.append(key)
-            return out, ch + [ng]
-        if isinstance(p, LJoin):
-            return emit_join(p, inputs)
-        raise PlanError(f"cannot compile {type(p).__name__}")
+        def emit(p: LogicalPlan):
+            if p in emit_memo:
+                return emit_memo[p]
+            out = _emit(p)
+            emit_memo[p] = out
+            return out
 
-    def emit_join(p: LJoin, inputs):
-        lc, lch = emit(p.left, inputs)
-        rc, rch = emit(p.right, inputs)
-        checks = lch + rch
-        lcols = frozenset(p.left.output_names())
-        rcols = frozenset(p.right.output_names())
+        def _emit(p: LogicalPlan):
+            if isinstance(p, LScan):
+                return inputs[scan_index[id(p)]]
+            if isinstance(p, LFilter):
+                return filter_chunk(emit(p.child), p.predicate)
+            if isinstance(p, LProject):
+                c = emit(p.child)
+                return project(c, [e for _, e in p.exprs], [n for n, _ in p.exprs])
+            if isinstance(p, LSort):
+                return sort_chunk(emit(p.child), p.keys, p.limit)
+            if isinstance(p, LLimit):
+                return limit_chunk(emit(p.child), p.limit, p.offset)
+            if isinstance(p, LWindow):
+                return window_op(emit(p.child), p.partition_by, p.order_by, p.funcs)
+            if isinstance(p, LUnion):
+                from ..ops.setops import union_all
 
-        probe_keys, build_keys, residual = [], [], []
-        for conj in (_conjuncts(p.condition) if p.condition is not None else []):
-            pair = _equi_pair(conj, lcols, rcols)
-            if pair is not None:
-                probe_keys.append(pair[0])
-                build_keys.append(pair[1])
+                out = emit(p.inputs[0])
+                for child in p.inputs[1:]:
+                    out = union_all(out, emit(child))
+                return out
+            if isinstance(p, LAggregate):
+                c = emit(p.child)
+                key = f"agg_{ordinal(p)}"
+                cap = caps.get(key, 1024)
+                out, ng = hash_aggregate(c, p.group_by, p.aggs, cap)
+                checks[key] = ng
+                return out
+            if isinstance(p, LJoin):
+                return emit_join(p)
+            raise PlanError(f"cannot compile {type(p).__name__}")
+
+        def emit_join(p: LJoin):
+            lc = emit(p.left)
+            rc = emit(p.right)
+            lcols = frozenset(p.left.output_names())
+            rcols = frozenset(p.right.output_names())
+
+            probe_keys, build_keys, residual = [], [], []
+            for conj in (_conjuncts(p.condition) if p.condition is not None else []):
+                pair = _equi_pair(conj, lcols, rcols)
+                if pair is not None:
+                    probe_keys.append(pair[0])
+                    build_keys.append(pair[1])
+                else:
+                    residual.append(conj)
+
+            kind = {
+                "inner": INNER, "left": LEFT_OUTER, "semi": LEFT_SEMI,
+                "anti": LEFT_ANTI, "cross": INNER,
+            }[p.kind]
+
+            if not probe_keys:
+                # cross join: constant key matches everything
+                probe_keys = [Lit(0)]
+                build_keys = [Lit(0)]
+                bit_widths = (2,)
+                unique = False
             else:
-                residual.append(conj)
+                bit_widths = None
+                if len(probe_keys) > 1:
+                    widths = []
+                    for pk, bk in zip(probe_keys, build_keys):
+                        w1 = _key_bit_width(p.left, pk, catalog)
+                        w2 = _key_bit_width(p.right, bk, catalog)
+                        if w1 is None or w2 is None:
+                            widths = None
+                            break
+                        widths.append(max(w1, w2))
+                    if widths is None or sum(widths) > 63:
+                        raise PlanError(
+                            "multi-key join without packable stats unsupported"
+                        )
+                    bit_widths = tuple(widths)
+                build_key_names = frozenset(
+                    k.name for k in build_keys if isinstance(k, Col)
+                )
+                unique = len(build_key_names) == len(build_keys) and any(
+                    s <= build_key_names for s in unique_sets(p.right, catalog)
+                )
 
-        kind = {
-            "inner": INNER, "left": LEFT_OUTER, "semi": LEFT_SEMI,
-            "anti": LEFT_ANTI, "cross": INNER,
-        }[p.kind]
-
-        if not probe_keys:
-            # cross join: constant key matches everything
-            probe_keys = [Lit(0)]
-            build_keys = [Lit(0)]
-            bit_widths = (2,)
-            unique = False
-        else:
-            bit_widths = None
-            if len(probe_keys) > 1:
-                widths = []
-                for pk, bk in zip(probe_keys, build_keys):
-                    w1 = _key_bit_width(p.left, pk, catalog)
-                    w2 = _key_bit_width(p.right, bk, catalog)
-                    if w1 is None or w2 is None:
-                        widths = None
-                        break
-                    widths.append(max(w1, w2))
-                if widths is None or sum(widths) > 63:
-                    raise PlanError(
-                        "multi-key join without packable stats unsupported"
-                    )
-                bit_widths = tuple(widths)
-            build_key_names = frozenset(
-                k.name for k in build_keys if isinstance(k, Col)
-            )
-            unique = len(build_key_names) == len(build_keys) and any(
-                s <= build_key_names for s in unique_sets(p.right, catalog)
+            payload = (
+                [] if p.kind in ("semi", "anti") else list(p.right.output_names())
             )
 
-        payload = (
-            [] if p.kind in ("semi", "anti") else list(p.right.output_names())
-        )
+            # build-side min/max runtime filter on the probe (INNER/SEMI only —
+            # LEFT OUTER/ANTI must keep non-matching probe rows)
+            from ..runtime.config import config as _cfg
+            from ..ops.join import runtime_filter_mask
 
-        if residual and p.kind in ("semi", "anti"):
-            # Residual-capable (anti)semi join: tag probe rows with a rowid,
-            # inner-expand on the equi keys, filter by the residual, derive
-            # the set of matched rowids, then (anti)semi-join on rowid.
-            # (TPC-H Q21's correlated <> predicates take this path.)
-            import jax.numpy as jnp
+            if p.kind in ("inner", "semi", "cross") and probe_keys and not (
+                len(probe_keys) == 1 and isinstance(probe_keys[0], Lit)
+            ) and _cfg.get("enable_runtime_filters"):
+                lc = lc.and_sel(
+                    runtime_filter_mask(lc, rc, tuple(probe_keys),
+                                        tuple(build_keys), bit_widths)
+                )
 
-            from ..column.column import Field
-            from .. import types as T
+            if residual and p.kind in ("semi", "anti"):
+                # Residual-capable (anti)semi join: tag probe rows with a rowid,
+                # inner-expand on the equi keys, filter by the residual, derive
+                # the set of matched rowids, then (anti)semi-join on rowid.
+                # (TPC-H Q21's correlated <> predicates take this path.)
+                import jax.numpy as jnp
 
-            rid = f"__rowid_{id(p)}"
-            rowid = jnp.arange(lc.capacity, dtype=jnp.int64)
-            lc2 = lc.with_columns(
-                [Field(rid, T.BIGINT, False)], [rowid], [None]
-            )
-            key = f"join_{id(p)}"
-            cap = caps.get(key, pad_capacity(lc.capacity))
-            expanded, total = hash_join_expand(
-                lc2, rc, tuple(probe_keys), tuple(build_keys), cap, INNER,
-                payload=list(p.right.output_names()), bit_widths=bit_widths,
-            )
-            checks_meta.append(key)
-            checks = checks + [total]
-            matched = filter_chunk(expanded, and_all(residual))
-            ids, _ = hash_aggregate(
-                matched, ((rid, Col(rid)),), (), lc.capacity
-            )
-            out = hash_join_unique(
-                lc2, ids, (Col(rid),), (Col(rid),),
-                LEFT_SEMI if p.kind == "semi" else LEFT_ANTI,
-                payload=[],
-            )
-            return out, checks
+                from ..column.column import Field
+                from .. import types as T
 
-        if unique and p.kind in ("inner", "left", "semi", "anti"):
-            if residual and p.kind != "inner":
+                rid = f"__rowid_{ordinal(p)}"
+                rowid = jnp.arange(lc.capacity, dtype=jnp.int64)
+                lc2 = lc.with_columns(
+                    [Field(rid, T.BIGINT, False)], [rowid], [None]
+                )
+                key = f"join_{ordinal(p)}"
+                cap = caps.get(key, pad_capacity(lc.capacity))
+                expanded, total = hash_join_expand(
+                    lc2, rc, tuple(probe_keys), tuple(build_keys), cap, INNER,
+                    payload=list(p.right.output_names()), bit_widths=bit_widths,
+                )
+                checks[key] = total
+                matched = filter_chunk(expanded, and_all(residual))
+                ids, _ = hash_aggregate(
+                    matched, ((rid, Col(rid)),), (), lc.capacity
+                )
+                out = hash_join_unique(
+                    lc2, ids, (Col(rid),), (Col(rid),),
+                    LEFT_SEMI if p.kind == "semi" else LEFT_ANTI,
+                    payload=[],
+                )
+                return out
+
+            if unique and p.kind in ("inner", "left", "semi", "anti"):
+                if residual and p.kind != "inner":
+                    raise PlanError(f"residual predicate on {p.kind} join unsupported")
+                out = hash_join_unique(
+                    lc, rc, tuple(probe_keys), tuple(build_keys), kind,
+                    payload=payload, bit_widths=bit_widths,
+                )
+                if residual:
+                    out = filter_chunk(out, and_all(residual))
+                return out
+            # expansion join
+            if residual and p.kind not in ("inner", "cross"):
                 raise PlanError(f"residual predicate on {p.kind} join unsupported")
-            out = hash_join_unique(
-                lc, rc, tuple(probe_keys), tuple(build_keys), kind,
+            key = f"join_{ordinal(p)}"
+            default = pad_capacity(lc.capacity)
+            cap = caps.get(key, default)
+            out, total = hash_join_expand(
+                lc, rc, tuple(probe_keys), tuple(build_keys), cap, kind,
                 payload=payload, bit_widths=bit_widths,
             )
+            if p.kind not in ("semi", "anti"):
+                checks[key] = total
             if residual:
                 out = filter_chunk(out, and_all(residual))
-            return out, checks
-        # expansion join
-        if residual and p.kind not in ("inner", "cross"):
-            raise PlanError(f"residual predicate on {p.kind} join unsupported")
-        key = f"join_{id(p)}"
-        default = pad_capacity(lc.capacity)
-        cap = caps.get(key, default)
-        out, total = hash_join_expand(
-            lc, rc, tuple(probe_keys), tuple(build_keys), cap, kind,
-            payload=payload, bit_widths=bit_widths,
-        )
-        if p.kind in ("semi", "anti"):
-            return out, checks  # no expansion: no overflow possible
-        checks_meta.append(key)
-        checks = checks + [total]
-        if residual:
-            out = filter_chunk(out, and_all(residual))
-        return out, checks
+            return out
 
-    def run(inputs):
-        chunk, checks = emit(plan, inputs)
-        return chunk, tuple(checks)
+        chunk = emit(plan)
+        return chunk, checks
 
-    return Compiled(run, scans, checks_meta, plan.output_names())
+    return Compiled(run, scans, None, plan.output_names())
 
 
 def _equi_pair(conj: Expr, lcols: frozenset, rcols: frozenset):

@@ -1,0 +1,115 @@
+"""TPC-DS subset generator: the four tables Q67 needs.
+
+Reference behavior: the TPC-DS kit the reference benchmarks with
+(docs/en/benchmarking/TPC_DS_Benchmark.md; BASELINE.json lists Q67 —
+high-cardinality ROLLUP group-by + rank window — as a target config).
+Schema-faithful for store_sales / date_dim / item / store; simplified value
+distributions.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+
+from ... import types as T
+from ...column import HostTable, StringDict
+
+DEC = T.DECIMAL(7, 2)
+
+CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
+              "Men", "Music", "Shoes", "Sports", "Women"]
+
+
+def gen_tpcds(sf: float = 0.01, seed: int = 11) -> dict:
+    rng = np.random.default_rng(seed)
+    out = {}
+
+    # --- date_dim: 1998-2003 --------------------------------------------------
+    start = datetime.date(1998, 1, 1)
+    ndays = (datetime.date(2003, 12, 31) - start).days + 1
+    dates = [start + datetime.timedelta(days=int(i)) for i in range(ndays)]
+    d_sk = np.arange(2_450_000, 2_450_000 + ndays, dtype=np.int64)
+    out["date_dim"] = HostTable.from_pydict(
+        {
+            "d_date_sk": d_sk,
+            "d_year": np.array([d.year for d in dates], dtype=np.int32),
+            "d_moy": np.array([d.month for d in dates], dtype=np.int32),
+            "d_qoy": np.array([(d.month - 1) // 3 + 1 for d in dates], dtype=np.int32),
+            "d_month_seq": np.array(
+                [(d.year - 1998) * 12 + d.month - 1 for d in dates], dtype=np.int32
+            ),
+        },
+        types={"d_date_sk": T.BIGINT, "d_year": T.INT, "d_moy": T.INT,
+               "d_qoy": T.INT, "d_month_seq": T.INT},
+    )
+
+    # --- item ----------------------------------------------------------------
+    ni = max(int(18_000 * sf), 100)
+    i_sk = np.arange(1, ni + 1, dtype=np.int64)
+    cat_i = rng.integers(0, len(CATEGORIES), ni)
+    class_i = rng.integers(0, 16, ni)
+    brand_i = rng.integers(0, 50, ni)
+    classes = sorted({f"class{c:02d}" for c in range(16)})
+    class_dict = StringDict.from_values(classes)
+    brands = sorted({f"brand{b:02d}" for b in range(50)})
+    brand_dict = StringDict.from_values(brands)
+    pnames = sorted({f"product{p:04d}" for p in range(ni)})
+    pname_dict = StringDict.from_values(pnames)
+    out["item"] = HostTable.from_pydict(
+        {
+            "i_item_sk": i_sk,
+            "i_category": [CATEGORIES[i] for i in cat_i],
+            "i_class": (class_dict, class_i.astype(np.int32)),
+            "i_brand": (brand_dict, brand_i.astype(np.int32)),
+            "i_product_name": (pname_dict,
+                               pname_dict.encode([f"product{p:04d}" for p in range(ni)])),
+        },
+        types={"i_item_sk": T.BIGINT},
+    )
+
+    # --- store ---------------------------------------------------------------
+    ns = max(int(12 * (1 + np.log2(max(sf, 0.01)))), 4)
+    s_sk = np.arange(1, ns + 1, dtype=np.int64)
+    sids = sorted({f"S{k:04d}" for k in range(ns)})
+    sid_dict = StringDict.from_values(sids)
+    out["store"] = HostTable.from_pydict(
+        {
+            "s_store_sk": s_sk,
+            "s_store_id": (sid_dict, sid_dict.encode([f"S{k:04d}" for k in range(ns)])),
+        },
+        types={"s_store_sk": T.BIGINT},
+    )
+
+    # --- store_sales ---------------------------------------------------------
+    nss = max(int(2_880_000 * sf), 2000)
+    out["store_sales"] = HostTable.from_pydict(
+        {
+            "ss_sold_date_sk": d_sk[rng.integers(0, ndays, nss)],
+            "ss_item_sk": rng.integers(1, ni + 1, nss).astype(np.int64),
+            "ss_store_sk": rng.integers(1, ns + 1, nss).astype(np.int64),
+            "ss_quantity": rng.integers(1, 100, nss).astype(np.int32),
+            "ss_sales_price": np.round(rng.uniform(1.0, 200.0, nss), 2),
+        },
+        types={"ss_sold_date_sk": T.BIGINT, "ss_item_sk": T.BIGINT,
+               "ss_store_sk": T.BIGINT, "ss_quantity": T.INT,
+               "ss_sales_price": DEC},
+    )
+    return out
+
+
+TPCDS_UNIQUE_KEYS = {
+    "date_dim": [("d_date_sk",)],
+    "item": [("i_item_sk",)],
+    "store": [("s_store_sk",)],
+}
+
+
+def tpcds_catalog(sf: float = 0.01, seed: int = 11):
+    from ..catalog import Catalog
+
+    cat = Catalog()
+    for name, ht in gen_tpcds(sf, seed).items():
+        cat.register(name, ht, TPCDS_UNIQUE_KEYS.get(name, ()))
+    return cat

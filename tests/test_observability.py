@@ -74,3 +74,32 @@ def test_failpoint_action_and_times():
         s.sql("select count(*) c from t group by a > 0")
         s.sql("select count(*) c from t group by a > 0")
     assert calls == [1]  # times=1 limited the injection
+
+
+def test_program_cache_and_cap_adoption():
+    s = _sess()
+    q = "select a, sum(b) s from t group by a order by a"
+    import time
+    t0 = time.time(); r1 = s.sql(q).rows(); first = time.time() - t0
+    t0 = time.time(); r2 = s.sql(q).rows(); second = time.time() - t0
+    assert r1 == r2
+    assert second < first  # cached program, no re-trace
+    # learned capacities: an overflowing query runs 1 attempt the second time
+    s.sql("insert into t values (3, 1.0), (4, 1.0), (5, 1.0)")
+    qq = "select a, count(*) c from t group by a order by a"
+    s.sql(qq)
+    s.sql(qq)
+    attempts = sum(1 for c in s.last_profile.children if c.name.startswith("attempt"))
+    assert attempts == 1
+
+
+def test_program_cache_retrace_safe_after_dict_change():
+    # regression: cached programs must retrace cleanly when a string
+    # dictionary (jit-static schema metadata) changes after DML
+    s = Session()
+    s.sql("create table rc (g int, s varchar)")
+    s.sql("insert into rc values (1, 'a'), (2, 'b')")
+    q = "select s, count(*) c from rc group by s order by s"
+    assert s.sql(q).rows() == [("a", 1), ("b", 1)]
+    s.sql("insert into rc values (3, 'zzz')")
+    assert s.sql(q).rows() == [("a", 1), ("b", 1), ("zzz", 1)]
