@@ -742,8 +742,172 @@ def _f_substr(cc, a, start, length=None):
 
 @function("concat")
 def _f_concat(cc, *args):
-    # only literal-with-column or column-alone concat for now
-    raise NotImplementedError("concat on device pending")
+    """Dict-remap concat: works when at most ONE argument is a (dict) column
+    and the rest are string literals — the common SQL pattern. Column-column
+    concat would need a cross-product dictionary (planner-gated, later)."""
+    col_args = [a for a in args if a.dict is not None]
+    if len(col_args) > 1:
+        raise NotImplementedError("concat of multiple string columns")
+    for a in args:
+        if a.dict is None and not isinstance(a.data, (str, int, float, bool)):
+            raise NotImplementedError(
+                "concat requires string literals / one string column "
+                f"(got a {a.type} column)"
+            )
+    if not col_args:
+        return EVal("".join(str(a.data) for a in args), None, T.VARCHAR)
+    col = col_args[0]
+
+    def f(s):
+        return "".join(s if a is col else str(a.data) for a in args)
+
+    return _string_map_fn(cc, col, f)
+
+
+@function("length")
+def _f_length(cc, a):
+    assert a.dict is not None, "length() needs a string column"
+    lens = np.fromiter((len(str(v)) for v in a.dict.values),
+                       count=len(a.dict), dtype=np.int32)
+    n = max(len(a.dict), 1)
+    lut = jnp.asarray(lens) if len(a.dict) else jnp.zeros((1,), jnp.int32)
+    return EVal(lut[jnp.clip(a.data, 0, n - 1)], a.valid, T.INT)
+
+
+@function("trim")
+def _f_trim(cc, a):
+    return _string_map_fn(cc, a, str.strip)
+
+
+@function("ltrim")
+def _f_ltrim(cc, a):
+    return _string_map_fn(cc, a, str.lstrip)
+
+
+@function("rtrim")
+def _f_rtrim(cc, a):
+    return _string_map_fn(cc, a, str.rstrip)
+
+
+@function("replace")
+def _f_replace(cc, a, old, new):
+    o, n = str(old.data), str(new.data)
+    return _string_map_fn(cc, a, lambda s: s.replace(o, n))
+
+
+@function("ends_with")
+def _f_ends_with(cc, a, suf):
+    p = str(suf.data)
+    return _string_bool_fn(cc, a, lambda s: str(s).endswith(p))
+
+
+@function("round")
+def _f_round(cc, a, nd=None):
+    digits = 0 if nd is None else int(nd.data)
+    if a.type.is_decimal:
+        s = a.type.scale
+        if digits >= s:
+            return a
+        q = 10 ** (s - digits)
+        d = jnp.asarray(a.data, jnp.int64)
+        # round-half-away-from-zero on scaled ints
+        r = jnp.where(d >= 0, (d + q // 2) // q, -((-d + q // 2) // q)) * q
+        return EVal(r, a.valid, a.type)
+    d = jnp.asarray(a.data, jnp.float64)
+    f = 10.0 ** digits
+    # SQL rounds half away from zero (jnp.round is banker's half-to-even)
+    r = jnp.sign(d) * jnp.floor(jnp.abs(d) * f + 0.5) / f
+    return EVal(r, a.valid, T.DOUBLE)
+
+
+@function("floor")
+def _f_floor(cc, a):
+    d = _to_numeric(a, T.DOUBLE)
+    return EVal(jnp.floor(d), a.valid, T.DOUBLE)
+
+
+@function("ceil")
+def _f_ceil(cc, a):
+    d = _to_numeric(a, T.DOUBLE)
+    return EVal(jnp.ceil(d), a.valid, T.DOUBLE)
+
+
+@function("sqrt")
+def _f_sqrt(cc, a):
+    d = _to_numeric(a, T.DOUBLE)
+    neg = d < 0
+    out = jnp.sqrt(jnp.where(neg, 0.0, d))
+    return EVal(out, _and_valid(a.valid, ~neg), T.DOUBLE)
+
+
+@function("power")
+def _f_power(cc, a, b):
+    da = _to_numeric(a, T.DOUBLE)
+    db = _to_numeric(b, T.DOUBLE)
+    return EVal(jnp.power(da, db), _and_valid(a.valid, b.valid), T.DOUBLE)
+
+
+@function("exp")
+def _f_exp(cc, a):
+    return EVal(jnp.exp(_to_numeric(a, T.DOUBLE)), a.valid, T.DOUBLE)
+
+
+@function("ln")
+def _f_ln(cc, a):
+    d = _to_numeric(a, T.DOUBLE)
+    bad = d <= 0
+    return EVal(jnp.log(jnp.where(bad, 1.0, d)), _and_valid(a.valid, ~bad), T.DOUBLE)
+
+
+@function("greatest")
+def _f_greatest(cc, *args):
+    ct = args[0].type
+    for x in args[1:]:
+        ct = T.common_numeric_type(ct, x.type)
+    d = _to_numeric(args[0], ct)
+    v = args[0].valid
+    for x in args[1:]:
+        d = jnp.maximum(d, _to_numeric(x, ct))
+        v = _and_valid(v, x.valid)
+    return EVal(d, v, ct)
+
+
+@function("least")
+def _f_least(cc, *args):
+    ct = args[0].type
+    for x in args[1:]:
+        ct = T.common_numeric_type(ct, x.type)
+    d = _to_numeric(args[0], ct)
+    v = args[0].valid
+    for x in args[1:]:
+        d = jnp.minimum(d, _to_numeric(x, ct))
+        v = _and_valid(v, x.valid)
+    return EVal(d, v, ct)
+
+
+@function("datediff")
+def _f_datediff(cc, a, b):
+    a = _lit_as_date_if_str(a)
+    b = _lit_as_date_if_str(b)
+    return EVal(
+        jnp.asarray(_as_days(a), jnp.int32) - jnp.asarray(_as_days(b), jnp.int32),
+        _and_valid(a.valid, b.valid), T.INT,
+    )
+
+
+@function("dayofweek")
+def _f_dayofweek(cc, a):
+    a = _lit_as_date_if_str(a)
+    # 1970-01-01 was a Thursday; SQL convention: 1=Sunday .. 7=Saturday
+    days = jnp.asarray(_as_days(a), jnp.int64)
+    return EVal(((days + 4) % 7 + 1).astype(jnp.int32), a.valid, T.INT)
+
+
+@function("quarter")
+def _f_quarter(cc, a):
+    a = _lit_as_date_if_str(a)
+    y, m, d = _civil_from_days(_as_days(a))
+    return EVal((m - 1) // 3 + 1, a.valid, T.INT)
 
 
 def eval_expr(chunk: Chunk, e: Expr) -> EVal:

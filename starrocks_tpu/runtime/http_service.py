@@ -1,0 +1,121 @@
+"""HTTP SQL service.
+
+Reference behavior: the BE/FE HTTP surfaces (be/src/service/service_be/
+http_service.h, http/action/*: SQL execute, metrics, profile endpoints; FE
+http/rest/ExecuteSqlAction.java). Minimal but real server:
+
+  POST /query   {"sql": "..."}  -> {"columns": [...], "rows": [...], "ms": t}
+  GET  /metrics                 -> Prometheus text
+  GET  /profile                 -> last query's RuntimeProfile render
+  GET  /tables                  -> catalog listing
+
+Runs on the stdlib http.server (threaded); one Session per server, queries
+serialized by a lock (the engine itself is single-controller).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .metrics import metrics
+from .session import Session
+
+
+def make_handler(session: Session, lock: threading.Lock):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass  # quiet; metrics cover observability
+
+        def _send(self, code: int, body: str, ctype="application/json"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._send(200, metrics.render_prometheus(), "text/plain")
+            elif self.path == "/profile":
+                prof = getattr(session, "last_profile", None)
+                self._send(200, prof.render() if prof else "no queries yet",
+                           "text/plain")
+            elif self.path == "/tables":
+                self._send(200, json.dumps(sorted(session.catalog.tables)))
+            else:
+                self._send(404, json.dumps({"error": "not found"}))
+
+        def do_POST(self):
+            if self.path != "/query":
+                self._send(404, json.dumps({"error": "not found"}))
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                sql = payload["sql"]
+            except Exception as e:
+                self._send(400, json.dumps({"error": f"bad request: {e}"}))
+                return
+            t0 = time.time()
+            try:
+                with lock:
+                    res = session.sql(sql)
+                if res is None:
+                    body = {"ok": True}
+                elif isinstance(res, (list, str, int)):
+                    body = {"result": res}
+                else:
+                    body = {"columns": res.column_names, "rows": res.rows()}
+                body["ms"] = round((time.time() - t0) * 1000, 1)
+                self._send(200, json.dumps(body, default=str))
+            except Exception as e:
+                self._send(
+                    400,
+                    json.dumps({"error": f"{type(e).__name__}: {e}"}),
+                )
+
+    return Handler
+
+
+class SqlHttpServer:
+    def __init__(self, session: Session, host: str = "127.0.0.1", port: int = 0):
+        self.session = session
+        self._lock = threading.Lock()
+        self.httpd = ThreadingHTTPServer(
+            (host, port), make_handler(session, self._lock)
+        )
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def serve(data_dir: str | None = None, port: int = 8030):
+    """CLI entry: python -m starrocks_tpu.runtime.http_service"""
+    s = Session(data_dir=data_dir)
+    srv = SqlHttpServer(s, port=port)
+    print(f"starrocks_tpu SQL service on http://127.0.0.1:{srv.port}")
+    srv.httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    import sys
+
+    serve(
+        data_dir=sys.argv[1] if len(sys.argv) > 1 else None,
+        port=int(sys.argv[2]) if len(sys.argv) > 2 else 8030,
+    )

@@ -134,6 +134,8 @@ class Session:
             return None
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
+        if isinstance(stmt, ast.Delete):
+            return self._delete(stmt)
         if isinstance(stmt, ast.ShowTables):
             return sorted(self.catalog.tables)
         if isinstance(stmt, ast.Describe):
@@ -177,8 +179,64 @@ class Session:
         plan = optimize(plan, self.catalog)
         return plan_tree_str(plan)
 
+    def _delete(self, stmt: ast.Delete):
+        """DELETE FROM t [WHERE pred]: keep rows where pred is FALSE or NULL,
+        rewrite the table (reference analog: delete predicates applied at
+        read/compaction; here: immediate rewrite — object-store-first)."""
+        from ..exprs.ir import Call, Lit
+
+        handle = self.catalog.get_table(stmt.table)
+        if handle is None:
+            raise ValueError(f"unknown table {stmt.table}")
+        before = handle.row_count
+        if stmt.where is None:
+            kept = _empty_like(handle.schema)
+        else:
+            keep_pred = Call("not", Call("coalesce", stmt.where, Lit(False)))
+            sel = ast.Select(
+                items=(ast.SelectItem(ast.Star()),),
+                from_=ast.TableRef(stmt.table, None),
+                where=keep_pred,
+            )
+            kept = self._query(sel).table
+        self._replace_table_data(handle, kept)
+        return before - kept.num_rows
+
+    def _replace_table_data(self, handle, data: HostTable):
+        from ..storage.catalog import StoredTableHandle
+
+        conformed = _conform_to_schema(handle.schema, data)
+        if self.store is not None and isinstance(handle, StoredTableHandle):
+            self.store.rewrite_table(handle.name, conformed)
+            handle.invalidate()
+        else:
+            self.catalog.register(handle.name, conformed, handle.unique_keys)
+        self.cache.invalidate(handle.name)
+
     # --- DDL / DML -------------------------------------------------------------
     def _create(self, stmt: ast.CreateTable):
+        if stmt.select is not None:
+            # CREATE TABLE .. AS SELECT: schema inferred from the result
+            res = self._query(stmt.select)
+            t = res.table
+            if any("." in f.name for f in t.schema):
+                raise ValueError(
+                    "CTAS query has duplicate column names; alias them: "
+                    f"{[f.name for f in t.schema if '.' in f.name]}"
+                )
+            if self.store is not None:
+                from ..storage.catalog import StoredTableHandle
+
+                name = stmt.name.lower()
+                self.store.create_table(name, t.schema, (), 1)
+                h = StoredTableHandle(name, self.store, t.schema)
+                self.catalog.register_handle(h)
+                if t.num_rows:
+                    self.store.insert(name, t)
+                    h.invalidate()
+            else:
+                self.catalog.register(stmt.name, t, unique_keys=())
+            return t.num_rows
         fields, arrays = [], {}
         for c in stmt.columns:
             t = c.type
@@ -236,12 +294,7 @@ class Session:
 
         if self.store is not None and isinstance(handle, StoredTableHandle):
             # conform incoming data to the declared schema before persisting
-            empty = HostTable(
-                handle.schema,
-                {f.name: np.zeros(0, dtype=f.type.np_dtype) for f in handle.schema},
-                {},
-            )
-            conformed = concat_tables(empty, incoming, target_schema=handle.schema)
+            conformed = _conform_to_schema(handle.schema, incoming)
             n = self.store.insert(handle.name, conformed)
             handle.invalidate()
         else:
@@ -313,3 +366,16 @@ def concat_tables(a: HostTable, b: HostTable, target_schema: Schema) -> HostTabl
             vb = vb if vb is not None else np.ones(len(ba), dtype=np.bool_)
             valids[name] = np.concatenate([va, vb])
     return HostTable(Schema(tuple(fields)), arrays, valids)
+
+
+def _empty_like(schema: Schema) -> HostTable:
+    return HostTable(
+        schema,
+        {f.name: np.zeros(0, dtype=f.type.np_dtype) for f in schema},
+        {},
+    )
+
+
+def _conform_to_schema(schema: Schema, data: HostTable) -> HostTable:
+    """Coerce `data` (positionally name-matched) onto the declared schema."""
+    return concat_tables(_empty_like(schema), data, target_schema=schema)

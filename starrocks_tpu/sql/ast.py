@@ -152,10 +152,17 @@ class ColumnDef:
 @dataclasses.dataclass(frozen=True)
 class CreateTable:
     name: str
-    columns: tuple  # tuple[ColumnDef]
+    columns: tuple  # tuple[ColumnDef]; empty for CTAS
     distributed_by: tuple = ()  # hash distribution keys
     buckets: int = 0
     properties: tuple = ()
+    select: object = None  # Select | SetOp for CREATE TABLE .. AS SELECT
+
+
+@dataclasses.dataclass(frozen=True)
+class Delete:
+    table: str
+    where: object  # Expr | None (None = delete all rows)
 
 
 @dataclasses.dataclass(frozen=True)

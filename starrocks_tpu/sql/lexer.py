@@ -19,7 +19,7 @@ KEYWORDS = {
     "nulls", "first", "last", "explain", "analyze", "year", "month", "day",
     "distributed", "hash", "buckets", "properties", "substring", "any",
     "over", "partition", "rows", "range", "unbounded", "preceding", "current",
-    "show", "describe", "desc", "tables",
+    "show", "describe", "desc", "tables", "delete", "truncate",
 }
 
 

@@ -25,7 +25,13 @@ SCALAR_FUNCS = {
     "substr": "substr", "substring": "substr",
     "upper": "upper", "lower": "lower", "abs": "abs",
     "coalesce": "coalesce", "if": "if", "mod": "mod",
-    "starts_with": "starts_with", "concat": "concat",
+    "starts_with": "starts_with", "ends_with": "ends_with",
+    "concat": "concat", "length": "length", "char_length": "length",
+    "trim": "trim", "ltrim": "ltrim", "rtrim": "rtrim", "replace": "replace",
+    "round": "round", "floor": "floor", "ceil": "ceil", "ceiling": "ceil",
+    "sqrt": "sqrt", "power": "power", "pow": "power", "exp": "exp", "ln": "ln",
+    "greatest": "greatest", "least": "least", "datediff": "datediff",
+    "dayofweek": "dayofweek", "quarter": "quarter", "null_of": "null_of",
     "date_add_days": "date_add_days", "date_add_months": "date_add_months",
 }
 
@@ -100,6 +106,19 @@ class Parser:
             return self.parse_insert()
         if self.at_kw("drop"):
             return self.parse_drop()
+        if self.accept_kw("delete"):
+            self.expect_kw("from")
+            name = self.parse_table_name()
+            where = None
+            if self.accept_kw("where"):
+                where = self.parse_expr()
+            self.accept_op(";")
+            return ast.Delete(name, where)
+        if self.accept_kw("truncate"):
+            self.accept_kw("table")
+            name = self.parse_table_name()
+            self.accept_op(";")
+            return ast.Delete(name, None)
         if self.accept_kw("show"):
             self.expect_kw("tables")
             self.accept_op(";")
@@ -633,6 +652,10 @@ class Parser:
         self.expect_kw("create")
         self.expect_kw("table")
         name = self.expect_ident()
+        if self.accept_kw("as"):
+            sel = self.parse_select()
+            self.accept_op(";")
+            return ast.CreateTable(name, (), select=sel)
         self.expect_op("(")
         cols = []
         while True:

@@ -164,6 +164,18 @@ class TabletStore:
             bucket = np.zeros(n, dtype=np.int64)
 
         rid = m["next_rowset"]
+        files = self._write_rowset_files(name, rid, data, bucket, nb)
+        m["rowsets"].append({"id": rid, "files": files, "rows": n})
+        m["next_rowset"] = rid + 1
+        self._write_manifest(name, m)
+        if record:
+            self.log({"op": "insert", "table": name, "rowset": rid, "rows": n})
+        return n
+
+    def _write_rowset_files(self, name, rid, data, bucket, nb):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
         files = []
         table = _to_arrow(data)
         for b in range(nb):
@@ -180,11 +192,37 @@ class TabletStore:
                 "rows": rows,
                 "zonemap": _zonemap(data, sel),
             })
-        m["rowsets"].append({"id": rid, "files": files, "rows": n})
+        return files
+
+    def rewrite_table(self, name: str, data: HostTable, record: bool = True) -> int:
+        """Atomically replace a table's rows (DELETE/TRUNCATE rewrite): the
+        replacement rowset is written FIRST, then the manifest swaps via
+        os.replace; old files are removed only after the swap. A crash
+        mid-rewrite leaves either the old or the new state, never data loss."""
+        import numpy as np
+
+        m = self.read_manifest(name)
+        old_files = [
+            f["file"] for rs in m["rowsets"] for f in rs["files"]
+        ]
+        rid = m["next_rowset"]
+        n = data.num_rows
+        if n:
+            bucket = np.zeros(n, dtype=np.int64)
+            nb = 1
+            files = self._write_rowset_files(name, rid, data, bucket, nb)
+            m["rowsets"] = [{"id": rid, "files": files, "rows": n}]
+        else:
+            m["rowsets"] = []
         m["next_rowset"] = rid + 1
-        self._write_manifest(name, m)
+        self._write_manifest(name, m)  # atomic swap: new state is now durable
+        for f in old_files:
+            try:
+                os.remove(os.path.join(self._tdir(name), f))
+            except OSError:
+                pass
         if record:
-            self.log({"op": "insert", "table": name, "rowset": rid, "rows": n})
+            self.log({"op": "rewrite", "table": name, "rows": n})
         return n
 
     # --- read path ------------------------------------------------------------
