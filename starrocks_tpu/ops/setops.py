@@ -46,3 +46,35 @@ def union_all(a: Chunk, b: Chunk) -> Chunk:
         fields.append(Field(fa.name, fa.type, True, dict_))
     sel = jnp.concatenate([a.sel_mask(), b.sel_mask()])
     return Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)
+
+
+def concat_many(chunks) -> Chunk:
+    """Concatenate k same-schema chunks with ONE device concatenate per
+    column (the O(k) merge for batched/spill partial states)."""
+    chunks = list(chunks)
+    if len(chunks) == 1:
+        return chunks[0]
+    first = chunks[0]
+    for c in chunks[1:]:
+        assert len(c.schema) == len(first.schema), "concat arity mismatch"
+    fields, data, valid = [], [], []
+    for i, f in enumerate(first.schema.fields):
+        dicts = {id(c.schema.fields[i].dict) for c in chunks}
+        if f.type.is_string and len(dicts) > 1:
+            # rare for batched partials (same source dicts); merge pairwise
+            out = chunks[0]
+            for c in chunks[1:]:
+                out = union_all(out, c)
+            return out
+        data.append(jnp.concatenate([c.data[i] for c in chunks]))
+        if all(c.valid[i] is None for c in chunks):
+            valid.append(None)
+        else:
+            valid.append(jnp.concatenate([
+                c.valid[i] if c.valid[i] is not None
+                else jnp.ones((c.capacity,), jnp.bool_)
+                for c in chunks
+            ]))
+        fields.append(f)
+    sel = jnp.concatenate([c.sel_mask() for c in chunks])
+    return Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)

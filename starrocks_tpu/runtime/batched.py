@@ -1,0 +1,153 @@
+"""Batched (host-offload) aggregation: the spill story.
+
+Reference behavior: the spill framework (be/src/compute_env/spill/spiller.h:161
+— partitioned mem-tables spilled to disk when aggregation state exceeds
+memory) and SURVEY §7's re-design guidance: on TPU the "scale one big thing"
+tool is chunked host->device streaming, not a literal Spiller port. Device
+HBM holds one batch at a time; aggregate state stays tiny (PARTIAL states),
+and batches stream through one compiled program:
+
+    for each row-batch of the big table (host -> device):
+        partial_b = jit[scan chain + PARTIAL agg](batch)     # compiled once
+    merged = concat(partial_0..partial_k)                    # one concatenate
+    result = jit[FINAL agg + remaining plan](merged)
+
+Applies when the plan is an aggregation whose input chain is Filter/Project
+over ONE big scan (the classic scan-agg shape, e.g. TPC-H Q1 at scale
+factors whose lineitem exceeds HBM). Overflow handling and program caching
+ride the executor's shared machinery (_adaptive + DeviceCache.programs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from ..column import Chunk
+from ..column.column import Schema, chunk_from_arrays, pad_capacity
+from ..exprs.ir import Col
+from ..ops import filter_chunk, hash_aggregate, limit_chunk, project, sort_chunk
+from ..ops.aggregate import FINAL, PARTIAL, final_agg_exprs
+from ..ops.setops import concat_many
+from ..sql.logical import (
+    LAggregate, LFilter, LLimit, LProject, LScan, LSort, LogicalPlan,
+)
+
+GROUP_CAP_KEY = "batched_agg"
+
+
+@dataclasses.dataclass
+class BatchablePlan:
+    top_chain: list  # nodes above the aggregate, outermost first
+    agg: LAggregate
+    scan_chain: list  # nodes between agg and scan, topmost first
+    scan: LScan
+
+
+def match_batchable(plan: LogicalPlan) -> BatchablePlan | None:
+    """Top chain (Project/Sort/Limit/Filter)* -> LAggregate ->
+    (Filter/Project)* -> LScan."""
+    top = []
+    node = plan
+    while isinstance(node, (LProject, LSort, LLimit, LFilter)):
+        top.append(node)
+        node = node.child
+    if not isinstance(node, LAggregate):
+        return None
+    agg = node
+    chain = []
+    node = agg.child
+    while isinstance(node, (LFilter, LProject)):
+        chain.append(node)
+        node = node.child
+    if not isinstance(node, LScan):
+        return None
+    return BatchablePlan(top, agg, chain, node)
+
+
+def make_programs(bp: BatchablePlan, group_cap: int):
+    """Build the (partial, final) jitted programs for one capacity setting.
+    All trace state is created per call; the executor caches the pair."""
+
+    def partial_program(chunk: Chunk):
+        c = chunk
+        for node in reversed(bp.scan_chain):
+            if isinstance(node, LFilter):
+                c = filter_chunk(c, node.predicate)
+            else:
+                c = project(c, [e for _, e in node.exprs], [n for n, _ in node.exprs])
+        return hash_aggregate(
+            c, bp.agg.group_by, bp.agg.aggs, group_cap, mode=PARTIAL
+        )
+
+    final_group_by = tuple((n, Col(n)) for n, _ in bp.agg.group_by)
+
+    def final_program(m: Chunk):
+        out, ng = hash_aggregate(
+            m, final_group_by, final_agg_exprs(bp.agg.aggs), group_cap,
+            mode=FINAL,
+        )
+        c = out
+        for node in reversed(bp.top_chain):
+            if isinstance(node, LFilter):
+                c = filter_chunk(c, node.predicate)
+            elif isinstance(node, LProject):
+                c = project(c, [e for _, e in node.exprs], [n for n, _ in node.exprs])
+            elif isinstance(node, LSort):
+                c = sort_chunk(c, node.keys, node.limit)
+            else:
+                c = limit_chunk(c, node.limit, node.offset)
+        return c, ng
+
+    return jax.jit(partial_program), jax.jit(final_program)
+
+
+def execute_batched(
+    bp: BatchablePlan, catalog, caps, profile_node, batch_rows: int,
+    programs_cache: dict,
+):
+    """One attempt: stream batches, merge, finalize.
+
+    Returns (chunk, [(cap_key, true_group_count)]) for the executor's shared
+    adaptive loop."""
+    handle = catalog.get_table(bp.scan.table)
+    ht = handle.table
+    total = ht.num_rows
+    n_batches = max(1, -(-total // batch_rows))
+    cap = pad_capacity(min(batch_rows, total))
+
+    group_cap = caps.get(GROUP_CAP_KEY, 4096)
+    prog_key = (bp.agg, tuple(bp.scan_chain), tuple(bp.top_chain), group_cap, cap)
+    if prog_key not in programs_cache:
+        programs_cache[prog_key] = make_programs(bp, group_cap)
+    jpartial, jfinal = programs_cache[prog_key]
+
+    alias = bp.scan.alias
+    cols = bp.scan.columns
+    profile_node.set_info("batches", n_batches)
+    profile_node.set_info("batch_rows", batch_rows)
+
+    partials = []
+    max_ng = 0
+    for b in range(n_batches):
+        lo, hi = b * batch_rows, min((b + 1) * batch_rows, total)
+        arrays = {f"{alias}.{c}": ht.arrays[c][lo:hi] for c in cols}
+        valids = {
+            f"{alias}.{c}": ht.valids[c][lo:hi] for c in cols if c in ht.valids
+        }
+        fields = tuple(
+            dataclasses.replace(ht.schema.field(c), name=f"{alias}.{c}")
+            for c in cols
+        )
+        chunk = chunk_from_arrays(
+            Schema(fields), arrays, valids, hi - lo, capacity=cap
+        )
+        out, ng = jpartial(chunk)
+        partials.append(out)
+        max_ng = max(max_ng, int(ng))
+
+    merged = concat_many(partials)
+    out, ng = jfinal(merged)
+    max_ng = max(max_ng, int(ng))
+    return out, [(GROUP_CAP_KEY, max_ng)]

@@ -78,6 +78,12 @@ config.define("max_recompiles", 6, True, "adaptive capacity recompile limit per 
 config.define("join_expand_headroom", 1.2, True, "growth factor applied on capacity overflow")
 config.define("enable_zonemap_pruning", True, True, "prune parquet rowsets by zonemap stats")
 config.define("enable_runtime_filters", True, True, "build-side min/max filters applied to join probes")
+config.define("batch_rows_threshold", 0, True,
+              "stream scan-aggregations in host batches when a table exceeds "
+              "this many rows (0 = off); the spill/host-offload path")
+config.define("spill_batch_rows", 0, True,
+              "rows per streamed batch for the spill path (0 = use the "
+              "activation threshold as the batch size)")
 config.define("bench_sf", 1.0, True, "scale factor used by bench.py")
 config.define("profile_queries", True, True, "collect RuntimeProfile for every query")
 config.load_env()

@@ -259,6 +259,12 @@ class Executor:
     def _run(self, plan: LogicalPlan, profile: RuntimeProfile | None = None) -> Chunk:
         profile = profile or RuntimeProfile("query")
 
+        batch_threshold = config.get("batch_rows_threshold")
+        if batch_threshold:
+            out = self._try_batched(plan, profile, batch_threshold)
+            if out is not None:
+                return out
+
         def attempt(caps, p):
             def compile_cb():
                 compiled = compile_plan(plan, self.catalog, caps)
@@ -274,6 +280,28 @@ class Executor:
                 ("local", plan), caps, p, compile_cb, place_cb
             )
             return out, [(k, int(v)) for k, v in checks.items()]
+
+        return self._adaptive(profile, attempt)
+
+    def _try_batched(self, plan, profile, batch_threshold):
+        """Host-offload streaming for big scan-aggregations (spill analog).
+        Rides the shared _adaptive loop (headroom config, profile attempts,
+        RECOMPILES metric) and caches the partial/final jitted programs."""
+        from .batched import execute_batched, match_batchable
+
+        bp = match_batchable(plan)
+        if bp is None:
+            return None
+        handle = self.catalog.get_table(bp.scan.table)
+        if handle is None or handle.row_count <= batch_threshold:
+            return None
+        batch_rows = config.get("spill_batch_rows") or batch_threshold
+        prog_cache = self.cache.program_bucket(("batched", plan))["progs"]
+
+        def attempt(caps, p):
+            return execute_batched(
+                bp, self.catalog, caps, p, batch_rows, prog_cache
+            )
 
         return self._adaptive(profile, attempt)
 

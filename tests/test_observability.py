@@ -103,3 +103,31 @@ def test_program_cache_retrace_safe_after_dict_change():
     assert s.sql(q).rows() == [("a", 1), ("b", 1)]
     s.sql("insert into rc values (3, 'zzz')")
     assert s.sql(q).rows() == [("a", 1), ("b", 1), ("zzz", 1)]
+
+
+def test_batched_aggregation_spill_path():
+    # host-offload streaming (spill analog): results identical to one-shot
+    from starrocks_tpu.storage.catalog import tpch_catalog
+
+    cat = tpch_catalog(sf=0.005)
+    q = """select l_returnflag, sum(l_quantity) q, count(*) c,
+           avg(l_discount) a, min(l_extendedprice) mn
+           from lineitem where l_shipdate <= date '1998-09-02'
+           group by l_returnflag order by l_returnflag"""
+    ref = Session(cat).sql(q).rows()
+    config.set("batch_rows_threshold", 4000)
+    try:
+        s = Session(cat)
+        got = s.sql(q).rows()
+        assert got == ref
+        info = s.last_profile.find("attempt_0").infos
+        assert info["batches"] >= 2
+        # high-cardinality group-by: overflow-recompile inside the batched path
+        q2 = "select l_orderkey, sum(l_quantity) s from lineitem group by l_orderkey"
+        config.set("batch_rows_threshold", 0)
+        ref2 = sorted(Session(cat).sql(q2).rows())  # one-shot oracle
+        config.set("batch_rows_threshold", 4000)
+        got2 = sorted(Session(cat).sql(q2).rows())
+        assert got2 == ref2
+    finally:
+        config.set("batch_rows_threshold", 0)
