@@ -136,6 +136,13 @@ class Session:
             return self._insert(stmt)
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt)
+        if isinstance(stmt, ast.Update):
+            return self._update(stmt)
+        if isinstance(stmt, ast.SetVar):
+            from .config import config
+
+            config.set(stmt.name, stmt.value)
+            return None
         if isinstance(stmt, ast.ShowTables):
             return sorted(self.catalog.tables)
         if isinstance(stmt, ast.Describe):
@@ -202,6 +209,78 @@ class Session:
         self._replace_table_data(handle, kept)
         return before - kept.num_rows
 
+    def _update(self, stmt: ast.Update):
+        """UPDATE t SET c = expr [WHERE pred]: evaluated as a full-table
+        projection (CASE WHEN pred THEN expr ELSE c END) + rewrite."""
+        from ..exprs.ir import Call, Case, Lit
+        from ..sql import ast as A
+
+        handle = self.catalog.get_table(stmt.table)
+        if handle is None:
+            raise ValueError(f"unknown table {stmt.table}")
+        assigned = dict(stmt.assignments)
+        pk_cols = {k for ks in handle.unique_keys for k in ks}
+        for c in assigned:
+            if c not in {f.name for f in handle.schema}:
+                raise ValueError(f"unknown column {c!r} in UPDATE")
+            if c in pk_cols:
+                raise ValueError(
+                    f"cannot UPDATE primary-key column {c!r} (delete+insert)"
+                )
+        items = []
+        for f in handle.schema:
+            if f.name in assigned:
+                new = assigned[f.name]
+                if stmt.where is not None:
+                    cond = Call("coalesce", stmt.where, Lit(False))
+                    new = Case(((cond, new),), A.RawCol(None, f.name))
+                items.append(A.SelectItem(new, f.name))
+            else:
+                items.append(A.SelectItem(A.RawCol(None, f.name), f.name))
+        sel = A.Select(items=tuple(items), from_=A.TableRef(stmt.table, None))
+        updated = self._query(sel).table
+        if stmt.where is not None:
+            from ..exprs.ir import AggExpr
+
+            cnt_sel = A.Select(
+                items=(A.SelectItem(AggExpr("count", None), "n"),),
+                from_=A.TableRef(stmt.table, None),
+                where=stmt.where,
+            )
+            affected = self._query(cnt_sel).rows()[0][0]
+        else:
+            affected = handle.row_count
+        self._replace_table_data(handle, updated)
+        return affected
+
+    def _upsert_merge(self, handle, merged: HostTable) -> HostTable:
+        """PRIMARY KEY model: keep the LAST row per key (merge-on-write;
+        reference analog: primary-key tables' upsert apply,
+        be/src/storage/tablet_updates.h:108 — re-designed as immediate
+        dedupe since rowsets rewrite atomically anyway)."""
+        keys = [k for ks in handle.unique_keys for k in ks]
+        if not keys:
+            return merged
+        import numpy as np
+
+        cols = [np.asarray(merged.arrays[k]) for k in keys]
+        # np.lexsort: LAST tuple element is the primary key; stable sort
+        # preserves insertion order within equal keys (last-write-wins)
+        order = np.lexsort(tuple(reversed(cols)))
+        sorted_keys = [c[order] for c in cols]
+        is_last = np.ones(merged.num_rows, dtype=bool)
+        if merged.num_rows > 1:
+            same_as_next = np.ones(merged.num_rows - 1, dtype=bool)
+            for c in sorted_keys:
+                same_as_next &= c[:-1] == c[1:]
+            is_last[:-1] = ~same_as_next
+        keep_idx = np.sort(order[is_last])
+        return HostTable(
+            merged.schema,
+            {n: a[keep_idx] for n, a in merged.arrays.items()},
+            {n: v[keep_idx] for n, v in merged.valids.items()},
+        )
+
     def _replace_table_data(self, handle, data: HostTable):
         from ..storage.catalog import StoredTableHandle
 
@@ -244,21 +323,26 @@ class Session:
             fields.append(Field(c.name, t, c.nullable, d))
             arrays[c.name] = np.zeros(0, dtype=t.np_dtype)
         schema = Schema(tuple(fields))
-        # DISTRIBUTED BY HASH is bucketing, NOT a uniqueness guarantee, so it
-        # must not feed unique_keys; key-model DDL (PRIMARY/UNIQUE KEY) will
+        # DISTRIBUTED BY HASH is bucketing, NOT a uniqueness guarantee; the
+        # PRIMARY KEY clause is one (upsert model enforces it on write)
+        pk = [stmt.primary_key] if stmt.primary_key else []
+        for k in stmt.primary_key:
+            if k not in {f.name for f in schema}:
+                raise ValueError(f"PRIMARY KEY column {k!r} not in schema")
         if self.store is not None:
             from ..storage.catalog import StoredTableHandle
 
             name = stmt.name.lower()
             self.store.create_table(
-                name, schema, stmt.distributed_by, stmt.buckets or 1
+                name, schema, stmt.distributed_by, stmt.buckets or 1,
+                unique_keys=pk,
             )
             self.catalog.register_handle(
-                StoredTableHandle(name, self.store, schema)
+                StoredTableHandle(name, self.store, schema, pk)
             )
         else:
             ht = HostTable(schema, arrays, {})
-            self.catalog.register(stmt.name, ht, unique_keys=())
+            self.catalog.register(stmt.name, ht, unique_keys=pk)
         return None
 
     def _insert(self, stmt: ast.Insert):
@@ -292,15 +376,28 @@ class Session:
     def _append(self, handle, incoming: HostTable) -> int:
         from ..storage.catalog import StoredTableHandle
 
+        n = incoming.num_rows
+        if handle.unique_keys:
+            for ks in handle.unique_keys:
+                for k in ks:
+                    v = incoming.valids.get(k)
+                    if v is not None and not v.all():
+                        raise ValueError(
+                            f"NULL value in PRIMARY KEY column {k!r}"
+                        )
+            # PRIMARY KEY model: merge + dedupe (last write wins), rewrite
+            merged = concat_tables(handle.table, incoming, target_schema=handle.schema)
+            deduped = self._upsert_merge(handle, merged)
+            self._replace_table_data(handle, deduped)
+            return n
         if self.store is not None and isinstance(handle, StoredTableHandle):
             # conform incoming data to the declared schema before persisting
             conformed = _conform_to_schema(handle.schema, incoming)
-            n = self.store.insert(handle.name, conformed)
+            self.store.insert(handle.name, conformed)
             handle.invalidate()
         else:
             merged = concat_tables(handle.table, incoming, target_schema=handle.schema)
             self.catalog.register(handle.name, merged, handle.unique_keys)
-            n = incoming.num_rows
         self.cache.invalidate(handle.name)
         return n
 
@@ -342,7 +439,10 @@ def concat_tables(a: HostTable, b: HostTable, target_schema: Schema) -> HostTabl
         aa = a.arrays[name]
         ba = b.arrays[bname]
         if f.type.is_string:
-            da = f.dict or StringDict.from_values([])
+            # remap through each side's ACTUAL dict (the target schema's dict
+            # may be the declared empty one for stored tables)
+            fa = a.schema.field(name)
+            da = fa.dict or StringDict.from_values([])
             db = fb.dict or StringDict.from_values([])
             merged, ra, rb = da.merge(db)
             aa = ra[aa] if len(aa) else aa

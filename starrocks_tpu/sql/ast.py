@@ -157,12 +157,26 @@ class CreateTable:
     buckets: int = 0
     properties: tuple = ()
     select: object = None  # Select | SetOp for CREATE TABLE .. AS SELECT
+    primary_key: tuple = ()  # PRIMARY KEY(cols): upsert-on-insert model
 
 
 @dataclasses.dataclass(frozen=True)
 class Delete:
     table: str
     where: object  # Expr | None (None = delete all rows)
+
+
+@dataclasses.dataclass(frozen=True)
+class Update:
+    table: str
+    assignments: tuple  # tuple[(col_name, Expr)]
+    where: object  # Expr | None
+
+
+@dataclasses.dataclass(frozen=True)
+class SetVar:
+    name: str
+    value: object
 
 
 @dataclasses.dataclass(frozen=True)

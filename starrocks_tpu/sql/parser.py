@@ -78,14 +78,17 @@ class Parser:
         if not self.accept_op(op):
             raise ParseError(f"expected {op!r} at {self.peek().value!r} (pos {self.peek().pos})")
 
+    # statement-dispatch words that remain valid identifiers elsewhere
+    SOFT_KEYWORDS = frozenset({
+        "year", "month", "day", "date", "first", "last", "tables", "values",
+        "show", "key", "primary", "update", "set", "delete", "truncate",
+        "describe", "desc",
+    })
+
     def expect_ident(self) -> str:
         t = self.peek()
         # permit non-reserved keywords as identifiers where unambiguous
-        if t.kind in ("ident",) or (
-            t.kind == "kw"
-            and t.value in ("year", "month", "day", "date", "first", "last",
-                            "tables", "values", "show")
-        ):
+        if t.kind == "ident" or (t.kind == "kw" and t.value in self.SOFT_KEYWORDS):
             self.next()
             return t.value
         raise ParseError(f"expected identifier at {t.value!r} (pos {t.pos})")
@@ -106,6 +109,38 @@ class Parser:
             return self.parse_insert()
         if self.at_kw("drop"):
             return self.parse_drop()
+        if self.accept_kw("update"):
+            name = self.parse_table_name()
+            self.expect_kw("set")
+            assigns = []
+            while True:
+                col_name = self.expect_ident()
+                self.expect_op("=")
+                assigns.append((col_name, self.parse_expr()))
+                if not self.accept_op(","):
+                    break
+            where = None
+            if self.accept_kw("where"):
+                where = self.parse_expr()
+            self.accept_op(";")
+            return ast.Update(name, tuple(assigns), where)
+        if self.accept_kw("set"):
+            name = self.expect_ident()
+            self.expect_op("=")
+            neg = self.accept_op("-")
+            t = self.next()
+            if t.kind == "string":
+                val = t.value
+            elif t.kind == "number":
+                val = float(t.value) if "." in t.value else int(t.value)
+            elif t.kind == "kw" and t.value in ("true", "false"):
+                val = t.value == "true"
+            else:
+                val = t.value
+            if neg:
+                val = -val
+            self.accept_op(";")
+            return ast.SetVar(name, val)
         if self.accept_kw("delete"):
             self.expect_kw("from")
             name = self.parse_table_name()
@@ -526,7 +561,11 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return e
-        if t.kind == "ident":
+        if t.kind == "ident" or (
+            t.kind == "kw"
+            and t.value in ("key", "primary", "update", "set", "delete",
+                            "truncate", "tables", "show", "first", "last")
+        ):
             # func call / qualified col / bare col
             if self.peek(1).kind == "op" and self.peek(1).value == "(":
                 return self.parse_func_call(self.next().value)
@@ -658,7 +697,20 @@ class Parser:
             return ast.CreateTable(name, (), select=sel)
         self.expect_op("(")
         cols = []
+        pk = ()
         while True:
+            if self.at_kw("primary") and self.peek(1).kind == "kw" and self.peek(1).value == "key":
+                self.next()
+                self.expect_kw("key")
+                self.expect_op("(")
+                ks = [self.expect_ident()]
+                while self.accept_op(","):
+                    ks.append(self.expect_ident())
+                self.expect_op(")")
+                pk = tuple(ks)
+                if not self.accept_op(","):
+                    break
+                continue
             cname = self.expect_ident()
             t = self.parse_type_name()
             nullable = True
@@ -685,7 +737,7 @@ class Parser:
             if self.accept_kw("buckets"):
                 buckets = int(self.next().value)
         self.accept_op(";")
-        return ast.CreateTable(name, tuple(cols), dist, buckets)
+        return ast.CreateTable(name, tuple(cols), dist, buckets, primary_key=pk)
 
     def parse_insert(self):
         self.expect_kw("insert")

@@ -138,6 +138,25 @@ class Catalog:
                 ("table_rows", T.BIGINT,
                  [self.tables[n].row_count for n in names]),
             ])
+        if view == "be_configs":
+            from ..runtime.config import config as cfg
+
+            items = cfg.items()
+            return vtable([
+                ("name", T.VARCHAR, [i[0] for i in items]),
+                ("value", T.VARCHAR, [str(i[1]) for i in items]),
+                ("default", T.VARCHAR, [str(i[2]) for i in items]),
+                ("mutable", T.INT, [1 if i[3] else 0 for i in items]),
+                ("description", T.VARCHAR, [i[4] for i in items]),
+            ])
+        if view == "metrics":
+            from ..runtime.metrics import metrics as mreg
+
+            names = sorted(mreg._metrics)
+            return vtable([
+                ("name", T.VARCHAR, names),
+                ("value", T.BIGINT, [mreg._metrics[n].value for n in names]),
+            ])
         if view == "columns":
             tn, cn, ty, nu = [], [], [], []
             for n in sorted(self.tables):

@@ -78,3 +78,30 @@ def test_scalar_function_breadth():
     # NULL propagation through math fns: sqrt(-1) and ln(0) -> NULL
     r2 = s.sql("select sqrt(0.0 - 1.0), ln(0.0) from f")
     assert r2.rows() == [(None, None)]
+
+
+def test_primary_key_upsert_update_set(tmp_path):
+    d = str(tmp_path / "pkdb")
+    s = Session(data_dir=d)
+    s.sql("create table pk (k int, v varchar, n int, primary key(k))")
+    s.sql("insert into pk values (1, 'a', 10), (2, 'b', 20)")
+    s.sql("insert into pk values (2, 'B', 99), (3, 'c', 30)")
+    assert s.sql("select k, v, n from pk order by k").rows() == [
+        (1, "a", 10), (2, "B", 99), (3, "c", 30)]
+    s.sql("update pk set n = n * 2 where k >= 2")
+    assert s.sql("select k, n from pk order by k").rows() == [(1, 10), (2, 198), (3, 60)]
+    # restart: PK metadata survives; upsert still applies
+    s2 = Session(data_dir=d)
+    s2.sql("insert into pk values (1, 'A!', 1)")
+    assert s2.sql("select k, v, n from pk order by k").rows() == [
+        (1, "A!", 1), (2, "B", 198), (3, "c", 60)]
+    # SET + config/metrics virtual tables
+    s2.sql("set max_recompiles = 5")
+    assert s2.sql(
+        "select value from information_schema.be_configs where name = 'max_recompiles'"
+    ).rows() == [("5",)]
+    s2.sql("set max_recompiles = 6")
+    assert s2.sql("select count(*) c from information_schema.metrics").rows()[0][0] > 0
+    # planner uses the PK for unique-build joins
+    plan = s2.sql("explain select pk.v from pk, pk p2 where pk.k = p2.k")
+    assert "Join[inner" in plan
