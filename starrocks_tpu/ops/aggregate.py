@@ -67,6 +67,168 @@ def _state_fields(name: str, agg: AggExpr, arg_t: Optional[T.LogicalType]):
     raise NotImplementedError(f"aggregate {agg.fn}")
 
 
+def _try_lowcard(chunk, group_by, keys, live, num_groups: int, mode: str):
+    """Sort-free fast path when every group key has a bounded domain
+    (dictionary codes / booleans): group id = mixed-radix packed codes, and
+    aggregates are direct segment reductions — no lexsort. This is the
+    re-design of the reference's fixed-size SIMD agg hash maps
+    (be/src/exec/aggregate/agg_hash_map.h) for TPU: the Q1/SSB-class
+    low-cardinality group-bys skip the O(n log n) sort entirely.
+
+    Returns (gid[cap] int32 with dead rows OUT of range, infos, total) or
+    None when a key is unbounded or the domain exceeds num_groups."""
+    from ..runtime.config import config as _cfg
+
+    if mode == FINAL or not group_by or not _cfg.get("enable_lowcard_agg"):
+        return None
+    infos = []
+    total = 1
+    for k in keys:
+        if k.dict is not None:
+            base = max(len(k.dict), 1)
+        elif k.type.kind is T.TypeKind.BOOLEAN:
+            base = 2
+        else:
+            return None
+        has_null = k.valid is not None
+        size = base + (1 if has_null else 0)
+        infos.append((k, base, has_null, size))
+        total *= size
+        if total > num_groups:
+            return None
+    gid = jnp.zeros((live.shape[0],), jnp.int32)
+    for k, base, has_null, size in infos:
+        code = jnp.clip(jnp.asarray(k.data, jnp.int32), 0, base - 1)
+        if has_null:
+            code = jnp.where(k.valid, code, base)
+        gid = gid * size + code
+    gid = jnp.where(live, gid, total)  # out-of-range: dropped by segment ops
+    return gid, infos, total
+
+
+def _lowcard_key_columns(infos, total: int, num_groups: int):
+    """Decode slot ids back into per-key code columns (+ NULL validity)."""
+    slots = jnp.arange(num_groups, dtype=jnp.int32)
+    cols = []
+    strides = []
+    s = 1
+    for k, base, has_null, size in reversed(infos):
+        strides.append(s)
+        s *= size
+    strides = list(reversed(strides))
+    for (k, base, has_null, size), stride in zip(infos, strides):
+        code = (slots // stride) % size
+        valid = None
+        if has_null:
+            valid = code != base
+            code = jnp.where(valid, code, 0)
+        cols.append((k, jnp.asarray(code, k.type.dtype), valid))
+    return cols
+
+
+
+def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
+                      num_groups, indices_sorted):
+    """Emit aggregate output columns — shared by the sort path (reorder
+    permutes rows into group order) and the low-cardinality packed-gid path
+    (reorder is identity). live_rows is the row-liveness mask AFTER reorder."""
+
+    def seg_sum(vals):
+        return jax.ops.segment_sum(
+            vals, gid, num_segments=num_groups,
+            indices_are_sorted=indices_sorted,
+        )
+
+    out_fields, out_data, out_valid = [], [], []
+    for name, agg in aggs:
+        if agg.fn in ("count_star",) or (agg.fn == "count" and agg.arg is None):
+            if mode == FINAL:
+                st = cc.eval(Col(name))
+                v = jnp.where(live_rows, reorder(jnp.asarray(st.data, jnp.int64)), 0)
+                cnt = seg_sum(v)
+            else:
+                cnt = seg_sum(jnp.asarray(live_rows, jnp.int64))
+            out_fields.append(Field(name, T.BIGINT, False))
+            out_data.append(cnt)
+            out_valid.append(None)
+            continue
+
+        if agg.fn == "avg":
+            if mode == FINAL:
+                sv = cc.eval(Col(f"{name}__sum"))
+                cv = cc.eval(Col(f"{name}__cnt"))
+                sum_t = sv.type
+                vals = jnp.where(live_rows, reorder(jnp.asarray(sv.data)), 0)
+                cnts = jnp.where(live_rows, reorder(jnp.asarray(cv.data)), 0)
+            else:
+                a = cc.eval(agg.arg)
+                sum_t = _sum_out_type(a.type)
+                d = reorder(jnp.broadcast_to(_to_rep(a, sum_t), (cap,)))
+                m = live_rows if a.valid is None else (
+                    live_rows & reorder(jnp.broadcast_to(a.valid, (cap,)))
+                )
+                vals = jnp.where(m, d, 0)
+                cnts = jnp.asarray(m, jnp.int64)
+            gsum = seg_sum(vals)
+            gcnt = seg_sum(cnts)
+            if mode == PARTIAL:
+                out_fields.append(Field(f"{name}__sum", sum_t, False))
+                out_data.append(gsum)
+                out_valid.append(None)
+                out_fields.append(Field(f"{name}__cnt", T.BIGINT, False))
+                out_data.append(gcnt)
+                out_valid.append(None)
+            else:
+                denom = jnp.maximum(gcnt, 1)
+                if sum_t.is_decimal:
+                    res = jnp.asarray(gsum, jnp.float64) / (10 ** sum_t.scale) / denom
+                else:
+                    res = jnp.asarray(gsum, jnp.float64) / denom
+                out_fields.append(Field(name, T.DOUBLE, True))
+                out_data.append(res)
+                out_valid.append(gcnt > 0)
+            continue
+
+        # sum / min / max / count(x)
+        a = cc.eval(Col(name)) if mode == FINAL else cc.eval(agg.arg)
+        m = live_rows if a.valid is None else (
+            live_rows & reorder(jnp.broadcast_to(a.valid, (cap,)))
+        )
+
+        if agg.fn == "count":
+            if mode == FINAL:
+                vals = jnp.where(m, reorder(jnp.asarray(a.data, jnp.int64)), 0)
+                res = seg_sum(vals)
+            else:
+                res = seg_sum(jnp.asarray(m, jnp.int64))
+            out_fields.append(Field(name, T.BIGINT, False))
+            out_data.append(res)
+            out_valid.append(None)
+        elif agg.fn == "sum":
+            out_t = a.type if mode == FINAL else _sum_out_type(a.type)
+            d = reorder(jnp.broadcast_to(_to_rep(a, out_t), (cap,)))
+            res = seg_sum(jnp.where(m, d, 0))
+            nonempty = seg_sum(jnp.asarray(m, jnp.int64)) > 0
+            out_fields.append(Field(name, out_t, True))
+            out_data.append(res)
+            out_valid.append(nonempty)
+        elif agg.fn in ("min", "max"):
+            is_min = agg.fn == "min"
+            ident = _minmax_identity(a.type, is_min)
+            d = reorder(jnp.broadcast_to(jnp.asarray(a.data), (cap,)))
+            dd = jnp.where(m, d, jnp.asarray(ident, a.type.dtype))
+            seg = jax.ops.segment_min if is_min else jax.ops.segment_max
+            res = seg(dd, gid, num_segments=num_groups,
+                      indices_are_sorted=indices_sorted)
+            nonempty = seg_sum(jnp.asarray(m, jnp.int64)) > 0
+            out_fields.append(Field(name, a.type, True, a.dict))
+            out_data.append(res)
+            out_valid.append(nonempty)
+        else:
+            raise NotImplementedError(f"aggregate {agg.fn}")
+    return out_fields, out_data, out_valid
+
+
 def hash_aggregate(
     chunk: Chunk,
     group_by: tuple,  # tuple[(name, Expr)]
@@ -83,6 +245,12 @@ def hash_aggregate(
     cap = chunk.capacity
     live = chunk.sel_mask()
     keys = eval_keys(chunk, tuple(e for _, e in group_by))
+
+    lowcard = _try_lowcard(chunk, group_by, keys, live, num_groups, mode)
+    if lowcard is not None:
+        return _aggregate_with_gid(
+            chunk, cc, group_by, aggs, num_groups, mode, *lowcard, live=live
+        )
 
     if keys:
         order = jnp.lexsort(tuple(key_sort_arrays(keys, live)))
@@ -117,99 +285,13 @@ def hash_aggregate(
         out_valid.append(kv)
 
     # --- aggregate columns ----------------------------------------------------
-    def seg_sum(vals):
-        return jax.ops.segment_sum(
-            vals, gid, num_segments=num_groups, indices_are_sorted=True
-        )
-
-    for name, agg in aggs:
-        if agg.fn in ("count_star",) or (agg.fn == "count" and agg.arg is None):
-            if mode == FINAL:
-                st = cc.eval(Col(name))
-                v = jnp.where(live_s, st.data[order], 0)
-                cnt = seg_sum(jnp.asarray(v, jnp.int64))
-            else:
-                cnt = seg_sum(jnp.asarray(live_s, jnp.int64))
-            out_fields.append(Field(name, T.BIGINT, False))
-            out_data.append(cnt)
-            out_valid.append(None)
-            continue
-
-        if agg.fn == "avg":
-            if mode == FINAL:
-                s = cc.eval(Col(f"{name}__sum"))
-                c = cc.eval(Col(f"{name}__cnt"))
-                sum_t = s.type
-                vals = jnp.where(live_s, s.data[order], 0)
-                cnts = jnp.where(live_s, c.data[order], 0)
-            else:
-                a = cc.eval(agg.arg)
-                sum_t = _sum_out_type(a.type)
-                d = jnp.broadcast_to(_to_rep(a, sum_t), (cap,))[order]
-                m = live_s if a.valid is None else (live_s & a.valid[order])
-                vals = jnp.where(m, d, 0)
-                cnts = jnp.asarray(m, jnp.int64)
-            gsum = seg_sum(vals)
-            gcnt = seg_sum(cnts)
-            if mode == PARTIAL:
-                out_fields.append(Field(f"{name}__sum", sum_t, False))
-                out_data.append(gsum)
-                out_valid.append(None)
-                out_fields.append(Field(f"{name}__cnt", T.BIGINT, False))
-                out_data.append(gcnt)
-                out_valid.append(None)
-            else:
-                denom = jnp.maximum(gcnt, 1)
-                if sum_t.is_decimal:
-                    res = (
-                        jnp.asarray(gsum, jnp.float64)
-                        / (10 ** sum_t.scale)
-                        / denom
-                    )
-                else:
-                    res = jnp.asarray(gsum, jnp.float64) / denom
-                out_fields.append(Field(name, T.DOUBLE, True))
-                out_data.append(res)
-                out_valid.append(gcnt > 0)
-            continue
-
-        # sum / min / max / count(x)
-        if mode == FINAL:
-            a = cc.eval(Col(name))
-        else:
-            a = cc.eval(agg.arg)
-        m = live_s if a.valid is None else (live_s & jnp.broadcast_to(a.valid, (cap,))[order])
-
-        if agg.fn == "count":
-            if mode == FINAL:
-                vals = jnp.where(m, jnp.asarray(a.data, jnp.int64)[order], 0)
-                res = seg_sum(vals)
-            else:
-                res = seg_sum(jnp.asarray(m, jnp.int64))
-            out_fields.append(Field(name, T.BIGINT, False))
-            out_data.append(res)
-            out_valid.append(None)
-        elif agg.fn == "sum":
-            out_t = a.type if mode == FINAL else _sum_out_type(a.type)
-            d = jnp.broadcast_to(_to_rep(a, out_t), (cap,))[order]
-            res = seg_sum(jnp.where(m, d, 0))
-            nonempty = seg_sum(jnp.asarray(m, jnp.int64)) > 0
-            out_fields.append(Field(name, out_t, True))
-            out_data.append(res)
-            out_valid.append(nonempty)
-        elif agg.fn in ("min", "max"):
-            is_min = agg.fn == "min"
-            ident = _minmax_identity(a.type, is_min)
-            d = jnp.broadcast_to(jnp.asarray(a.data), (cap,))[order]
-            dd = jnp.where(m, d, jnp.asarray(ident, a.type.dtype))
-            seg = jax.ops.segment_min if is_min else jax.ops.segment_max
-            res = seg(dd, gid, num_segments=num_groups, indices_are_sorted=True)
-            nonempty = seg_sum(jnp.asarray(m, jnp.int64)) > 0
-            out_fields.append(Field(name, a.type, True, a.dict))
-            out_data.append(res)
-            out_valid.append(nonempty)
-        else:
-            raise NotImplementedError(f"aggregate {agg.fn}")
+    agg_fields, agg_data, agg_valid = _emit_agg_columns(
+        cc, aggs, mode, cap, live_s, lambda x: x[order], gid, num_groups,
+        indices_sorted=True,
+    )
+    out_fields += agg_fields
+    out_data += agg_data
+    out_valid += agg_valid
 
     sel = jnp.arange(num_groups) < ngroups
     out = Chunk(Schema(tuple(out_fields)), tuple(out_data), tuple(out_valid), sel)
@@ -245,3 +327,34 @@ def final_agg_exprs(aggs: tuple) -> tuple:
         else:
             raise NotImplementedError(agg.fn)
     return tuple(out)
+
+
+def _aggregate_with_gid(chunk, cc, group_by, aggs, num_groups, mode,
+                        gid, infos, total, live):
+    """Aggregate via direct (unsorted) segment reductions over packed gids."""
+    cap = chunk.capacity
+
+    out_fields, out_data, out_valid = [], [], []
+    for (name, _), (k, code, kvalid) in zip(
+        group_by, _lowcard_key_columns(infos, total, num_groups)
+    ):
+        out_fields.append(Field(name, k.type, kvalid is not None, k.dict))
+        out_data.append(code)
+        out_valid.append(kvalid)
+
+    group_count = jax.ops.segment_sum(
+        jnp.asarray(live, jnp.int64), gid, num_segments=num_groups
+    )
+    agg_fields, agg_data, agg_valid = _emit_agg_columns(
+        cc, aggs, mode, cap, live, lambda x: x, gid, num_groups,
+        indices_sorted=False,
+    )
+    out_fields += agg_fields
+    out_data += agg_data
+    out_valid += agg_valid
+
+    in_domain = jnp.arange(num_groups) < total
+    sel = in_domain & (group_count > 0)
+    ngroups = jnp.sum(sel, dtype=jnp.int64)
+    out = Chunk(Schema(tuple(out_fields)), tuple(out_data), tuple(out_valid), sel)
+    return out, ngroups

@@ -1,0 +1,80 @@
+"""Pallas TPU kernels for hot aggregation paths.
+
+The headline benchmark group-bys (TPC-H Q1: 4 groups; SSB: dozens) have
+dictionary-bounded key domains, so aggregation can skip the lexsort entirely:
+per-row group ids become a one-hot matrix and the per-group sums are ONE
+matmul — putting the aggregation FLOPs on the MXU instead of sort networks
+(reference analog: the SIMD-optimized fixed-size agg hash maps,
+be/src/exec/aggregate/agg_hash_map.h, re-designed for a systolic array).
+
+`segment_sum_onehot` is the portable XLA formulation (einsum — XLA lowers it
+to MXU matmuls on TPU). `segment_sum_pallas` is the explicit Pallas kernel:
+a grid over row blocks, each block building its one-hot tile in VMEM and
+accumulating partial sums into a [G, M] accumulator — HBM->VMEM streaming
+handled by the Pallas pipeline.
+
+STATUS: experimental. Validated against oracles in interpret mode
+(tests/test_lowcard_agg.py); NOT yet wired into the aggregate operator —
+the product fast path uses unsorted segment reductions
+(ops/aggregate.py _aggregate_with_gid), and this kernel replaces them only
+after real-TPU benchmarking shows a win (the decimal/int64 exactness
+requirement limits it to float sums).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum_onehot(gid, values, num_groups: int):
+    """[N] int32 group ids + [N, M] float32 values -> [G, M] sums (XLA path).
+
+    Dead rows must carry gid == num_groups (one extra one-hot column that is
+    discarded)."""
+    onehot = jax.nn.one_hot(gid, num_groups + 1, dtype=values.dtype, axis=-1)
+    out = jnp.einsum("ng,nm->gm", onehot, values)
+    return out[:num_groups]
+
+
+def _agg_block_kernel(gid_ref, val_ref, acc_ref, *, num_groups: int):
+    import jax.experimental.pallas as pl
+    import jax.numpy as jnp
+
+    i = pl.program_id(0)
+    gid = gid_ref[...]  # [B]
+    vals = val_ref[...]  # [B, M]
+    # one-hot tile [B, G+1]; the +1 column absorbs dead rows
+    oh = (gid[:, None] == jnp.arange(num_groups + 1)[None, :]).astype(vals.dtype)
+    partial = jnp.dot(oh.T, vals, preferred_element_type=jnp.float32)  # [G+1, M]
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += partial
+
+
+def segment_sum_pallas(gid, values, num_groups: int, block: int = 2048,
+                       interpret: bool = False):
+    """Pallas grid kernel: stream row blocks, accumulate [G+1, M] in VMEM."""
+    import jax.experimental.pallas as pl
+
+    n, m = values.shape
+    assert n % block == 0, f"rows {n} must be a multiple of block {block}"
+    grid = (n // block,)
+    kernel = functools.partial(_agg_block_kernel, num_groups=num_groups)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((num_groups + 1, m), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_groups + 1, m), jnp.float32),
+        interpret=interpret,
+    )(gid, values)
+    return out[:num_groups]

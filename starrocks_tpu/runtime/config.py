@@ -78,6 +78,8 @@ config.define("max_recompiles", 6, True, "adaptive capacity recompile limit per 
 config.define("join_expand_headroom", 1.2, True, "growth factor applied on capacity overflow")
 config.define("enable_zonemap_pruning", True, True, "prune parquet rowsets by zonemap stats")
 config.define("enable_runtime_filters", True, True, "build-side min/max filters applied to join probes")
+config.define("enable_lowcard_agg", True, True,
+              "sort-free packed-code aggregation for dictionary-bounded group keys")
 config.define("batch_rows_threshold", 0, True,
               "stream scan-aggregations in host batches when a table exceeds "
               "this many rows (0 = off); the spill/host-offload path")

@@ -1,0 +1,102 @@
+"""Low-cardinality (sort-free) aggregation fast path + pallas kernel tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from starrocks_tpu.runtime.config import config
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.storage.catalog import tpch_catalog
+
+
+QUERIES = [
+    # dict keys, no NULLs
+    """select l_returnflag, l_linestatus, sum(l_quantity) q,
+       sum(l_extendedprice) p, avg(l_discount) a, count(*) c,
+       min(l_extendedprice) mn, max(l_extendedprice) mx
+       from lineitem where l_shipdate <= date '1998-09-02'
+       group by l_returnflag, l_linestatus order by 1, 2""",
+    # boolean-derived key mixes with dict key via CASE? (bool col via expr)
+    """select l_returnflag, count(*) c from lineitem
+       group by l_returnflag order by 1""",
+]
+
+
+@pytest.fixture(scope="module")
+def cat():
+    return tpch_catalog(sf=0.01)
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_lowcard_matches_sort_path(cat, qi):
+    q = QUERIES[qi]
+    fast = Session(cat).sql(q).rows()
+    config.set("enable_lowcard_agg", False)
+    try:
+        slow = Session(cat).sql(q).rows()
+    finally:
+        config.set("enable_lowcard_agg", True)
+    assert len(fast) == len(slow)
+    for fr, sr in zip(fast, slow):
+        for fv, sv in zip(fr, sr):
+            if isinstance(fv, float):
+                assert sv == pytest.approx(fv, rel=1e-12, abs=1e-12)
+            else:
+                assert fv == sv
+
+
+def test_lowcard_with_nulls_and_two_phase():
+    s = Session()
+    s.sql("create table t (g varchar, v double)")
+    s.sql("insert into t values ('a', 1.0), (null, 2.0), ('a', null), ('b', 4.0), (null, 6.0)")
+    q = "select g, count(*) c, count(v) cv, sum(v) s, avg(v) a from t group by g order by g nulls last"
+    fast = s.sql(q).rows()
+    config.set("enable_lowcard_agg", False)
+    try:
+        slow = Session(s.catalog).sql(q).rows()
+    finally:
+        config.set("enable_lowcard_agg", True)
+    assert len(fast) == len(slow)
+    for fr, sr in zip(fast, slow):
+        for fv, sv in zip(fr, sr):
+            if isinstance(fv, float):
+                # the two paths reduce in different row orders; float sums
+                # may differ in the last ulp (esp. on TPU)
+                assert sv == pytest.approx(fv, rel=1e-12, abs=1e-12)
+            else:
+                assert fv == sv
+    assert fast[-1][0] is None and fast[-1][1] == 2  # NULL group
+
+
+def test_lowcard_distributed_two_phase(eight_devices, cat):
+    import starrocks_tpu.sql.distributed as D
+
+    old = D.SHARD_THRESHOLD_ROWS
+    D.SHARD_THRESHOLD_ROWS = 10_000
+    try:
+        q = QUERIES[0]
+        single = Session(cat).sql(q).rows()
+        dist = Session(cat, dist_shards=8).sql(q).rows()
+        assert single == dist
+    finally:
+        D.SHARD_THRESHOLD_ROWS = old
+
+
+def test_pallas_segment_sum_matches_oracle():
+    from starrocks_tpu.ops.pallas_kernels import (
+        segment_sum_onehot, segment_sum_pallas,
+    )
+
+    rng = np.random.default_rng(0)
+    N, G, M = 8192, 8, 4
+    gid = jnp.asarray(rng.integers(0, G + 1, N).astype(np.int32))
+    vals = jnp.asarray(rng.normal(size=(N, M)).astype(np.float32))
+    ref = segment_sum_onehot(gid, vals, G)
+    pal = segment_sum_pallas(gid, vals, G, block=2048, interpret=True)
+    assert jnp.allclose(ref, pal, rtol=1e-4, atol=1e-3)
+    exp = np.stack([
+        np.asarray(vals)[np.asarray(gid) == g].sum(axis=0) for g in range(G)
+    ])
+    np.testing.assert_allclose(np.asarray(ref), exp, rtol=1e-3, atol=1e-2)
