@@ -68,10 +68,40 @@ def run_sql_bench(query_key: str, sf: float, repeats: int):
           f"compile={compile_s:.1f}s best={best*1000:.1f}ms", file=sys.stderr)
 
 
+def _ensure_live_backend(probe_timeout_s: int = 120):
+    """Probe the accelerator in a SUBPROCESS first: a wedged TPU tunnel hangs
+    the first device op indefinitely (not an exception), which would hang the
+    whole benchmark. If the probe can't complete, fall back to CPU so the
+    bench always produces its JSON line."""
+    import subprocess
+
+    probe = (
+        "import jax, jax.numpy as jnp; jnp.arange(4).sum().block_until_ready();"
+        "print(jax.default_backend())"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True,
+            timeout=probe_timeout_s, text=True,
+        )
+        if r.returncode == 0:
+            backend = r.stdout.strip().splitlines()[-1]
+            print(f"# device probe ok: {backend}", file=sys.stderr)
+            return
+    except subprocess.TimeoutExpired:
+        pass
+    print("# device probe FAILED (wedged tunnel?); falling back to CPU",
+          file=sys.stderr)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main():
     sf = float(os.environ.get("SR_TPU_BENCH_SF", "1.0"))
     repeats = int(os.environ.get("SR_TPU_BENCH_REPEATS", "5"))
     query_key = os.environ.get("SR_TPU_BENCH_QUERY", "q1")
+    _ensure_live_backend()
     if query_key != "q1":
         return run_sql_bench(query_key, sf, repeats)
 

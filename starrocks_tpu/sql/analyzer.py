@@ -115,11 +115,16 @@ class Analyzer:
                     (nm, Col(q)) for nm, q in zip(names, p.output_names())
                 ))
             )
-        plan = LUnion(tuple(aligned))
-        if not so.all:
-            plan = LAggregate(
-                plan, tuple((n, Col(n)) for n in names), ()
-            )
+        if so.kind in ("intersect", "except"):
+            if len(aligned) != 2:
+                raise AnalyzerError(f"{so.kind.upper()} chains of >2 inputs unsupported")
+            plan = self._setop_filtered(aligned, names, so.kind)
+        else:
+            plan = LUnion(tuple(aligned))
+            if not so.all:
+                plan = LAggregate(
+                    plan, tuple((n, Col(n)) for n in names), ()
+                )
         order_items = [
             (self._lower_order_expr_union(o, names), o.asc,
              o.nulls_first if o.nulls_first is not None else not o.asc)
@@ -133,6 +138,36 @@ class Analyzer:
         elif so.limit is not None:
             plan = LLimit(plan, so.limit, so.offset)
         return plan
+
+    def _setop_filtered(self, aligned, names, kind):
+        """INTERSECT/EXCEPT via union + side-tagged counting: group by all
+        columns (NULLs group together — correct set-op NULL semantics, which
+        a join-based rewrite would get wrong) and keep groups present on the
+        right side or not."""
+        # unique synthetic names so user columns can't collide/shadow them
+        uid = next(self._ids)
+        side_c, cl_c, cr_c = f"__side_{uid}", f"__cl_{uid}", f"__cr_{uid}"
+        tagged = []
+        for side, p in enumerate(aligned):
+            tagged.append(LProject(
+                p,
+                tuple((n, Col(n)) for n in names) + ((side_c, Lit(side)),),
+            ))
+        u = LUnion(tuple(tagged))
+        agg = LAggregate(
+            u,
+            tuple((n, Col(n)) for n in names),
+            ((cl_c, AggExpr("sum", Call("subtract", Lit(1), Col(side_c)))),
+             (cr_c, AggExpr("sum", Col(side_c)))),
+        )
+        if kind == "intersect":
+            pred = Call("and", Call("gt", Col(cl_c), Lit(0)),
+                        Call("gt", Col(cr_c), Lit(0)))
+        else:
+            pred = Call("and", Call("gt", Col(cl_c), Lit(0)),
+                        Call("eq", Col(cr_c), Lit(0)))
+        filt = LFilter(agg, pred)
+        return LProject(filt, tuple((n, Col(n)) for n in names))
 
     def _lower_order_expr_union(self, o, names):
         e = o.expr

@@ -132,10 +132,12 @@ class Select:
 
 @dataclasses.dataclass(frozen=True)
 class SetOp:
-    """UNION [ALL] chain; order/limit apply to the combined result."""
+    """UNION [ALL] / INTERSECT / EXCEPT chain; order/limit apply to the
+    combined result."""
 
     selects: tuple  # tuple[Select]
     all: bool
+    kind: str = "union"  # union | intersect | except
     order_by: tuple = ()
     limit: object = None
     offset: int = 0

@@ -20,7 +20,7 @@ KEYWORDS = {
     "distributed", "hash", "buckets", "properties", "substring", "any",
     "over", "partition", "rows", "range", "unbounded", "preceding", "current",
     "show", "describe", "desc", "tables", "delete", "truncate",
-    "primary", "key", "update", "set",
+    "primary", "key", "update", "set", "intersect", "except",
 }
 
 

@@ -175,14 +175,26 @@ class Parser:
     def parse_select(self):
         """SELECT core optionally followed by UNION [ALL] chains."""
         first = self.parse_select_core()
-        if not self.at_kw("union"):
+        if not self.at_kw("union", "intersect", "except"):
             return first
         selects = [first]
         all_flags = []
-        while self.accept_kw("union"):
-            all_flags.append(self.accept_kw("all"))
+        kinds = []
+        while self.at_kw("union", "intersect", "except"):
+            kinds.append(self.next().value)
+            if kinds[-1] == "union":
+                all_flags.append(self.accept_kw("all"))
+            else:
+                if self.accept_kw("all"):
+                    raise ParseError(
+                        f"{kinds[-1].upper()} ALL is unsupported (bag "
+                        "semantics); use plain " + kinds[-1].upper()
+                    )
+                all_flags.append(False)
             selects.append(self.parse_select_core())
-        if len(set(all_flags)) > 1:
+        if len(set(kinds)) > 1:
+            raise ParseError("mixing UNION/INTERSECT/EXCEPT is unsupported")
+        if kinds[0] == "union" and len(set(all_flags)) > 1:
             raise ParseError("mixing UNION and UNION ALL is unsupported")
         # order/limit parsed into the LAST core bind to the whole union
         last = selects[-1]
@@ -192,7 +204,7 @@ class Parser:
             (), None, 0, last.distinct, last.ctes, last.rollup,
         )
         return ast.SetOp(
-            tuple(selects), all_flags[0], order_by, limit, offset,
+            tuple(selects), all_flags[0], kinds[0], order_by, limit, offset,
             selects[0].ctes,
         )
 

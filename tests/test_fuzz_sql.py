@@ -1,0 +1,168 @@
+"""Randomized differential testing: generated query specs run both through
+the engine (as SQL) and through pandas (as a direct evaluation of the same
+spec). Reference analog: the builtin-function fuzz tier
+(be/test/fuzzy/builtin_functions_fuzzy_test.cpp) lifted to whole queries."""
+
+import math
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from starrocks_tpu.runtime.session import Session
+
+N_CASES = 30
+ROWS = 500
+
+
+def make_tables(rng):
+    t1 = pd.DataFrame({
+        "g": rng.choice(["a", "b", "c", None], ROWS, p=[0.4, 0.3, 0.2, 0.1]),
+        "h": rng.integers(0, 4, ROWS),
+        "x": np.round(rng.normal(10, 5, ROWS), 3),
+        "y": rng.integers(-50, 50, ROWS),
+        "k": rng.integers(1, 40, ROWS),
+    })
+    t1.loc[rng.random(ROWS) < 0.08, "x"] = None
+    t2 = pd.DataFrame({
+        "k": np.arange(1, 41),
+        "w": np.round(rng.normal(0, 3, 40), 3),
+        "c": rng.choice(["u", "v"], 40),
+    })
+    return t1, t2
+
+
+def load_session(t1, t2):
+    s = Session()
+    s.sql("create table t1 (g varchar, h int, x double, y int, k int)")
+    s.sql("create table t2 (k int, w double, c varchar)")
+    for df, name in ((t1, "t1"), (t2, "t2")):
+        rows = []
+        for r in df.itertuples(index=False):
+            vals = []
+            for v in r:
+                if v is None or (isinstance(v, float) and math.isnan(v)):
+                    vals.append("null")
+                elif isinstance(v, str):
+                    vals.append(f"'{v}'")
+                else:
+                    vals.append(repr(v))
+            rows.append("(" + ", ".join(vals) + ")")
+        s.sql(f"insert into {name} values " + ", ".join(rows))
+    return s
+
+
+def gen_spec(rng):
+    """A random query spec over t1 (optionally joined to t2)."""
+    spec = {
+        "join": bool(rng.random() < 0.4),
+        "filters": [],
+        "group": list(rng.choice(["g", "h"], size=rng.integers(1, 3), replace=False)),
+        "aggs": [],
+    }
+    for _ in range(rng.integers(0, 3)):
+        col, lo, hi = rng.choice([("y", -50, 50), ("k", 1, 40), ("h", 0, 4)])
+        op = rng.choice(["<", ">=", "="])
+        spec["filters"].append((col, op, int(rng.integers(int(lo), int(hi)))))
+    pool = ["x", "y"] + (["w"] if spec["join"] else [])
+    for _ in range(rng.integers(1, 4)):
+        fn = rng.choice(["sum", "count", "min", "max", "avg"])
+        spec["aggs"].append((fn, rng.choice(pool)))
+    return spec
+
+
+def spec_to_sql(spec):
+    aggs = ", ".join(
+        f"{fn}({col}) a{i}" for i, (fn, col) in enumerate(spec["aggs"])
+    )
+    keys = ", ".join(spec["group"])
+    sql = f"select {keys}, {aggs}, count(*) cnt from t1"
+    if spec["join"]:
+        sql += ", t2 where t1.k = t2.k"
+        glue = " and "
+    else:
+        glue = " where "
+    for col, op, v in spec["filters"]:
+        q = f"t1.{col}" if spec["join"] else col
+        sql += f"{glue}{q} {op} {v}"
+        glue = " and "
+    sql += f" group by {keys}"
+    return sql
+
+
+def spec_to_pandas(spec, t1, t2):
+    df = t1.merge(t2, on="k") if spec["join"] else t1
+    for col, op, v in spec["filters"]:
+        if op == "<":
+            df = df[df[col] < v]
+        elif op == ">=":
+            df = df[df[col] >= v]
+        else:
+            df = df[df[col] == v]
+    if df.empty:
+        return []
+    g = df.groupby(spec["group"], dropna=False)
+    out = {}
+    for i, (fn, col) in enumerate(spec["aggs"]):
+        if fn == "count":
+            out[f"a{i}"] = g[col].count()
+        else:
+            out[f"a{i}"] = getattr(g[col], fn if fn != "avg" else "mean")()
+    out["cnt"] = g.size()
+    res = pd.DataFrame(out).reset_index()
+    return [tuple(r) for r in res.itertuples(index=False)]
+
+
+def _norm_cell(v):
+    if v is None:
+        return None
+    if isinstance(v, float) and math.isnan(v):
+        return None
+    if isinstance(v, (np.floating, np.integer)):
+        v = v.item()
+    if isinstance(v, float):
+        return round(v, 6)
+    return v
+
+
+def _norm(rows):
+    return sorted(
+        [tuple(_norm_cell(c) for c in r) for r in rows],
+        key=lambda t: tuple((x is None, x) for x in t),
+    )
+
+
+@pytest.fixture(scope="module")
+def env():
+    rng = np.random.default_rng(20260729)
+    t1, t2 = make_tables(rng)
+    return load_session(t1, t2), t1, t2, rng
+
+
+def test_fuzz_specs(env):
+    s, t1, t2, rng = env
+    failures = []
+    for case in range(N_CASES):
+        spec = gen_spec(rng)
+        sql = spec_to_sql(spec)
+        try:
+            got = _norm(s.sql(sql).rows())
+            exp = _norm(spec_to_pandas(spec, t1, t2))
+            if len(got) != len(exp):
+                failures.append((case, sql, f"rows {len(got)} vs {len(exp)}"))
+                continue
+            for gr, er in zip(got, exp):
+                for gv, ev in zip(gr, er):
+                    if isinstance(gv, float) and isinstance(ev, float):
+                        if not math.isclose(gv, ev, rel_tol=1e-6, abs_tol=1e-6):
+                            failures.append((case, sql, f"{gv} vs {ev}"))
+                            break
+                    elif gv != ev:
+                        failures.append((case, sql, f"{gv!r} vs {ev!r}"))
+                        break
+                else:
+                    continue
+                break
+        except Exception as e:
+            failures.append((case, sql, f"{type(e).__name__}: {e}"))
+    assert not failures, failures[:3]

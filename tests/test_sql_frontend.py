@@ -175,3 +175,16 @@ def test_distinct_in_correlated_subquery_and_union_in_subquery():
     assert r.rows() == [(1,)]
     r2 = s.sql("select a from rt where a in (select a from rt union select x from ru) order by a")
     assert r2.rows() == [(1,), (2,)]
+
+
+def test_intersect_except_null_semantics():
+    s = Session()
+    s.sql("create table ia (x int, s varchar)")
+    s.sql("create table ib (x int, s varchar)")
+    s.sql("insert into ia values (1,'p'),(2,'q'),(2,'q'),(null,'n')")
+    s.sql("insert into ib values (2,'q'),(3,'r'),(null,'n')")
+    # set-op semantics: distinct; NULLs compare equal
+    assert s.sql("select x, s from ia intersect select x, s from ib order by x nulls last").rows() == [
+        (2, "q"), (None, "n")]
+    assert s.sql("select x, s from ia except select x, s from ib order by x nulls last").rows() == [
+        (1, "p")]
