@@ -88,12 +88,16 @@ class InList(Expr):
 @dataclasses.dataclass(frozen=True)
 class WindowExpr(Expr):
     """fn(arg) OVER (PARTITION BY ... ORDER BY ...). fn is an aggregate name
-    or row_number/rank/dense_rank; arg is None for rank-family/count(*)."""
+    or row_number/rank/dense_rank/lead/lag/first_value/last_value/ntile;
+    arg is None for rank-family/count(*). offset/default serve lead/lag
+    (dedicated fields so generic expr walkers need no special cases)."""
 
     fn: str
     arg: object  # Expr | None
     partition_by: tuple = ()  # tuple[Expr]
     order_by: tuple = ()  # tuple[(Expr, asc, nulls_first)]
+    offset: int = 1  # lead/lag distance (also ntile bucket count)
+    default: object = None  # lead/lag default value (python literal)
 
     def __repr__(self):
         a = "" if self.arg is None else repr(self.arg)

@@ -35,7 +35,7 @@ def window_op(
     chunk: Chunk,
     partition_by: tuple,  # tuple[Expr]
     order_by: tuple,  # tuple[(Expr, asc, nulls_first)]
-    funcs: tuple,  # tuple[(out_name, fn_name, arg_expr|None)]
+    funcs: tuple,  # tuple[(out_name, fn, arg|None, offset, default)]
 ) -> Chunk:
     cap = chunk.capacity
     live = chunk.sel_mask()
@@ -73,10 +73,15 @@ def window_op(
     seg = jnp.clip(seg, 0, cap - 1)
     part_start, _ = _seg_cummax_from_flags(pos, part_new)
     row_in_part = pos - part_start
+    # "end" searches must stop at the live/dead boundary: treat the first
+    # dead row as a segment start so indices never land on padding
+    dead_start = ~live_s
+    end_peer_flags = peer_new | part_new | dead_start
+    end_part_flags = part_new | dead_start
 
     cc = ExprCompiler(sorted_chunk)
     new_fields, new_data, new_valid = [], [], []
-    for out_name, fn, arg in funcs:
+    for out_name, fn, arg, f_offset, f_default in funcs:
         if fn == "row_number":
             new_fields.append(Field(out_name, T.BIGINT, False))
             new_data.append(row_in_part + 1)
@@ -93,6 +98,64 @@ def window_op(
                 r = dr - dr_at_start + 1
             new_fields.append(Field(out_name, T.BIGINT, False))
             new_data.append(r)
+            new_valid.append(None)
+            continue
+
+        if fn in ("lead", "lag"):
+            v = cc.eval(arg)
+            shift = -f_offset if fn == "lead" else f_offset
+            d = jnp.broadcast_to(jnp.asarray(v.data), (cap,))
+            val = jnp.roll(d, shift)
+            vv = (jnp.broadcast_to(v.valid, (cap,)) if v.valid is not None
+                  else jnp.ones((cap,), jnp.bool_))
+            vv = jnp.roll(vv, shift)
+            # rows whose source falls outside the partition -> NULL
+            src = pos - shift
+            in_bounds = (src >= 0) & (src < cap)
+            src_c = jnp.clip(src, 0, cap - 1)
+            same_part = part_start == jnp.where(in_bounds, part_start[src_c], -1)
+            src_live = jnp.where(in_bounds, live_s[src_c], False)
+            ok = in_bounds & same_part & live_s & src_live
+            if f_default is not None:
+                # out-of-partition slots take the declared default
+                from ..exprs.compile import _infer_lit
+
+                hv, _ = _infer_lit(f_default, v.type)
+                val = jnp.where(ok, val, jnp.asarray(hv, val.dtype))
+                new_valid.append(jnp.where(ok, vv, True))
+            else:
+                new_valid.append(vv & ok)
+            new_fields.append(Field(out_name, v.type, True, v.dict))
+            new_data.append(val)
+            continue
+        if fn in ("first_value", "last_value"):
+            v = cc.eval(arg)
+            d = jnp.broadcast_to(jnp.asarray(v.data), (cap,))
+            if fn == "first_value":
+                idx = part_start
+            else:
+                # default frame: end of the current peer group (stops at the
+                # live/dead boundary)
+                nxt = jnp.concatenate(
+                    [end_peer_flags[1:], jnp.ones((1,), jnp.bool_)]
+                )
+                idx = _carry_scan(pos[::-1], nxt[::-1])[::-1]
+            val = d[idx]
+            vv = (jnp.broadcast_to(v.valid, (cap,))[idx]
+                  if v.valid is not None else None)
+            new_fields.append(Field(out_name, v.type, v.valid is not None, v.dict))
+            new_data.append(val)
+            new_valid.append(vv)
+            continue
+        if fn == "ntile":
+            n_tiles = int(f_offset)
+            # partition size = end - start + 1 (end stops at live/dead edge)
+            nxt = jnp.concatenate([end_part_flags[1:], jnp.ones((1,), jnp.bool_)])
+            part_end = _carry_scan(pos[::-1], nxt[::-1])[::-1]
+            psize = part_end - part_start + 1
+            tile = (row_in_part * n_tiles) // jnp.maximum(psize, 1) + 1
+            new_fields.append(Field(out_name, T.BIGINT, False))
+            new_data.append(jnp.asarray(tile, jnp.int64))
             new_valid.append(None)
             continue
 
@@ -121,13 +184,13 @@ def window_op(
             op = jnp.minimum if fn == "min" else jnp.maximum
             if running:
                 run = _segmented_scan(vals, part_new, op)
-                res = _peer_extend(run, peer_new | part_new, pos)
+                res = _peer_extend(run, end_peer_flags, pos)
             else:
                 segmin = (jax.ops.segment_min if fn == "min" else jax.ops.segment_max)(
                     vals, seg, num_segments=cap, indices_are_sorted=True
                 )
                 res = segmin[seg]
-            cnt = _part_count(m, seg, cap, running, part_new, peer_new, pos)
+            cnt = _part_count(m, seg, cap, running, part_new, end_peer_flags, pos)
             new_fields.append(Field(out_name, out_t, True, dict_))
             new_data.append(res)
             new_valid.append(cnt > 0)
@@ -136,10 +199,10 @@ def window_op(
         # sum / count / avg
         if running:
             csum = _segmented_scan(jnp.asarray(vals), part_new, jnp.add)
-            csum = _peer_extend(csum, peer_new | part_new, pos)
+            csum = _peer_extend(csum, end_peer_flags, pos)
             total = csum
             ccnt = _segmented_scan(jnp.asarray(m, jnp.int64), part_new, jnp.add)
-            ccnt = _peer_extend(ccnt, peer_new | part_new, pos)
+            ccnt = _peer_extend(ccnt, end_peer_flags, pos)
         else:
             total = jax.ops.segment_sum(vals, seg, num_segments=cap, indices_are_sorted=True)[seg]
             ccnt = jax.ops.segment_sum(
@@ -205,10 +268,10 @@ def _peer_extend(run, peer_start_flags, pos):
     return run[end]
 
 
-def _part_count(m, seg, cap, running, part_new, peer_new, pos):
+def _part_count(m, seg, cap, running, part_new, end_peer_flags, pos):
     if running:
         c = _segmented_scan(jnp.asarray(m, jnp.int64), part_new, jnp.add)
-        return _peer_extend(c, peer_new | part_new, pos)
+        return _peer_extend(c, end_peer_flags, pos)
     return jax.ops.segment_sum(
         jnp.asarray(m, jnp.int64), seg, num_segments=cap, indices_are_sorted=True
     )[seg]

@@ -18,7 +18,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..column import Chunk
 from ..parallel.mesh import make_mesh
-from ..sql.distributed import SHARDED, compile_distributed
+from ..sql.distributed import REPLICATED, compile_distributed
 from .executor import Executor
 from .profile import RuntimeProfile
 
@@ -45,7 +45,7 @@ class DistExecutor(Executor):
                 inputs0 = self._place(scans_meta)
                 in_specs = tuple(
                     jax.tree_util.tree_map(
-                        lambda _, mm=m: P(self.axis) if mm == SHARDED else P(),
+                        lambda _, mm=m: P() if mm == REPLICATED else P(self.axis),
                         chunk,
                     )
                     for chunk, (_, m) in zip(inputs0, scans_meta)

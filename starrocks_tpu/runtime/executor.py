@@ -75,13 +75,30 @@ class DeviceCache:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         ht = handle.table
+        reorder = None  # host row permutation + per-shard layout (hash modes)
+        per_shard_rows = None
         if placement is None:
             tag, put, n_shards = "local", jnp.asarray, 1
         else:
             mesh, axis, mode = placement
-            tag = mode
-            n_shards = mesh.shape[axis] if mode == "sharded" else 1
-            spec = P(axis) if mode == "sharded" else P()
+            replicated = mode == "replicated"
+            n_shards = 1 if replicated else mesh.shape[axis]
+            if isinstance(mode, tuple) and mode[0] == "hash":
+                # colocate placement: shard i holds rows whose bucket
+                # (same splitmix64 as the device shuffle) equals i
+                keycol = mode[1].split(".", 1)[-1]  # qualified -> base name
+                tag = f"hash:{keycol}"
+                from ..native import hash_partition_i64
+
+                bucket = hash_partition_i64(
+                    np.asarray(ht.arrays[keycol], dtype=np.int64), n_shards
+                )
+                counts = np.bincount(bucket, minlength=n_shards)
+                per_shard_rows = counts
+                reorder = np.argsort(bucket, kind="stable")
+            else:
+                tag = mode
+            spec = P() if replicated else P(axis)
             sharding = NamedSharding(mesh, spec)
 
             def put(x):
@@ -89,30 +106,57 @@ class DeviceCache:
 
         n = ht.num_rows
         cap_key = (handle.name, tag)
-        if n_shards > 1:
+        if reorder is not None:
+            shard_cap = pad_capacity(int(per_shard_rows.max()) if n else 1)
+            default_cap = shard_cap * n_shards
+        elif n_shards > 1:
             default_cap = pad_capacity((n + n_shards - 1) // n_shards) * n_shards
         else:
             default_cap = pad_capacity(n)
         cap = self._caps.setdefault(cap_key, default_cap)
+
+        def layout(a, fill):
+            """Host layout: pad (range mode) or bucket-slotted (hash mode)."""
+            if reorder is None:
+                if len(a) < cap:
+                    a = np.concatenate(
+                        [a, np.full(cap - len(a), fill, dtype=a.dtype)]
+                    )
+                return a
+            shard_cap = cap // n_shards
+            out = np.full(cap, fill, dtype=a.dtype)
+            srt = a[reorder]
+            off = 0
+            for b in range(n_shards):
+                cnt = int(per_shard_rows[b])
+                out[b * shard_cap : b * shard_cap + cnt] = srt[off : off + cnt]
+                off += cnt
+            return out
+
         from ..column.column import Field, Schema
 
         fields, data, valid = [], [], []
         for c in columns:
             key = (handle.name, c, tag)
             if key not in self._cols:
-                a = ht.arrays[c]
-                if len(a) < cap:
-                    a = np.concatenate([a, np.zeros(cap - len(a), dtype=a.dtype)])
+                a = layout(ht.arrays[c], 0)
                 v = ht.valids.get(c)
-                if v is not None and len(v) < cap:
-                    v = np.concatenate([v, np.zeros(cap - len(v), dtype=np.bool_)])
+                if v is not None:
+                    v = layout(v, False)
                 self._cols[key] = (put(a), None if v is None else put(v))
             d, v = self._cols[key]
             f = ht.schema.field(c)
             fields.append(dataclasses.replace(f, name=f"{alias}.{c}"))
             data.append(d)
             valid.append(v)
-        selv = np.arange(cap) < n
+        if reorder is None:
+            selv = np.arange(cap) < n
+        else:
+            shard_cap = cap // n_shards
+            selv = np.zeros(cap, dtype=bool)
+            for b in range(n_shards):
+                cnt = int(per_shard_rows[b])
+                selv[b * shard_cap : b * shard_cap + cnt] = True
         sel = put(selv) if (placement is not None or n != cap) else None
         return Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)
 
@@ -221,8 +265,8 @@ class Executor:
                     tuple(fix_expr(x) for x in p.partition_by),
                     tuple((fix_expr(e), a, nf) for e, a, nf in p.order_by),
                     tuple(
-                        (n, fn, fix_expr(a) if a is not None else None)
-                        for n, fn, a in p.funcs
+                        (n, fn, fix_expr(a) if a is not None else None, off, d)
+                        for n, fn, a, off, d in p.funcs
                     ),
                 )
             return p

@@ -52,6 +52,7 @@ class Session:
                     StoredTableHandle(
                         name, self.store, schema_from_json(m["schema"]),
                         [tuple(k) for k in m.get("unique_keys", [])],
+                        tuple(m.get("distribution", ())),
                     )
                 )
 
@@ -338,11 +339,16 @@ class Session:
                 unique_keys=pk,
             )
             self.catalog.register_handle(
-                StoredTableHandle(name, self.store, schema, pk)
+                StoredTableHandle(
+                    name, self.store, schema, pk, tuple(stmt.distributed_by)
+                )
             )
         else:
             ht = HostTable(schema, arrays, {})
-            self.catalog.register(stmt.name, ht, unique_keys=pk)
+            self.catalog.register(
+                stmt.name, ht, unique_keys=pk,
+                distribution=tuple(stmt.distributed_by),
+            )
         return None
 
     def _insert(self, stmt: ast.Insert):

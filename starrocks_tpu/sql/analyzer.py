@@ -261,15 +261,24 @@ class Analyzer:
         plan, lowered_items, order_items = self._extract_windows(
             plan, lowered_items, order_items
         )
-        order_only_wins = {
+        # ORDER BY may reference columns that aren't in the select list
+        # (hidden sort columns — windows or plain source columns): carry them
+        # through the projection and strip them after the sort
+        item_names = {n for n, _ in lowered_items}
+        hidden = {
             c
             for e, _, _ in order_items
             for c in _cols_of(e)
-            if c.startswith("win_") and not any(c == n for n, _ in lowered_items)
+            if c not in item_names
         }
-        if order_only_wins:
+        if hidden and not sel.distinct:
             visible_names = [n for n, _ in lowered_items]
-            lowered_items = lowered_items + [(c, Col(c)) for c in sorted(order_only_wins)]
+            lowered_items = lowered_items + [(c, Col(c)) for c in sorted(hidden)]
+        elif hidden:
+            raise AnalyzerError(
+                f"ORDER BY column(s) {sorted(hidden)} must appear in the "
+                "select list of a DISTINCT query"
+            )
 
         plan = LProject(plan, tuple(lowered_items))
 
@@ -375,7 +384,7 @@ class Analyzer:
                 (self._lower(o, scope, ctes, allow_agg=False), asc, nf)
                 for o, asc, nf in e.order_by
             )
-            return WindowExpr(e.fn, arg, part, order)
+            return WindowExpr(e.fn, arg, part, order, e.offset, e.default)
         if isinstance(e, AggExpr):
             if not allow_agg:
                 raise AnalyzerError(f"aggregate {e} not allowed here")
@@ -497,6 +506,7 @@ class Analyzer:
                     replace(e.arg) if e.arg is not None else None,
                     tuple(replace(p) for p in e.partition_by),
                     tuple((replace(o), a, nf) for o, a, nf in e.order_by),
+                    e.offset, e.default,
                 )
             if isinstance(e, (ScalarSubquery, SemiJoinMark)):
                 return e
@@ -531,7 +541,7 @@ class Analyzer:
                 name = f"win_{len(mapping)}"
                 mapping[e] = name
                 specs.setdefault((e.partition_by, e.order_by), []).append(
-                    (name, e.fn, e.arg)
+                    (name, e.fn, e.arg, e.offset, e.default)
                 )
                 return
             if isinstance(e, Call):

@@ -64,19 +64,39 @@ class DistCompiled:
 
 
 def plan_scan_modes(plan: LogicalPlan, catalog) -> dict:
-    """Decide sharding per scan node id: shard big tables, replicate small."""
+    """Decide placement per scan: replicate small tables; big tables shard —
+    by HASH of a single int distribution column when declared (enabling
+    colocate joins: the host placement uses the same splitmix64 bucketing as
+    the device shuffle), else by row range."""
     modes = {}
 
     def rec(p):
         if isinstance(p, LScan):
             t = catalog.get_table(p.table)
             rows = t.row_count if t is not None else 0
-            modes[id(p)] = SHARDED if rows >= SHARD_THRESHOLD_ROWS else REPLICATED
+            if rows < SHARD_THRESHOLD_ROWS:
+                modes[id(p)] = REPLICATED
+            else:
+                mode = SHARDED
+                dist = getattr(t, "distribution", ())
+                if len(dist) == 1 and dist[0] in p.columns:
+                    f = t.schema.field(dist[0])
+                    if f.type.is_integer:
+                        mode = ("hash", f"{p.alias}.{dist[0]}")
+                modes[id(p)] = mode
         for c in p.children:
             rec(c)
 
     rec(plan)
     return modes
+
+
+def _is_dist(mode) -> bool:
+    return mode != REPLICATED
+
+
+def _hash_col(mode):
+    return mode[1] if isinstance(mode, tuple) and mode[0] == "hash" else None
 
 
 def compile_distributed(
@@ -107,7 +127,7 @@ def compile_distributed(
     def gather(chunk, mode):
         if mode == REPLICATED:
             return chunk
-        return all_gather_chunk(chunk, axis)
+        return all_gather_chunk(chunk, axis)  # range- and hash-sharded alike
 
     def step(inputs):
         """Traced SPMD program; all mutable trace state lives inside (see
@@ -132,6 +152,14 @@ def compile_distributed(
                 return filter_chunk(c, p.predicate), m
             if isinstance(p, LProject):
                 c, m = emit(p.child)
+                hc = _hash_col(m)
+                if hc is not None:
+                    # keep colocate info only if the hash column passes through
+                    m = SHARDED
+                    for n, e in p.exprs:
+                        if isinstance(e, Col) and e.name == hc:
+                            m = ("hash", n)
+                            break
                 return (
                     project(c, [e for _, e in p.exprs], [n for n, _ in p.exprs]),
                     m,
@@ -204,7 +232,7 @@ def compile_distributed(
                 probe_keys, build_keys = [Lit(0)], [Lit(0)]
                 bit_widths = (2,)
                 unique = False
-                if lm == SHARDED and rm == SHARDED:
+                if _is_dist(lm) and _is_dist(rm):
                     # shuffling a constant key would funnel everything onto one
                     # shard; gather the build side and cross-join locally
                     rc = all_gather_chunk(rc, axis)
@@ -238,27 +266,48 @@ def compile_distributed(
             if p.kind in ("inner", "semi", "cross") and probe_keys and not (
                 len(probe_keys) == 1 and isinstance(probe_keys[0], Lit)
             ) and _cfg.get("enable_runtime_filters"):
-                rf_axis = axis if rm == SHARDED else None
+                rf_axis = axis if _is_dist(rm) else None
                 lc = lc.and_sel(
                     runtime_filter_mask(lc, rc, tuple(probe_keys),
                                         tuple(build_keys), bit_widths, rf_axis)
                 )
 
             # --- distribution strategy ---
-            if rm == SHARDED and lm == SHARDED:
-                # shuffle both sides by join key onto the mesh
-                kb = f"shufL_{ordinal(p)}"
-                cap_l = caps.get(kb, pad_capacity(lc.capacity // max(n_shards // 2, 1)))
-                lc, mxl = shuffle_chunk(lc, tuple(probe_keys), axis, n_shards, cap_l, bit_widths)
-                checks[kb] = mxl[None]
-                kb2 = f"shufR_{ordinal(p)}"
-                cap_r = caps.get(kb2, pad_capacity(rc.capacity // max(n_shards // 2, 1)))
-                rc, mxr = shuffle_chunk(rc, tuple(build_keys), axis, n_shards, cap_r, bit_widths)
-                checks[kb2] = mxr[None]
-                out_mode = SHARDED
-            elif rm == SHARDED:  # probe replicated, build sharded -> gather build
+            def aligned(mode, keys):
+                hc = _hash_col(mode)
+                return (
+                    hc is not None and len(keys) == 1
+                    and isinstance(keys[0], Col) and keys[0].name == hc
+                )
+
+            if _is_dist(lm) and _is_dist(rm):
+                la = aligned(lm, probe_keys)
+                ra = aligned(rm, build_keys)
+                # colocate: sides already hash-placed on their join keys with
+                # the same bucketing — no exchange at all
+                def shuffle_side(chunk, keys_, key_name):
+                    cap_k = caps.get(
+                        key_name,
+                        pad_capacity(chunk.capacity // max(n_shards // 2, 1)),
+                    )
+                    out, mx = shuffle_chunk(
+                        chunk, tuple(keys_), axis, n_shards, cap_k, bit_widths
+                    )
+                    checks[key_name] = mx[None]
+                    return out
+
+                # each unaligned side shuffles into hash alignment
+                if not la:
+                    lc = shuffle_side(lc, probe_keys, f"shufL_{ordinal(p)}")
+                if not ra:
+                    rc = shuffle_side(rc, build_keys, f"shufR_{ordinal(p)}")
+                if len(probe_keys) == 1 and isinstance(probe_keys[0], Col):
+                    out_mode = ("hash", probe_keys[0].name)
+                else:
+                    out_mode = SHARDED
+            elif _is_dist(rm):  # probe replicated, build sharded -> gather build
                 rc = all_gather_chunk(rc, axis)
-                out_mode = REPLICATED if lm == REPLICATED else SHARDED
+                out_mode = REPLICATED if lm == REPLICATED else lm
             else:
                 # build replicated: local (broadcast) join; output follows probe
                 out_mode = lm
@@ -312,7 +361,7 @@ def compile_distributed(
             return out, out_mode
 
         chunk, mode = emit(plan)
-        if mode == SHARDED:
+        if mode != REPLICATED:
             chunk = all_gather_chunk(chunk, axis)
         return chunk, checks
 

@@ -113,7 +113,7 @@ class LWindow(LogicalPlan):
     child: LogicalPlan
     partition_by: tuple  # tuple[Expr]
     order_by: tuple  # tuple[(Expr, asc, nulls_first)]
-    funcs: tuple  # tuple[(out_name, fn, arg_expr|None)]
+    funcs: tuple  # tuple[(out_name, fn, arg|None, offset, default)]
 
     @property
     def children(self):
@@ -123,7 +123,7 @@ class LWindow(LogicalPlan):
         return self.child.output_names() + tuple(n for n, _, _ in self.funcs)
 
     def __repr__(self):
-        return f"Window[{[n for n, _, _ in self.funcs]} part={list(self.partition_by)}]"
+        return f"Window[{[n for n, *_ in self.funcs]} part={list(self.partition_by)}]"
 
 
 @dataclasses.dataclass(frozen=True)

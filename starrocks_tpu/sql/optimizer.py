@@ -692,13 +692,13 @@ def prune_columns(plan: LogicalPlan, required: frozenset | None = None) -> Logic
         )
 
     if isinstance(plan, LWindow):
-        func_names = {n for n, _, _ in plan.funcs}
+        func_names = {n for n, *_ in plan.funcs}
         need = set(required) - func_names
         for p in plan.partition_by:
             need |= expr_cols(p)
         for o, _, _ in plan.order_by:
             need |= expr_cols(o)
-        for _, _, a in plan.funcs:
+        for _, _, a, _, _ in plan.funcs:
             if a is not None:
                 need |= expr_cols(a)
         if not need:

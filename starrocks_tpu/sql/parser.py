@@ -612,7 +612,8 @@ class Parser:
             return Call(reg, *args)
         return ast.RawFunc(name, tuple(args), distinct)
 
-    WINDOW_ONLY = {"row_number", "rank", "dense_rank"}
+    WINDOW_ONLY = {"row_number", "rank", "dense_rank", "lead", "lag",
+                   "first_value", "last_value", "ntile"}
 
     def parse_over(self, name, args, distinct):
         if distinct:
@@ -640,9 +641,26 @@ class Parser:
             raise ParseError("explicit window frames unsupported (default frame only)")
         self.expect_op(")")
         arg = None
+        offset = 1
+        default = None
         if args and not isinstance(args[0], ast.Star):
             arg = args[0]
-        return WindowExpr(name, arg, tuple(partition), tuple(order))
+        if name in ("lead", "lag"):
+            if len(args) > 1:
+                if not (isinstance(args[1], Lit) and isinstance(args[1].value, int)):
+                    raise ParseError(f"{name} offset must be an integer literal")
+                offset = args[1].value
+            if len(args) > 2:
+                if not isinstance(args[2], Lit):
+                    raise ParseError(f"{name} default must be a literal")
+                default = args[2].value
+        elif name == "ntile":
+            if not (isinstance(args[0], Lit) and isinstance(args[0].value, int)):
+                raise ParseError("ntile requires an integer literal")
+            offset = args[0].value
+            arg = None
+        return WindowExpr(name, arg, tuple(partition), tuple(order),
+                          offset, default)
 
     def parse_case(self) -> Expr:
         self.expect_kw("case")

@@ -24,11 +24,14 @@ class ColumnStats:
 
 
 class TableHandle:
-    def __init__(self, name: str, table: HostTable, unique_keys=()):
+    def __init__(self, name: str, table: HostTable, unique_keys=(),
+                 distribution=()):
         self.name = name
         self._table = table
         # tuple of key-column tuples each of which is unique per row
         self.unique_keys = tuple(tuple(k) for k in unique_keys)
+        # hash-bucketing columns (colocate-join placement on the mesh)
+        self.distribution = tuple(distribution)
         self._stats: dict = {}
 
     @property
@@ -60,8 +63,9 @@ class StoredTableHandle(TableHandle):
 
     The declared schema is available without touching data files."""
 
-    def __init__(self, name: str, store, schema: Schema, unique_keys=()):
-        super().__init__(name, None, unique_keys)
+    def __init__(self, name: str, store, schema: Schema, unique_keys=(),
+                 distribution=()):
+        super().__init__(name, None, unique_keys, distribution)
         self.store = store
         self._schema = schema
 
@@ -92,8 +96,11 @@ class Catalog:
     def __init__(self):
         self.tables: dict = {}
 
-    def register(self, name: str, table: HostTable, unique_keys=()):
-        self.tables[name.lower()] = TableHandle(name.lower(), table, unique_keys)
+    def register(self, name: str, table: HostTable, unique_keys=(),
+                 distribution=()):
+        self.tables[name.lower()] = TableHandle(
+            name.lower(), table, unique_keys, distribution
+        )
 
     def register_handle(self, handle: TableHandle):
         self.tables[handle.name] = handle
@@ -186,10 +193,22 @@ TPCH_UNIQUE_KEYS = {
 }
 
 
+TPCH_DISTRIBUTION = {
+    # natural bucketing keys: lineitem/orders colocate on orderkey
+    "lineitem": ("l_orderkey",),
+    "orders": ("o_orderkey",),
+    "customer": ("c_custkey",),
+    "part": ("p_partkey",),
+    "partsupp": ("ps_partkey",),
+    "supplier": ("s_suppkey",),
+}
+
+
 def tpch_catalog(sf: float = 0.01, seed: int = 42) -> Catalog:
     from .datagen.tpch import gen_tpch
 
     cat = Catalog()
     for name, ht in gen_tpch(sf=sf, seed=seed).items():
-        cat.register(name, ht, TPCH_UNIQUE_KEYS.get(name, ()))
+        cat.register(name, ht, TPCH_UNIQUE_KEYS.get(name, ()),
+                     TPCH_DISTRIBUTION.get(name, ()))
     return cat

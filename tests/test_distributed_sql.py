@@ -70,3 +70,49 @@ def test_distributed_adaptive_recompile(sessions):
     assert [r[1] for r in r1] == [r[1] for r in r8]
     prof = s8.last_profile
     assert prof.find("attempt_1") is not None  # at least one recompile happened
+
+
+def test_colocate_join_no_shuffle(eight_devices):
+    """lineitem/orders share hash distribution on orderkey -> the join
+    compiles with ZERO all-to-all collectives (colocate join)."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from starrocks_tpu.sql.analyzer import Analyzer
+    from starrocks_tpu.sql.distributed import compile_distributed
+    from starrocks_tpu.sql.optimizer import optimize
+    from starrocks_tpu.sql.parser import parse
+    from starrocks_tpu.sql.physical import Caps
+
+    old = D.SHARD_THRESHOLD_ROWS
+    D.SHARD_THRESHOLD_ROWS = 10_000
+    try:
+        cat = tpch_catalog(sf=0.01)
+        s1, s8 = Session(cat), Session(cat, dist_shards=8)
+        q = """select o_orderpriority, count(*) c, sum(l_quantity) q
+               from orders, lineitem where o_orderkey = l_orderkey
+               group by o_orderpriority order by 1"""
+        assert s1.sql(q).rows() == s8.sql(q).rows()
+
+        plan = optimize(Analyzer(cat).analyze(parse(q)), cat)
+        ex = s8._dist_executor
+        comp = compile_distributed(plan, cat, Caps({}), 8)
+        meta = tuple(zip(comp.scans, comp.scan_modes))
+        inputs = ex._place(meta)
+        in_specs = tuple(
+            jax.tree_util.tree_map(
+                lambda _, mm=m: P() if mm == "replicated" else P("d"), c
+            )
+            for c, (_, m) in zip(inputs, meta)
+        )
+        low = jax.jit(shard_map(
+            comp.fn, mesh=ex.mesh, in_specs=(in_specs,),
+            out_specs=(P(), P("d")), check_vma=False,
+        )).lower(inputs)
+        assert low.as_text().count("all-to-all") == 0
+        # at least one scan went through hash placement
+        assert any(isinstance(m, tuple) and m[0] == "hash"
+                   for m in comp.scan_modes)
+    finally:
+        D.SHARD_THRESHOLD_ROWS = old

@@ -88,3 +88,47 @@ def test_q67_shape(sess):
         assert got_row[1] == exp_row.l_suppkey
         assert abs(got_row[2] - exp_row.sumqty) < 1e-6
         assert got_row[3] == exp_row.rk
+
+
+def test_lead_lag_first_last_ntile():
+    s = Session()
+    s.sql("create table wt (g varchar, x int)")
+    s.sql("insert into wt values ('a',1),('a',2),('a',3),('b',10),('b',20)")
+    r = s.sql("""select g, x, lag(x) over (partition by g order by x) lg,
+      lead(x) over (partition by g order by x) ld,
+      lead(x, 2) over (partition by g order by x) ld2,
+      first_value(x) over (partition by g order by x) fv,
+      last_value(x) over (partition by g order by x) lv,
+      ntile(2) over (partition by g order by x) nt
+      from wt order by g, x""")
+    assert r.rows() == [
+        ("a", 1, None, 2, 3, 1, 1, 1),
+        ("a", 2, 1, 3, None, 1, 2, 1),
+        ("a", 3, 2, None, None, 1, 3, 2),
+        ("b", 10, None, 20, None, 10, 10, 1),
+        ("b", 20, 10, None, None, 10, 20, 2),
+    ]
+    # running min with the dead-aware peer extension (regression for
+    # _part_count scoping)
+    r2 = s.sql("select g, x, min(x) over (partition by g order by x) m from wt order by g, x")
+    assert [row[2] for row in r2.rows()] == [1, 1, 1, 10, 10]
+
+
+def test_lead_lag_defaults_and_hidden_order_columns():
+    s = Session()
+    s.sql("create table wh (g varchar, x int, y int)")
+    s.sql("insert into wh values ('a',1,100),('a',2,200),('b',3,300)")
+    # default value fills out-of-partition slots
+    assert [r[1] for r in s.sql(
+        "select g, lag(x, 1, 0) over (partition by g order by x) d from wh order by g, x"
+    ).rows()] == [0, 1, 0]
+    # lead arg columns survive pruning even when select-list-only
+    assert [r[1] for r in s.sql(
+        "select g, lead(y, 1) over (partition by g order by x) l from wh order by g, x"
+    ).rows()] == [200, None, None]
+    # 2-arg lead inside a GROUP BY query
+    assert s.sql(
+        "select g, lead(g, 1) over (order by g) n from wh group by g order by g"
+    ).rows() == [("a", "b"), ("b", None)]
+    # plain hidden ORDER BY column
+    assert s.sql("select g from wh order by x desc").rows() == [("b",), ("a",), ("a",)]
