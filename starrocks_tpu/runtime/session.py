@@ -127,6 +127,11 @@ class Session:
         if isinstance(stmt, ast.CreateTable):
             return self._create(stmt)
         if isinstance(stmt, ast.DropTable):
+            nm = stmt.name.lower()
+            if nm in self.catalog.views:
+                del self.catalog.views[nm]
+                return None
+            self.catalog.mv_defs.pop(nm, None)
             existed = self.catalog.get_table(stmt.name) is not None
             self.catalog.drop(stmt.name, stmt.if_exists)
             self.cache.invalidate(stmt.name.lower())
@@ -144,6 +149,28 @@ class Session:
 
             config.set(stmt.name, stmt.value)
             return None
+        if isinstance(stmt, ast.CreateView):
+            name = stmt.name.lower()
+            if (
+                self.catalog.get_table(name) is not None
+                or name in self.catalog.views
+                or name in self.catalog.mv_defs
+            ):
+                raise ValueError(f"name {name!r} already exists")
+            if stmt.materialized:
+                # validate + materialize BEFORE committing the definition so
+                # a failing query leaves no half-created MV behind
+                self.catalog.mv_defs[name] = stmt.select_text
+                try:
+                    self._refresh_mv(name)
+                except Exception:
+                    self.catalog.mv_defs.pop(name, None)
+                    raise
+            else:
+                self.catalog.views[name] = stmt.select_text
+            return None
+        if isinstance(stmt, ast.RefreshView):
+            return self._refresh_mv(stmt.name.lower())
         if isinstance(stmt, ast.ShowTables):
             return sorted(self.catalog.tables)
         if isinstance(stmt, ast.Describe):
@@ -155,6 +182,21 @@ class Session:
                 for f in h.schema
             ]
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
+
+    def _refresh_mv(self, name: str) -> int:
+        """(Re)materialize an MV: run its defining query, replace the backing
+        table (reference analog: the MV refresh TaskRun pipeline,
+        fe scheduler/mv/ — here: full refresh on demand)."""
+        sql_text = self.catalog.mv_defs.get(name)
+        if sql_text is None:
+            raise ValueError(f"unknown materialized view {name!r}")
+        res = self.sql(sql_text)
+        t = res.table
+        if any("." in f.name for f in t.schema):
+            raise ValueError("materialized view query has duplicate column names")
+        self.catalog.register(name, t)
+        self.cache.invalidate(name)
+        return t.num_rows
 
     # --- SELECT ---------------------------------------------------------------
     def _query(self, sel) -> QueryResult:

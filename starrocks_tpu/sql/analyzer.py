@@ -91,6 +91,7 @@ class Analyzer:
     def __init__(self, catalog):
         self.catalog = catalog
         self._ids = itertools.count()
+        self._view_stack: list = []  # cycle detection for view expansion
 
     # --- relations -----------------------------------------------------------
     def analyze(self, sel) -> LogicalPlan:
@@ -304,14 +305,27 @@ class Analyzer:
     def _analyze_relation(self, rel, outer, ctes):
         if isinstance(rel, ast.TableRef):
             name = rel.name.lower()
+            view_sql = getattr(self.catalog, "views", {}).get(name)
+            if view_sql is not None and name not in ctes:
+                from .parser import parse as _parse
+
+                if name in self._view_stack:
+                    raise AnalyzerError(
+                        f"cyclic view reference: {' -> '.join(self._view_stack + [name])}"
+                    )
+                self._view_stack.append(name)
+                try:
+                    # views resolve against the catalog ONLY: caller CTEs and
+                    # outer scopes must not leak into the view body
+                    return self._expand_definition(
+                        _parse(view_sql), rel.alias or name, None, {}
+                    )
+                finally:
+                    self._view_stack.pop()
             if name in ctes:
-                alias = rel.alias or name
-                cdef = ctes[name]
-                if isinstance(cdef, ast.SetOp):
-                    sub_plan = self._analyze_setop(cdef, outer, ctes)
-                else:
-                    sub_plan = self._analyze_select(cdef, outer, ctes)
-                return self._aliased_subplan(sub_plan, alias)
+                return self._expand_definition(
+                    ctes[name], rel.alias or name, outer, ctes
+                )
             t = self.catalog.get_table(name)
             if t is None:
                 raise AnalyzerError(f"unknown table {rel.name!r}")
@@ -340,6 +354,14 @@ class Analyzer:
                 kind = "left"
             return LJoin(lplan, rplan, kind, cond), scope
         raise AnalyzerError(f"unsupported relation {rel!r}")
+
+    def _expand_definition(self, def_ast, alias: str, outer, ctes):
+        """Analyze a view/CTE definition AST and expose it under an alias."""
+        if isinstance(def_ast, ast.SetOp):
+            sub_plan = self._analyze_setop(def_ast, outer, ctes)
+        else:
+            sub_plan = self._analyze_select(def_ast, outer, ctes)
+        return self._aliased_subplan(sub_plan, alias)
 
     def _aliased_subplan(self, sub_plan: LogicalPlan, alias: str):
         """Wrap a subquery plan so its outputs become alias.col."""

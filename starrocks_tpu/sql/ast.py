@@ -190,6 +190,18 @@ class Insert:
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateView:
+    name: str
+    select_text: str  # original SQL text (re-analyzed at reference time)
+    materialized: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RefreshView:
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
 class DropTable:
     name: str
     if_exists: bool = False

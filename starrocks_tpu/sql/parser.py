@@ -39,6 +39,7 @@ SCALAR_FUNCS = {
 class Parser:
     def __init__(self, sql: str):
         self.toks = tokenize(sql)
+        self._sql_text = sql
         self.i = 0
 
     # --- token helpers -------------------------------------------------------
@@ -82,7 +83,7 @@ class Parser:
     SOFT_KEYWORDS = frozenset({
         "year", "month", "day", "date", "first", "last", "tables", "values",
         "show", "key", "primary", "update", "set", "delete", "truncate",
-        "describe", "desc",
+        "describe", "desc", "view", "materialized", "refresh",
     })
 
     def expect_ident(self) -> str:
@@ -141,6 +142,12 @@ class Parser:
                 val = -val
             self.accept_op(";")
             return ast.SetVar(name, val)
+        if self.accept_kw("refresh"):
+            self.accept_kw("materialized")
+            self.expect_kw("view")
+            name = self.expect_ident()
+            self.accept_op(";")
+            return ast.RefreshView(name)
         if self.accept_kw("delete"):
             self.expect_kw("from")
             name = self.parse_table_name()
@@ -576,7 +583,8 @@ class Parser:
         if t.kind == "ident" or (
             t.kind == "kw"
             and t.value in ("key", "primary", "update", "set", "delete",
-                            "truncate", "tables", "show", "first", "last")
+                            "truncate", "tables", "show", "first", "last",
+                            "view", "materialized", "refresh")
         ):
             # func call / qualified col / bare col
             if self.peek(1).kind == "op" and self.peek(1).value == "(":
@@ -719,6 +727,17 @@ class Parser:
     # --- DDL / DML -----------------------------------------------------------
     def parse_create(self):
         self.expect_kw("create")
+        if self.at_kw("view", "materialized"):
+            mat = self.accept_kw("materialized")
+            self.expect_kw("view")
+            name = self.expect_ident()
+            self.expect_kw("as")
+            start = self.peek().pos
+            self.parse_select()  # validate syntax; body re-parsed on use
+            end = self.peek().pos
+            self.accept_op(";")
+            # capture the raw text of the body for storage
+            return ast.CreateView(name, self._sql_text[start:end or None], mat)
         self.expect_kw("table")
         name = self.expect_ident()
         if self.accept_kw("as"):

@@ -95,6 +95,10 @@ class StoredTableHandle(TableHandle):
 class Catalog:
     def __init__(self):
         self.tables: dict = {}
+        # logical views: name -> SQL text (inlined at reference, like the
+        # reference's view expansion); MVs live in `tables` + mv_defs
+        self.views: dict = {}
+        self.mv_defs: dict = {}  # mv name -> SQL text (for REFRESH)
 
     def register(self, name: str, table: HostTable, unique_keys=(),
                  distribution=()):

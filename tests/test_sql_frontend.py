@@ -188,3 +188,51 @@ def test_intersect_except_null_semantics():
         (2, "q"), (None, "n")]
     assert s.sql("select x, s from ia except select x, s from ib order by x nulls last").rows() == [
         (1, "p")]
+
+
+def test_views_and_materialized_views():
+    s = Session()
+    s.sql("create table vb (g varchar, v int)")
+    s.sql("insert into vb values ('a', 1), ('a', 2), ('b', 5)")
+    s.sql("create view vv as select g, sum(v) s from vb group by g")
+    # logical view inlines at reference (always fresh) and composes
+    assert s.sql("select g, s from vv where s > 2 order by g").rows() == [("a", 3), ("b", 5)]
+    assert s.sql("select count(*) c from vv").rows() == [(2,)]
+    # MV materializes; stale until refreshed
+    s.sql("create materialized view mv as select g, count(*) c from vb group by g")
+    assert s.sql("select g, c from mv order by g").rows() == [("a", 2), ("b", 1)]
+    s.sql("insert into vb values ('b', 6)")
+    assert s.sql("select g, c from mv order by g").rows() == [("a", 2), ("b", 1)]
+    assert s.sql("refresh materialized view mv") == 2
+    assert s.sql("select g, c from mv order by g").rows() == [("a", 2), ("b", 2)]
+    # views join with base tables
+    assert s.sql(
+        "select vb.g, vv.s from vb, vv where vb.g = vv.g group by vb.g, vv.s order by 1"
+    ).rows() == [("a", 3), ("b", 11)]
+    # drop
+    s.sql("drop table vv")
+    with pytest.raises(Exception):
+        s.sql("select * from vv")
+
+
+def test_view_scoping_and_conflicts():
+    s = Session()
+    s.sql("create table bt (a int)")
+    s.sql("create table bu (a int)")
+    s.sql("insert into bt values (1)")
+    s.sql("insert into bu values (99)")
+    s.sql("create view bv as select a from bt;")
+    # caller CTEs must NOT leak into view bodies
+    assert s.sql("with bt as (select a from bu) select a from bv").rows() == [(1,)]
+    with pytest.raises(ValueError):
+        s.sql("create materialized view bt as select a from bu")
+    # failed MV creation leaves nothing behind
+    with pytest.raises(Exception):
+        s.sql("create materialized view bad as select zzz from bt")
+    assert "bad" not in s.catalog.mv_defs
+    # cycle guard
+    s.sql("create view c1 as select a from bt")
+    s.catalog.views["c1"] = "select a from c2"
+    s.catalog.views["c2"] = "select a from c1"
+    with pytest.raises(Exception, match="cyclic"):
+        s.sql("select * from c1")
