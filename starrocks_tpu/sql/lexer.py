@@ -21,7 +21,7 @@ KEYWORDS = {
     "over", "partition", "rows", "range", "unbounded", "preceding", "current",
     "show", "describe", "desc", "tables", "delete", "truncate",
     "primary", "key", "update", "set", "intersect", "except",
-    "view", "materialized", "refresh",
+    "view", "materialized", "refresh", "full",
 }
 
 

@@ -75,7 +75,7 @@ class LProject(LogicalPlan):
 class LJoin(LogicalPlan):
     left: LogicalPlan
     right: LogicalPlan
-    kind: str  # inner | left | semi | anti | cross
+    kind: str  # inner | left | semi | anti | cross | full (pre-rewrite only)
     condition: Optional[Expr]  # full ON condition (analyzer form)
 
     @property

@@ -32,6 +32,7 @@ from .logical import (
 
 
 def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
+    plan = rewrite_full_joins(plan)
     plan = rewrite_distinct_aggs(plan)
     plan = pushdown_filters(plan)
     plan = rewrite_subqueries(plan, catalog)
@@ -40,6 +41,84 @@ def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
     plan = pushdown_filters(plan)
     plan = prune_columns(plan)
     return plan
+
+
+# --- 0a. FULL OUTER JOIN rewrite ---------------------------------------------
+
+
+def rewrite_full_joins(plan: LogicalPlan) -> LogicalPlan:
+    """FULL OUTER JOIN -> LEFT OUTER(L,R) UNION ALL the R rows that found no
+    match, taken from LEFT OUTER(R,L) filtered on a NULL left join key (join
+    keys never match NULL, so a NULL key column after the join marks an
+    unmatched row; the join machinery produces correctly-typed NULL columns
+    for free)."""
+    new_children = tuple(rewrite_full_joins(c) for c in plan.children)
+    plan = _replace_children(plan, new_children)
+    if not isinstance(plan, LJoin) or plan.kind != "full":
+        return plan
+    if plan.condition is None:
+        raise NotImplementedError("FULL OUTER JOIN requires an ON condition")
+    lcols = frozenset(plan.left.output_names())
+    rcols = frozenset(plan.right.output_names())
+    probe_key = None
+    equis, l_extras, r_extras = [], [], []
+    for conj in _conjuncts(plan.condition):
+        if (
+            isinstance(conj, Call) and conj.fn == "eq" and len(conj.args) == 2
+            and isinstance(conj.args[0], Col) and isinstance(conj.args[1], Col)
+            and (
+                (conj.args[0].name in lcols and conj.args[1].name in rcols)
+                or (conj.args[1].name in lcols and conj.args[0].name in rcols)
+            )
+        ):
+            a, b = conj.args
+            if probe_key is None:
+                probe_key = a.name if a.name in lcols else b.name
+            equis.append(conj)
+        elif expr_cols(conj) <= lcols:
+            l_extras.append(conj)
+        elif expr_cols(conj) <= rcols:
+            r_extras.append(conj)
+        else:
+            raise NotImplementedError(
+                "FULL OUTER JOIN with mixed-side non-equi ON conjuncts"
+            )
+    if probe_key is None:
+        raise NotImplementedError(
+            "FULL OUTER JOIN requires a column equality condition"
+        )
+
+    def wrap_keys(conds, extras, side_cols):
+        """Preserved-side extras can't filter rows out of an outer join;
+        instead the preserved side's join keys become NULL when the extras
+        fail, so those rows simply never match (NULL keys never match)."""
+        if not extras:
+            return conds
+        pred = and_all(extras)
+        out = []
+        for c in conds:
+            a, b = c.args
+            if a.name in side_cols:
+                a = Call("if", pred, a, Lit(None))
+            else:
+                b = Call("if", pred, b, Lit(None))
+            out.append(Call("eq", a, b))
+        return out
+
+    # b1 preserves L: L-side extras wrap L keys; R-side extras stay in the
+    # condition (pushdown filters the R child — valid for the build side)
+    b1_cond = and_all(wrap_keys(equis, l_extras, lcols) + r_extras)
+    b1 = LJoin(plan.left, plan.right, "left", b1_cond)
+    # b2 preserves R: symmetric
+    b2_cond = and_all(wrap_keys(equis, r_extras, rcols) + l_extras)
+    b2raw = LJoin(plan.right, plan.left, "left", b2_cond)
+    unmatched = LFilter(b2raw, Call("is_null", Col(probe_key)))
+    ordered = tuple(
+        (n, Col(n)) for n in plan.left.output_names() + plan.right.output_names()
+    )
+    b2 = LProject(unmatched, ordered)
+    b1p = LProject(b1, ordered)
+    return LUnion((b1p, b2))
 
 
 # --- 0. DISTINCT aggregate rewrite -------------------------------------------
@@ -209,6 +288,10 @@ def _push(plan: LogicalPlan, preds: list) -> LogicalPlan:
         join_conjuncts = (
             list(_conjuncts(plan.condition)) if plan.condition is not None else []
         )
+        if plan.kind == "full":
+            left = _push(plan.left, [])
+            right = _push(plan.right, [])
+            return _wrap(LJoin(left, right, plan.kind, plan.condition), preds)
         pool = preds + (join_conjuncts if plan.kind in ("inner", "cross") else [])
         for p in pool:
             cols = expr_cols(p)
@@ -435,6 +518,7 @@ def _apply_marker(outer_plan: LogicalPlan, conjunct: Expr, catalog) -> LogicalPl
         m = conjunct
         removed: list = []
         sub = _strip_correlation(m.plan, removed)
+        sub = rewrite_full_joins(sub)
         sub = rewrite_distinct_aggs(sub)
         sub = rewrite_subqueries(sub, catalog)
         # equality pairs become join keys; other correlated conjuncts
@@ -489,6 +573,7 @@ def _apply_marker(outer_plan: LogicalPlan, conjunct: Expr, catalog) -> LogicalPl
     # NOTE: no distinct-agg rewrite here — the pattern match below needs the
     # original single-LAggregate shape; the rewrite applies to `grouped`.
     sub = _strip_correlation(marker.plan)
+    sub = rewrite_full_joins(sub)
     sub = rewrite_subqueries(sub, catalog)
     # locate the aggregate inside (LProject over LAggregate with no group keys)
     if not (

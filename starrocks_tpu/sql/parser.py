@@ -343,6 +343,10 @@ class Parser:
                 self.accept_kw("outer")
                 kind = "right"
                 self.expect_kw("join")
+            elif self.accept_kw("full"):
+                self.accept_kw("outer")
+                kind = "full"
+                self.expect_kw("join")
             elif self.accept_kw("cross"):
                 kind = "cross"
                 self.expect_kw("join")

@@ -236,3 +236,42 @@ def test_view_scoping_and_conflicts():
     s.catalog.views["c2"] = "select a from c1"
     with pytest.raises(Exception, match="cyclic"):
         s.sql("select * from c1")
+
+
+def test_right_and_full_outer_joins():
+    s = Session()
+    s.sql("create table fl (k int, a varchar)")
+    s.sql("create table fr (k int, b varchar)")
+    s.sql("insert into fl values (1, 'x'), (2, 'y')")
+    s.sql("insert into fr values (2, 'q'), (3, 'z')")
+    assert sorted(
+        s.sql("select fl.a, fr.b from fl right join fr on fl.k = fr.k").rows(),
+        key=str,
+    ) == [("y", "q"), (None, "z")]
+    rows = sorted(
+        s.sql("select fl.k, fl.a, fr.k, fr.b from fl full outer join fr on fl.k = fr.k").rows(),
+        key=str,
+    )
+    assert rows == [(1, "x", None, None), (2, "y", 2, "q"), (None, None, 3, "z")]
+    # aggregates over a full join
+    r = s.sql("""select count(*) c, count(fl.k) cl, count(fr.k) cr
+                 from fl full outer join fr on fl.k = fr.k""")
+    assert r.rows() == [(3, 2, 2)]
+
+
+def test_full_join_extras_and_subquery():
+    s = Session()
+    s.sql("create table el (k int, a varchar)")
+    s.sql("create table er (k int, b varchar)")
+    s.sql("insert into el values (1,'x'),(2,'y')")
+    s.sql("insert into er values (2,'q'),(3,'z')")
+    # one-side extra ON conjunct: failed rows stay, as unmatched
+    rows = sorted(s.sql(
+        "select el.k, er.k from el full outer join er on el.k = er.k and er.b = 'q'"
+    ).rows(), key=str)
+    assert rows == [(1, None), (2, 2), (None, 3)]
+    # full join inside a correlated EXISTS
+    r = s.sql("""select el.k from el where exists (
+      select 1 one from el e2 full outer join er on e2.k = er.k
+      where e2.k = el.k) order by 1""")
+    assert r.rows() == [(1,), (2,)]
