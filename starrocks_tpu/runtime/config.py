@@ -28,6 +28,7 @@ class ConfigField:
 class ConfigRegistry:
     def __init__(self):
         self._fields: dict = {}
+        self._hooks: dict = {}
 
     def define(self, name, default, mutable=True, description=""):
         f = ConfigField(name, default, type(default), mutable, description, default)
@@ -46,6 +47,18 @@ class ConfigRegistry:
         if f.type is bool and isinstance(value, str):
             value = value.lower() in ("1", "true", "on", "yes")
         f.value = f.type(value)
+        hook = self._hooks.get(name)
+        if hook is not None:
+            hook(f.value)
+
+    def on_set(self, name: str, hook):
+        """Apply-side hook run on every successful set (and immediately with
+        the current value if non-default) — wiring lives with the field, not
+        in import-time module code."""
+        self._hooks[name] = hook
+        f = self._fields[name]
+        if f.value != f.default:
+            hook(f.value)
 
     def load_env(self, prefix: str = "SR_TPU_"):
         for name, f in self._fields.items():
@@ -88,4 +101,20 @@ config.define("spill_batch_rows", 0, True,
               "activation threshold as the batch size)")
 config.define("bench_sf", 1.0, True, "scale factor used by bench.py")
 config.define("profile_queries", True, True, "collect RuntimeProfile for every query")
+config.define("compilation_cache_dir", "", False,
+              "persistent XLA compilation cache directory (survives process "
+              "restarts; big win for TPU first-compiles). Set via "
+              "SR_TPU_COMPILATION_CACHE_DIR.")
 config.load_env()
+
+
+def _wire_compilation_cache(path: str):
+    if not path:
+        return
+    import jax as _jax
+
+    _jax.config.update("jax_compilation_cache_dir", path)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+config.on_set("compilation_cache_dir", _wire_compilation_cache)

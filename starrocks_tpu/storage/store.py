@@ -427,3 +427,56 @@ def _conjunct_excludes(zm: dict, c: Expr) -> bool:
 
 def _base(qualified: str) -> str:
     return qualified.split(".", 1)[-1]
+
+
+def backup(store: TabletStore, dest_dir: str, max_retries: int = 3) -> int:
+    """Snapshot the whole store (manifests + rowset files + edit log) into an
+    EMPTY dest_dir (reference analog: backup jobs snapshotting tablets to
+    broker storage, fe backup/).
+
+    Consistency: the edit log is copied FIRST (it only under-describes the
+    immutable rowsets that follow); each table's manifest is written after
+    its files. A concurrent rewrite (DELETE/UPDATE) that removes files while
+    a table is being copied is detected (missing file) and that table's
+    snapshot restarts from its fresh manifest."""
+    import shutil
+
+    if os.path.exists(dest_dir) and os.listdir(dest_dir):
+        raise ValueError(f"backup target {dest_dir!r} is not empty")
+    os.makedirs(dest_dir, exist_ok=True)
+    if os.path.exists(store.log_path):
+        shutil.copy2(store.log_path, os.path.join(dest_dir, "edit_log.jsonl"))
+    n = 0
+    for t in store.table_names():
+        src = store._tdir(t)
+        dst = os.path.join(dest_dir, t)
+        for attempt in range(max_retries):
+            os.makedirs(dst, exist_ok=True)
+            m = store.read_manifest(t)
+            try:
+                for rs in m["rowsets"]:
+                    for fmeta in rs["files"]:
+                        shutil.copy2(os.path.join(src, fmeta["file"]), dst)
+                break
+            except FileNotFoundError:
+                # a concurrent rewrite replaced this table's rowsets;
+                # restart from the fresh manifest
+                shutil.rmtree(dst, ignore_errors=True)
+        else:
+            raise RuntimeError(
+                f"table {t!r} kept changing during backup ({max_retries} tries)"
+            )
+        with open(os.path.join(dst, "manifest.json"), "w") as f:
+            json.dump(m, f, indent=1)
+        n += 1
+    return n
+
+
+def restore(backup_dir: str, dest_dir: str) -> int:
+    """Materialize a backup as a fresh store directory."""
+    import shutil
+
+    if os.path.exists(dest_dir) and os.listdir(dest_dir):
+        raise ValueError(f"restore target {dest_dir!r} is not empty")
+    shutil.copytree(backup_dir, dest_dir, dirs_exist_ok=True)
+    return len(TabletStore(dest_dir).table_names())
