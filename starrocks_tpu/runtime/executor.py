@@ -291,7 +291,14 @@ class Executor:
             overflow = False
             for key, v in keyed_checks:
                 if v > caps.values[key]:
-                    caps.values[key] = pad_capacity(int(v * headroom) + 1)
+                    new_cap = pad_capacity(int(v * headroom) + 1)
+                    if new_cap >= (1 << 31):
+                        raise ExecError(
+                            f"operator {key} needs capacity {v} rows — the "
+                            "plan is likely missing a join predicate "
+                            "(cartesian blowup)"
+                        )
+                    caps.values[key] = new_cap
                     overflow = True
             if not overflow:
                 profile.add_counter("recompiles", attempt)

@@ -245,6 +245,44 @@ def substitute(e: Expr, mapping: dict) -> Expr:
     return e
 
 
+def _disjuncts(e: Expr):
+    if isinstance(e, Call) and e.fn == "or":
+        for a in e.args:
+            yield from _disjuncts(a)
+    else:
+        yield e
+
+
+def or_all(disjuncts) -> Expr:
+    disjuncts = list(disjuncts)
+    e = disjuncts[0]
+    for d in disjuncts[1:]:
+        e = Call("or", e, d)
+    return e
+
+
+def factor_or(pred: Expr) -> list:
+    """(A AND X AND ..) OR (B AND X AND ..) -> [X, (A AND ..) OR (B AND ..)].
+
+    Pulls conjuncts common to every OR branch out of the disjunction — the
+    classic rewrite that turns TPC-H Q19's 'three OR-ed bundles each
+    repeating the join predicate' into an extractable equi-join key
+    (reference analog: common-predicate extraction in the fe optimizer)."""
+    if not (isinstance(pred, Call) and pred.fn == "or"):
+        return [pred]
+    branch_sets = [list(_conjuncts(b)) for b in _disjuncts(pred)]
+    common = [c for c in branch_sets[0] if all(c in bs for bs in branch_sets[1:])]
+    if not common:
+        return [pred]
+    residuals = []
+    for bs in branch_sets:
+        rest = [c for c in bs if c not in common]
+        residuals.append(and_all(rest) if rest else Lit(True))
+    if all(r == Lit(True) for r in residuals):
+        return common  # some branch was exactly the common set: OR is vacuous
+    return common + [or_all(residuals)]
+
+
 def and_all(conjuncts) -> Expr:
     conjuncts = list(conjuncts)
     if not conjuncts:
@@ -265,7 +303,10 @@ def pushdown_filters(plan: LogicalPlan) -> LogicalPlan:
 def _push(plan: LogicalPlan, preds: list) -> LogicalPlan:
     """preds: conjuncts from above to place as deep as possible."""
     if isinstance(plan, LFilter):
-        return _push(plan.child, preds + list(_conjuncts(plan.predicate)))
+        incoming = [
+            f for c in _conjuncts(plan.predicate) for f in factor_or(c)
+        ]
+        return _push(plan.child, preds + incoming)
 
     if isinstance(plan, LProject):
         mapping = dict(plan.exprs)
@@ -286,7 +327,8 @@ def _push(plan: LogicalPlan, preds: list) -> LogicalPlan:
         rcols = frozenset(plan.right.output_names())
         lpreds, rpreds, stay, markers = [], [], [], []
         join_conjuncts = (
-            list(_conjuncts(plan.condition)) if plan.condition is not None else []
+            [f for c in _conjuncts(plan.condition) for f in factor_or(c)]
+            if plan.condition is not None else []
         )
         if plan.kind == "full":
             left = _push(plan.left, [])

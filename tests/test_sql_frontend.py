@@ -275,3 +275,13 @@ def test_full_join_extras_and_subquery():
       select 1 one from el e2 full outer join er on e2.k = er.k
       where e2.k = el.k) order by 1""")
     assert r.rows() == [(1,), (2,)]
+
+
+def test_or_factoring_enables_join_keys():
+    # regression: TPC-H-Q19-style OR of bundles repeating the join predicate
+    # must factor the common equi conjunct out (else cartesian blowup)
+    s = Session(tpch_catalog(sf=0.001))
+    plan = s.sql("""explain select sum(l_extendedprice) r from lineitem, part
+        where (p_partkey = l_partkey and p_size < 10)
+           or (p_partkey = l_partkey and p_size > 40)""")
+    assert "Join[inner" in plan and "Join[cross" not in plan
