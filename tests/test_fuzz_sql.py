@@ -59,6 +59,9 @@ def gen_spec(rng):
         "filters": [],
         "group": list(rng.choice(["g", "h"], size=rng.integers(1, 3), replace=False)),
         "aggs": [],
+        "str_filter": (rng.random() < 0.3),
+        "having_min_cnt": int(rng.integers(0, 4)) if rng.random() < 0.4 else None,
+        "order_limit": int(rng.integers(1, 6)) if rng.random() < 0.4 else None,
     }
     for _ in range(rng.integers(0, 3)):
         col, lo, hi = rng.choice([("y", -50, 50), ("k", 1, 40), ("h", 0, 4)])
@@ -86,7 +89,14 @@ def spec_to_sql(spec):
         q = f"t1.{col}" if spec["join"] else col
         sql += f"{glue}{q} {op} {v}"
         glue = " and "
+    if spec["str_filter"]:
+        g = "t1.g" if spec["join"] else "g"
+        sql += f"{glue}{g} in ('a', 'c')"
     sql += f" group by {keys}"
+    if spec["having_min_cnt"] is not None:
+        sql += f" having count(*) >= {spec['having_min_cnt']}"
+    if spec["order_limit"] is not None:
+        sql += f" order by cnt desc, {keys} limit {spec['order_limit']}"
     return sql
 
 
@@ -99,6 +109,8 @@ def spec_to_pandas(spec, t1, t2):
             df = df[df[col] >= v]
         else:
             df = df[df[col] == v]
+    if spec["str_filter"]:
+        df = df[df["g"].isin(["a", "c"])]
     if df.empty:
         return []
     g = df.groupby(spec["group"], dropna=False)
@@ -110,6 +122,12 @@ def spec_to_pandas(spec, t1, t2):
             out[f"a{i}"] = getattr(g[col], fn if fn != "avg" else "mean")()
     out["cnt"] = g.size()
     res = pd.DataFrame(out).reset_index()
+    if spec["having_min_cnt"] is not None:
+        res = res[res["cnt"] >= spec["having_min_cnt"]]
+    if spec["order_limit"] is not None:
+        res = res.sort_values(
+            ["cnt"] + spec["group"], ascending=[False] + [True] * len(spec["group"])
+        ).head(spec["order_limit"])
     return [tuple(r) for r in res.itertuples(index=False)]
 
 
