@@ -68,6 +68,10 @@ def _infer_lit(value, ltype: T.LogicalType | None) -> tuple:
     if ltype is not None and ltype.kind is T.TypeKind.DATE and isinstance(value, str):
         d = datetime.date.fromisoformat(value)
         return (d - datetime.date(1970, 1, 1)).days, ltype
+    if ltype is not None and ltype.kind is T.TypeKind.DATETIME and isinstance(value, str):
+        dt = datetime.datetime.fromisoformat(value.replace(" ", "T"))
+        us = (dt - datetime.datetime(1970, 1, 1)) // datetime.timedelta(microseconds=1)
+        return us, ltype
     if value is None:
         # typed or not, a NULL literal is NULL; callers branch on value None
         return 0, T.NULLTYPE
@@ -90,21 +94,54 @@ def _infer_lit(value, ltype: T.LogicalType | None) -> tuple:
 
 
 _DATE_RE = re.compile(r"^\d{4}-\d{2}-\d{2}$")
+_DATETIME_RE = re.compile(r"^\d{4}-\d{2}-\d{2}[ T]\d{2}:\d{2}(:\d{2}(\.\d+)?)?$")
 
 
 def _lit_as_date_if_str(v: EVal) -> EVal:
-    """Promote a 'YYYY-MM-DD' string literal to DATE (context coercion)."""
-    if v.type.is_string and isinstance(v.data, str) and _DATE_RE.match(v.data):
-        days = (datetime.date.fromisoformat(v.data) - datetime.date(1970, 1, 1)).days
-        return EVal(jnp.asarray(days, dtype=jnp.int32), v.valid, T.DATE)
+    """Promote 'YYYY-MM-DD' / 'YYYY-MM-DD HH:MM[:SS]' string literals to
+    DATE / DATETIME. Callers apply this only in TEMPORAL context (the other
+    operand is a date/datetime) so ordinary string comparisons are untouched;
+    unparseable look-alikes fall through unchanged."""
+    if v.type.is_string and isinstance(v.data, str):
+        if _DATE_RE.match(v.data):
+            try:
+                d = datetime.date.fromisoformat(v.data)
+            except ValueError:
+                return v
+            days = (d - datetime.date(1970, 1, 1)).days
+            return EVal(jnp.asarray(days, dtype=jnp.int32), v.valid, T.DATE)
+        if _DATETIME_RE.match(v.data):
+            try:
+                dt = datetime.datetime.fromisoformat(v.data.replace(" ", "T"))
+            except ValueError:
+                return v
+            us = (dt - datetime.datetime(1970, 1, 1)) // datetime.timedelta(
+                microseconds=1
+            )
+            return EVal(jnp.asarray(us, dtype=jnp.int64), v.valid, T.DATETIME)
     return v
+
+
+def _promote_temporal_literals(a: EVal, b: EVal):
+    """Context coercion: string literals become dates/datetimes only when the
+    OTHER operand is temporal (never hijack string-vs-string comparisons)."""
+    if b.type.is_temporal:
+        a = _lit_as_date_if_str(a)
+    if a.type.is_temporal:
+        b = _lit_as_date_if_str(b)
+    return a, b
 
 
 # --- numeric coercion -------------------------------------------------------
 
 
 def _to_numeric(v: EVal, target: T.LogicalType) -> jnp.ndarray:
-    """Cast v.data to target's representation (handles decimal rescale)."""
+    """Cast v.data to target's representation (handles decimal rescale and
+    temporal unit conversion)."""
+    if v.type.kind is T.TypeKind.DATE and target.kind is T.TypeKind.DATETIME:
+        return jnp.asarray(v.data, jnp.int64) * 86_400_000_000
+    if v.type.kind is T.TypeKind.DATETIME and target.kind is T.TypeKind.DATE:
+        return (jnp.asarray(v.data, jnp.int64) // 86_400_000_000).astype(jnp.int32)
     if v.type.is_decimal and target.is_decimal:
         d = jnp.asarray(v.data, dtype=jnp.int64)
         if v.type.scale < target.scale:
@@ -193,14 +230,7 @@ class ExprCompiler:
             raise NotImplementedError("string->x casts not supported on device")
         if to.is_string:
             raise NotImplementedError("x->string casts not supported on device")
-        if v.type.kind is T.TypeKind.DATE and to.kind is T.TypeKind.DATETIME:
-            return EVal(
-                jnp.asarray(v.data, dtype=jnp.int64) * 86_400_000_000, v.valid, to
-            )
-        if v.type.kind is T.TypeKind.DATETIME and to.kind is T.TypeKind.DATE:
-            return EVal(
-                (jnp.asarray(v.data) // 86_400_000_000).astype(jnp.int32), v.valid, to
-            )
+        # DATE<->DATETIME conversion is handled inside _to_numeric
         return EVal(_to_numeric(v, to), v.valid, to)
 
     # --- CASE ---------------------------------------------------------------
@@ -292,8 +322,7 @@ def function(name):
 
 
 def _binary_numeric(cc: ExprCompiler, a: EVal, b: EVal, op, scale_rule):
-    a = _lit_as_date_if_str(a)
-    b = _lit_as_date_if_str(b)
+    a, b = _promote_temporal_literals(a, b)
     ct = _common(a, b)
     if ct.is_decimal:
         ct = scale_rule(a, b, ct)
@@ -319,8 +348,7 @@ def _f_sub(cc, a, b):
 
 @function("multiply")
 def _f_mul(cc, a, b):
-    a = _lit_as_date_if_str(a)
-    b = _lit_as_date_if_str(b)
+    a, b = _promote_temporal_literals(a, b)
     ct = _common(a, b)
     if ct.is_decimal:
         sa = a.type.scale if a.type.is_decimal else 0
@@ -369,8 +397,7 @@ def _f_abs(cc, a):
 
 
 def _compare(cc, a, b, op):
-    a = _lit_as_date_if_str(a)
-    b = _lit_as_date_if_str(b)
+    a, b = _promote_temporal_literals(a, b)
     if a.type.is_string or b.type.is_string:
         return _compare_strings(cc, a, b, op)
     ct = _common(a, b)

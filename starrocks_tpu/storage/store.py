@@ -399,10 +399,16 @@ def _conjunct_excludes(zm: dict, c: Expr) -> bool:
     if ent is None or b.value is None:
         return False
     v = b.value
-    if b.type is not None and b.type.kind is T.TypeKind.DATE and isinstance(v, str):
+    if b.type is not None and isinstance(v, str):
         import datetime
 
-        v = (datetime.date.fromisoformat(v) - datetime.date(1970, 1, 1)).days
+        if b.type.kind is T.TypeKind.DATE:
+            v = (datetime.date.fromisoformat(v) - datetime.date(1970, 1, 1)).days
+        elif b.type.kind is T.TypeKind.DATETIME:
+            v = (
+                datetime.datetime.fromisoformat(v.replace(" ", "T"))
+                - datetime.datetime(1970, 1, 1)
+            ) // datetime.timedelta(microseconds=1)
     if "scale" in ent:
         # decimal zonemaps hold scaled ints; scale the logical literal
         if isinstance(v, str):

@@ -174,3 +174,29 @@ def test_exprs_jittable():
     assert list(np.asarray(m)[:3]) == [False, True, True]
     run(c)
     assert run._cache_size() == 1
+
+
+def test_datetime_end_to_end():
+    import tempfile
+
+    from starrocks_tpu.runtime.session import Session
+
+    d = tempfile.mkdtemp()
+    s = Session(data_dir=d)
+    s.sql("create table ev (id int, ts datetime, v double)")
+    s.sql("""insert into ev values (1, '2024-03-01 10:30:00', 1.5),
+             (2, '2024-03-01 11:00:00', 2.5), (3, '2024-03-02 09:00:00', 4.0)""")
+    assert s.sql("select id from ev where ts >= '2024-03-01 11:00:00' order by id").rows() == [(2,), (3,)]
+    assert s.sql("select id from ev where ts < '2024-03-02' order by id").rows() == [(1,), (2,)]
+    assert s.sql("select day(ts) d, sum(v) s from ev group by 1 order by 1").rows() == [(1, 4.0), (2, 4.0)]
+    # real parquet persistence roundtrip (fresh session over the same dir)
+    s2 = Session(data_dir=d)
+    assert s2.sql("select id from ev where ts >= '2024-03-01 11:00' order by id").rows() == [(2,), (3,)]
+    # string comparisons with datetime-looking literals stay string-typed
+    s2.sql("create table sv (name varchar)")
+    s2.sql("insert into sv values ('2024-03-01 11:00:00'), ('other')")
+    assert s2.sql("select count(*) c from sv where name = '2024-03-01 11:00:00'").rows() == [(1,)]
+    # garbage time values in string context stay plain strings
+    assert s2.sql("select count(*) c from sv where name = '2024-03-01 99:99'").rows() == [(0,)]
+    # IN-list on a datetime column
+    assert s2.sql("select id from ev where ts in ('2024-03-01 10:30:00')").rows() == [(1,)]
