@@ -71,7 +71,11 @@ class StringDict:
     def decode(self, codes: np.ndarray) -> np.ndarray:
         """Codes -> strings; the -1 'absent' sentinel decodes to ""."""
         codes = np.asarray(codes)
-        out = self.values[np.clip(codes, 0, max(len(self.values) - 1, 0))]
+        if len(self.values) == 0:
+            # empty dictionary (e.g. an all-NULL varchar column): every slot
+            # decodes to "" (real values are masked by validity anyway)
+            return np.full(codes.shape, "", dtype=object)
+        out = self.values[np.clip(codes, 0, len(self.values) - 1)]
         if len(out) and (codes < 0).any():
             out = out.copy()
             out[codes < 0] = ""

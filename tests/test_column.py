@@ -152,3 +152,25 @@ def test_from_arrow():
     assert rows[0][0] == 1 and rows[2][0] is None
     assert rows[0][1] == "p" and rows[1][1] is None
     assert rows[0][2] == "2019-04-14"
+
+
+def test_empty_dict_decode():
+    d = StringDict.from_values([])
+    assert list(d.decode(np.array([0, 3, -1]))) == ["", "", ""]
+
+
+def test_empty_table_operator_sweep():
+    # every operator shape over an empty table must return cleanly
+    from starrocks_tpu.runtime.session import Session
+
+    s = Session()
+    s.sql("create table e1 (k int, g varchar, v double)")
+    s.sql("create table f1 (k int, g varchar, v double)")
+    s.sql("insert into f1 values (1, 'a', 1.0)")
+    assert s.sql("select g, sum(v) s from e1 group by g").rows() == []
+    assert s.sql("select count(*) c, sum(v) s from e1").rows() == [(0, None)]
+    assert s.sql("select f1.k from f1 left join e1 on f1.k = e1.k").rows() == [(1,)]
+    assert s.sql("select g, sum(v) s from e1 group by rollup(g)").rows() == [(None, None)]
+    assert s.sql("select k, rank() over (order by v) r from e1").rows() == []
+    assert s.sql("select count(distinct g) c from e1").rows() == [(0,)]
+    assert s.sql("select k from e1 union all select k from f1").rows() == [(1,)]
