@@ -116,3 +116,23 @@ def test_colocate_join_no_shuffle(eight_devices):
                    for m in comp.scan_modes)
     finally:
         D.SHARD_THRESHOLD_ROWS = old
+
+
+def test_distributed_fuzz(eight_devices):
+    """Random query specs agree between single-chip and the 8-shard mesh."""
+    import numpy as np
+
+    from test_fuzz_sql import _norm, gen_spec, load_session, make_tables, spec_to_sql
+
+    old = D.SHARD_THRESHOLD_ROWS
+    D.SHARD_THRESHOLD_ROWS = 300
+    try:
+        rng = np.random.default_rng(777)
+        t1, t2 = make_tables(rng)
+        s1 = load_session(t1, t2)
+        s8 = Session(s1.catalog, dist_shards=8)
+        for _ in range(10):
+            sql = spec_to_sql(gen_spec(rng))
+            assert _norm(s1.sql(sql).rows()) == _norm(s8.sql(sql).rows()), sql
+    finally:
+        D.SHARD_THRESHOLD_ROWS = old
