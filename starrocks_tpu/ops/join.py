@@ -64,22 +64,42 @@ def pack_keys(chunk: Chunk, key_exprs, bit_widths=None):
 
 def runtime_filter_mask(
     probe: Chunk, build: Chunk, probe_keys, build_keys, bit_widths=None,
-    axis: str | None = None,
+    axis: str | None = None, dense_range: tuple | None = None,
 ):
-    """Build-side min/max runtime filter applied to the probe (reference:
+    """Build-side runtime filter applied to the probe (reference:
     be/src/exec_primitive/runtime_filter/ + global merge via
     orchestration/runtime_filter_worker.h:41). In the compiled world the
-    "delivery" is dataflow: the build min/max feeds a probe mask in the same
-    program; with `axis` set the local bounds are merged across shards with
-    pmin/pmax — the global-runtime-filter collective. Only valid for
-    INNER/LEFT SEMI joins (probe rows may be dropped)."""
+    "delivery" is dataflow: build-side summaries feed a probe mask inside
+    the same program. Two strengths:
+
+    - min/max range filter (always available); with `axis` the local bounds
+      merge across shards via pmin/pmax — the global-RF collective.
+    - EXACT membership (IN-set) filter when the planner bounds the key range
+      via catalog stats (`dense_range=(lo, hi)`): build keys scatter into a
+      dense presence bitmap the probe gathers; with `axis` the bitmaps
+      OR-merge across shards (pmax). Subsumes min/max — e.g. a filtered
+      dimension build passes only its surviving keys.
+
+    Only valid for INNER/LEFT SEMI joins (probe rows may be dropped)."""
     bk, b_ok = pack_keys(build, build_keys, bit_widths)
+    pk, p_ok = pack_keys(probe, probe_keys, bit_widths)
+    if dense_range is not None:
+        lo, hi = dense_range
+        size = int(hi - lo + 1)
+        present = jnp.zeros((size,), jnp.int32).at[
+            jnp.where(b_ok, bk - lo, size)
+        ].set(1, mode="drop")
+        if axis is not None:
+            present = jax.lax.pmax(present, axis)
+        idx = pk - lo
+        in_range = (idx >= 0) & (idx < size)
+        hit = present[jnp.clip(idx, 0, size - 1)] == 1
+        return in_range & hit
     bmin = jnp.min(jnp.where(b_ok, bk, _I64MAX))
     bmax = jnp.max(jnp.where(b_ok, bk, jnp.iinfo(jnp.int64).min))
     if axis is not None:
         bmin = jax.lax.pmin(bmin, axis)
         bmax = jax.lax.pmax(bmax, axis)
-    pk, p_ok = pack_keys(probe, probe_keys, bit_widths)
     return (pk >= bmin) & (pk <= bmax)
 
 

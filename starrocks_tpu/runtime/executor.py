@@ -68,6 +68,21 @@ class DeviceCache:
     def invalidate(self, table: str):
         self._cols = {k: v for k, v in self._cols.items() if k[0] != table}
         self._caps = {k: v for k, v in self._caps.items() if k[0] != table}
+        # evict compiled programs that scan this table: traces bake
+        # stats-derived constants (dense runtime-filter ranges, multi-key
+        # bit widths), which DML can silently outgrow without a shape change
+        from ..sql.logical import LScan, LogicalPlan, walk_plan
+
+        def scans_table(key) -> bool:
+            for part in key:
+                if isinstance(part, LogicalPlan):
+                    for node in walk_plan(part):
+                        if isinstance(node, LScan) and node.table == table:
+                            return True
+            return False
+
+        for key in [k for k in self.programs if scans_table(k)]:
+            del self.programs[key]
 
     def chunk_for(self, handle, alias: str, columns, placement=None) -> Chunk:
         """Device chunk of the requested columns, renamed to alias-qualified."""

@@ -266,10 +266,14 @@ def compile_distributed(
             if p.kind in ("inner", "semi", "cross") and probe_keys and not (
                 len(probe_keys) == 1 and isinstance(probe_keys[0], Lit)
             ) and _cfg.get("enable_runtime_filters"):
+                from .physical import dense_rf_range
+
                 rf_axis = axis if _is_dist(rm) else None
+                dr = dense_rf_range(p.left, p.right, probe_keys, build_keys, catalog)
                 lc = lc.and_sel(
                     runtime_filter_mask(lc, rc, tuple(probe_keys),
-                                        tuple(build_keys), bit_widths, rf_axis)
+                                        tuple(build_keys), bit_widths, rf_axis,
+                                        dense_range=dr)
                 )
 
             # --- distribution strategy ---

@@ -108,6 +108,32 @@ def col_origin(plan: LogicalPlan, name: str):
     return None
 
 
+DENSE_RF_MAX_RANGE = 1 << 22  # dense presence bitmaps up to 4M slots
+
+
+def dense_rf_range(plan_l, plan_r, probe_keys, build_keys, catalog):
+    """(lo, hi) for an exact IN-set runtime filter: the BUILD side's key
+    range only (probe keys outside it fail in_range and are correctly
+    dropped — they can't match anything); None when unbounded/too wide."""
+    if len(probe_keys) != 1 or len(build_keys) != 1:
+        return None
+    pk, bk = probe_keys[0], build_keys[0]
+    if not (isinstance(pk, Col) and isinstance(bk, Col)):
+        return None
+    origin = col_origin(plan_r, bk.name)
+    if origin is None:
+        return None
+    t = catalog.get_table(origin[0])
+    if t is None:
+        return None
+    st = t.column_stats(origin[1])
+    if st.min is None or st.max is None:
+        return None
+    if st.max - st.min + 1 > DENSE_RF_MAX_RANGE:
+        return None
+    return (st.min, st.max)
+
+
 def _key_bit_width(plan, key: Expr, catalog) -> Optional[int]:
     if not isinstance(key, Col):
         return None
@@ -275,9 +301,11 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
             if p.kind in ("inner", "semi", "cross") and probe_keys and not (
                 len(probe_keys) == 1 and isinstance(probe_keys[0], Lit)
             ) and _cfg.get("enable_runtime_filters"):
+                dr = dense_rf_range(p.left, p.right, probe_keys, build_keys, catalog)
                 lc = lc.and_sel(
                     runtime_filter_mask(lc, rc, tuple(probe_keys),
-                                        tuple(build_keys), bit_widths)
+                                        tuple(build_keys), bit_widths,
+                                        dense_range=dr)
                 )
 
             if residual and p.kind in ("semi", "anti"):

@@ -289,3 +289,37 @@ def test_join_expand_null_probe_key_left_outer():
     rows = sorted(_res(out), key=str)
     assert (None, None) in rows and (2, 5) in rows
     assert (None, 999) not in rows
+
+
+def test_dense_runtime_filter_exactness():
+    # an exact IN-set filter passes ONLY surviving build keys (min/max can't)
+    from starrocks_tpu.ops.join import runtime_filter_mask
+
+    probe = HostTable.from_pydict({"pk": [1, 2, 3, 4, 5, 6]}).to_chunk()
+    build = HostTable.from_pydict({"bk": [1, 3, 5, 6]}).to_chunk()
+    build = build.and_sel(jnp.asarray(
+        [True, True, False, True] + [False] * (build.capacity - 4)))  # drop 5
+    m = runtime_filter_mask(probe, build, (col("pk"),), (col("bk"),),
+                            dense_range=(1, 6))
+    assert list(np.asarray(m)[:6]) == [True, False, True, False, False, True]
+    # min/max only bounds the range
+    m2 = runtime_filter_mask(probe, build, (col("pk"),), (col("bk"),))
+    assert list(np.asarray(m2)[:6]) == [True, True, True, True, True, True]
+
+
+def test_stale_stats_program_eviction():
+    # regression: INSERT must evict cached programs whose traces baked
+    # stats-derived constants (dense RF ranges)
+    from starrocks_tpu.runtime.session import Session
+
+    s = Session()
+    s.sql("create table dl (k int)")
+    s.sql("create table dr (k int, v int)")
+    s.sql("insert into dl values (1), (2)")
+    s.sql("insert into dr values (1, 10), (2, 20)")
+    q = "select dl.k, dr.v from dl, dr where dl.k = dr.k order by 1"
+    assert s.sql(q).rows() == [(1, 10), (2, 20)]
+    # extend the key range WITHOUT changing padded capacities
+    s.sql("insert into dl values (99)")
+    s.sql("insert into dr values (99, 990)")
+    assert s.sql(q).rows() == [(1, 10), (2, 20), (99, 990)]
