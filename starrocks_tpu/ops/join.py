@@ -86,11 +86,11 @@ def runtime_filter_mask(
     if dense_range is not None:
         lo, hi = dense_range
         size = int(hi - lo + 1)
-        present = jnp.zeros((size,), jnp.int32).at[
+        present = jnp.zeros((size,), jnp.uint8).at[
             jnp.where(b_ok, bk - lo, size)
         ].set(1, mode="drop")
         if axis is not None:
-            present = jax.lax.pmax(present, axis)
+            present = jax.lax.pmax(present, axis)  # bitmap OR across shards
         idx = pk - lo
         in_range = (idx >= 0) & (idx < size)
         hit = present[jnp.clip(idx, 0, size - 1)] == 1

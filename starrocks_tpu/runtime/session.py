@@ -173,6 +173,8 @@ class Session:
             return self._refresh_mv(stmt.name.lower())
         if isinstance(stmt, ast.ShowTables):
             return sorted(self.catalog.tables)
+        if isinstance(stmt, ast.ShowCreate):
+            return self._show_create(stmt.table)
         if isinstance(stmt, ast.Describe):
             h = self.catalog.get_table(stmt.table)
             if h is None:
@@ -197,6 +199,28 @@ class Session:
         self.catalog.register(name, t)
         self.cache.invalidate(name)
         return t.num_rows
+
+    def _show_create(self, name: str) -> str:
+        nm = name.lower()
+        if nm in self.catalog.views:
+            return f"CREATE VIEW {nm} AS {self.catalog.views[nm].strip()}"
+        if nm in self.catalog.mv_defs:
+            return (f"CREATE MATERIALIZED VIEW {nm} AS "
+                    f"{self.catalog.mv_defs[nm].strip()}")
+        h = self.catalog.get_table(name)
+        if h is None:
+            raise ValueError(f"unknown table {name}")
+        cols = ",\n  ".join(
+            f"{f.name} {repr(f.type)}{'' if f.nullable else ' NOT NULL'}"
+            for f in h.schema
+        )
+        out = f"CREATE TABLE {nm} (\n  {cols}"
+        if h.unique_keys:
+            out += f",\n  PRIMARY KEY({', '.join(h.unique_keys[0])})"
+        out += "\n)"
+        if h.distribution:
+            out += f" DISTRIBUTED BY HASH({', '.join(h.distribution)})"
+        return out
 
     # --- SELECT ---------------------------------------------------------------
     def _query(self, sel) -> QueryResult:
@@ -332,7 +356,8 @@ class Session:
             self.store.rewrite_table(handle.name, conformed)
             handle.invalidate()
         else:
-            self.catalog.register(handle.name, conformed, handle.unique_keys)
+            self.catalog.register(handle.name, conformed, handle.unique_keys,
+                                  handle.distribution)
         self.cache.invalidate(handle.name)
 
     # --- DDL / DML -------------------------------------------------------------
@@ -445,7 +470,8 @@ class Session:
             handle.invalidate()
         else:
             merged = concat_tables(handle.table, incoming, target_schema=handle.schema)
-            self.catalog.register(handle.name, merged, handle.unique_keys)
+            self.catalog.register(handle.name, merged, handle.unique_keys,
+                                  handle.distribution)
         self.cache.invalidate(handle.name)
         return n
 

@@ -213,6 +213,11 @@ class ShowTables:
 
 
 @dataclasses.dataclass(frozen=True)
+class ShowCreate:
+    table: str
+
+
+@dataclasses.dataclass(frozen=True)
 class Describe:
     table: str
 

@@ -162,6 +162,11 @@ class Parser:
             self.accept_op(";")
             return ast.Delete(name, None)
         if self.accept_kw("show"):
+            if self.accept_kw("create"):
+                self.expect_kw("table")
+                name = self.parse_table_name()
+                self.accept_op(";")
+                return ast.ShowCreate(name)
             self.expect_kw("tables")
             self.accept_op(";")
             return ast.ShowTables()

@@ -285,3 +285,27 @@ def test_or_factoring_enables_join_keys():
         where (p_partkey = l_partkey and p_size < 10)
            or (p_partkey = l_partkey and p_size > 40)""")
     assert "Join[inner" in plan and "Join[cross" not in plan
+
+
+def test_show_create_table():
+    s = Session()
+    s.sql("create table sct (a int not null, b varchar, primary key(a)) distributed by hash(a) buckets 4")
+    ddl = s.sql("show create table sct")
+    assert "a INT NOT NULL" in ddl and "PRIMARY KEY(a)" in ddl
+    assert "DISTRIBUTED BY HASH(a)" in ddl
+    s.sql("create view scv as select a from sct")
+    assert s.sql("show create table scv").startswith("CREATE VIEW scv AS")
+    with pytest.raises(ValueError):
+        s.sql("show create table nosuch")
+
+
+def test_distribution_survives_dml():
+    # regression: INSERT/DELETE must not drop distribution metadata (it feeds
+    # colocate placement and SHOW CREATE)
+    s = Session()
+    s.sql("create table dt (a int) distributed by hash(a)")
+    s.sql("insert into dt values (1), (2)")
+    assert "DISTRIBUTED BY HASH(a)" in s.sql("show create table dt")
+    s.sql("delete from dt where a = 1")
+    assert "DISTRIBUTED BY HASH(a)" in s.sql("show create table dt")
+    assert s.catalog.get_table("dt").distribution == ("a",)
