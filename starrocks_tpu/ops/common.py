@@ -23,7 +23,11 @@ def eval_keys(chunk: Chunk, key_exprs) -> list:
     for e in key_exprs:
         v = cc.eval(e)
         data = jnp.broadcast_to(jnp.asarray(v.data), (chunk.capacity,))
-        out.append(EVal(data, v.valid, v.type, v.dict))
+        # valid can come back scalar too (e.g. `x % 3`: nullness derives
+        # from the literal divisor) — lexsort/boundaries need full rank
+        valid = (None if v.valid is None else
+                 jnp.broadcast_to(jnp.asarray(v.valid), (chunk.capacity,)))
+        out.append(EVal(data, valid, v.type, v.dict))
     return out
 
 

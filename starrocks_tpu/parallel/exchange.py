@@ -88,7 +88,14 @@ def shuffle_chunk(
         mix = hash_hash64(mix ^ kd_u)
     bucket = jnp.asarray(mix % jnp.uint64(n_shards), jnp.int32)
     bucket = jnp.where(live, bucket, n_shards)
+    return _exchange_by_bucket(chunk, bucket, axis, n_shards, bucket_capacity)
 
+
+def _exchange_by_bucket(chunk, bucket, axis, n_shards, bucket_capacity):
+    """Route each live row to shard `bucket[row]` (dead rows carry bucket
+    n_shards). Shared tail of the HASH and RANGE partition exchanges:
+    stable-pack rows per destination bucket, pad to bucket_capacity, one
+    lax.all_to_all. Returns (chunk_out, max_bucket_count)."""
     order = jnp.argsort(bucket, stable=True)
     b_sorted = bucket[order]
     counts = jnp.bincount(bucket, length=n_shards + 1)[:n_shards]
@@ -122,3 +129,48 @@ def shuffle_chunk(
     )
     sel = a2a(live_buf)
     return Chunk(chunk.schema, data, valid, sel), jnp.max(counts)
+
+
+def range_partition_chunk(
+    chunk: Chunk,
+    rank: jnp.ndarray,
+    axis: str,
+    n_shards: int,
+    bucket_capacity: int,
+    sample_per_shard: int = 64,
+):
+    """RANGE exchange: rows travel to shards by sampled splitters of `rank`
+    (a totally-ordered per-row sort key; dead rows may hold anything). After
+    the exchange, shard i's live rows all rank <= shard i+1's — a local sort
+    per shard then yields GLOBAL order across the device axis, so the final
+    tiled all_gather concatenates to a globally sorted table. This is the
+    TPU analog of the reference's merge-path distributed sort
+    (be/src/compute_env/sorting/merge_path.h): splitters replace the
+    merge-path diagonal search; the all_to_all replaces streamed merges.
+
+    Returns (chunk_out, max_bucket_count) — same overflow contract as
+    shuffle_chunk (host checks max_bucket_count <= bucket_capacity).
+    """
+    live = chunk.sel_mask()
+    if jnp.issubdtype(rank.dtype, jnp.floating):
+        big = jnp.asarray(jnp.inf, rank.dtype)
+    else:
+        big = jnp.asarray(jnp.iinfo(rank.dtype).max, rank.dtype)
+    r = jnp.where(live, rank, big)
+
+    # evenly spaced live quantiles of the locally sorted ranks; every shard
+    # gathers every shard's sample, so all shards derive IDENTICAL splitters
+    srt = jnp.sort(r)
+    n_live = jnp.sum(live)
+    idx = (jnp.arange(sample_per_shard) * jnp.maximum(n_live, 1)) // sample_per_shard
+    sample = srt[jnp.clip(idx, 0, chunk.capacity - 1)]
+    # empty shards contribute `big` samples (srt is all-big), skewing
+    # splitters upward — a balance issue only, never a correctness one
+    all_samples = lax.all_gather(sample, axis, axis=0, tiled=True)
+    ss = jnp.sort(all_samples)
+    total = n_shards * sample_per_shard
+    splitters = ss[(jnp.arange(1, n_shards) * total) // n_shards]
+
+    bucket = jnp.asarray(jnp.searchsorted(splitters, r, side="left"), jnp.int32)
+    bucket = jnp.where(live, bucket, n_shards)
+    return _exchange_by_bucket(chunk, bucket, axis, n_shards, bucket_capacity)

@@ -11,15 +11,21 @@ distributed plan into a single jitted shard_map over the ICI mesh:
 - join strategies: probe-sharded x build-replicated = local broadcast join
   (no collective); sharded x sharded = hash-shuffle both sides
   (lax.all_to_all) then local join — HASH_PARTITIONED exchange;
-- aggregation over sharded input = local PARTIAL -> all_gather ->
-  replicated FINAL (two-phase agg; low-cardinality benchmark group-bys make
-  gather the right default, SHUFFLE final is available via dist_ops);
-- sort/limit/window require whole-table view: inputs gather to replicated
-  first; every shard then computes the identical result (out_spec P()).
+- aggregation over sharded input: colocate COMPLETE when the input is
+  hash-placed on a subset of the group keys; else two-phase — local PARTIAL,
+  then all_gather+FINAL (low-cardinality) or an all_to_all SHUFFLE of the
+  partial states with per-shard FINAL (high-cardinality, by NDV estimate);
+- ORDER BY+LIMIT = per-shard TopN, compact, gather top-k only; full ORDER BY
+  = range exchange by sampled splitters + local sort (shards end globally
+  ordered); PARTITION BY windows shuffle by partition key and run locally;
+  unpartitioned windows and bare LIMIT still gather to replicated.
 
-Every node returns (chunk, mode) with mode in {SHARDED, REPLICATED}; checks
-carry per-shard true counts as [1]-arrays (out_spec P('d')) so the host
-overflow-recompile loop sees the max across shards.
+Every node returns (chunk, mode) with mode one of REPLICATED, SHARDED,
+RANGE_SHARDED (sharded + globally ordered across the axis), or
+("hash", col) (sharded by the standard splitmix64 recipe on col — the
+colocate-placement token). Checks carry per-shard true counts as [1]-arrays
+(out_spec P('d')) so the host overflow-recompile loop sees the max across
+shards.
 """
 
 from __future__ import annotations
@@ -35,8 +41,12 @@ from ..ops import (
     limit_chunk, project, sort_chunk,
 )
 from ..ops.aggregate import FINAL, PARTIAL, final_agg_exprs
+from ..ops.common import compact, eval_keys
+from ..ops.sort import _descending
 from ..ops.window import window_op
-from ..parallel.exchange import all_gather_chunk, shuffle_chunk
+from ..parallel.exchange import (
+    all_gather_chunk, range_partition_chunk, shuffle_chunk,
+)
 from ..parallel.mesh import DATA_AXIS
 from .analyzer import _conjuncts
 from .logical import (
@@ -48,9 +58,79 @@ from .physical import Caps, PlanError, _equi_pair, _key_bit_width, unique_sets
 
 SHARDED = "sharded"
 REPLICATED = "replicated"
+# sharded AND globally ordered across the device axis (range exchange +
+# local sort): a tiled all_gather concatenates shards into sorted order
+RANGE_SHARDED = "range_sharded"
 
 # tables smaller than this are replicated rather than sharded
 SHARD_THRESHOLD_ROWS = 100_000
+# estimated group count above which two-phase aggregation shuffles partial
+# states by group key (each shard finalizes its own key range) instead of
+# all_gathering them (every shard redundantly finalizes all groups) —
+# the reference's HASH_PARTITIONED vs GATHER enforcer choice
+# (fe sql/optimizer/ChildOutputPropertyGuarantor.java)
+SHUFFLE_AGG_MIN_GROUPS = 32_768
+
+
+def _default_bucket_cap(capacity: int, n_shards: int) -> int:
+    """Default per-destination exchange bucket capacity: even split of the
+    input capacity with ~2x skew headroom (n//2 destinations' worth)."""
+    return pad_capacity(capacity // max(n_shards // 2, 1))
+
+
+def estimated_group_ndv(p: LAggregate, catalog):
+    """Upper bound on GROUP BY cardinality from ingest column stats: the
+    product over group keys of (max-min+1). None when any key is a non-Col
+    expression or lacks integer stats (then the planner stays BROADCAST)."""
+    if not p.group_by:
+        return 0
+    from .physical import col_origin
+
+    total = 1
+    for _, e in p.group_by:
+        if not isinstance(e, Col):
+            return None
+        origin = col_origin(p.child, e.name)
+        if origin is None:
+            return None
+        t = catalog.get_table(origin[0])
+        if t is None:
+            return None
+        st = t.column_stats(origin[1])
+        if st.min is None or st.max is None:
+            return None
+        total *= int(st.max) - int(st.min) + 1
+        if total > (1 << 40):
+            return total
+    return total
+
+
+def _single_sort_rank(chunk, sort_keys):
+    """One totally-ordered per-row array encoding a single-key ORDER BY
+    (asc/desc + NULLS FIRST/LAST), for the range-partition exchange; None
+    when the sort is multi-key (ties at a splitter boundary could split a
+    secondary-order run across shards) or the key dtype is unsupported.
+    Caveat: NULLs share a rank with the dtype's extreme value, so a real
+    INT64_MIN/MAX (or +/-inf) key can interleave with NULLs at a shard
+    boundary — same class of caveat as _descending's INT_MIN note."""
+    if len(sort_keys) != 1:
+        return None
+    expr, asc, nulls_first = sort_keys[0]
+    (k,) = eval_keys(chunk, (expr,))
+    d = k.data
+    if d.dtype == jnp.bool_:
+        d = jnp.asarray(d, jnp.int8)
+    if jnp.issubdtype(d.dtype, jnp.unsignedinteger):
+        return None
+    rank = d if asc else _descending(d)
+    if k.valid is not None:
+        if jnp.issubdtype(rank.dtype, jnp.floating):
+            sentinel = -jnp.inf if nulls_first else jnp.inf
+        else:
+            info = jnp.iinfo(rank.dtype)
+            sentinel = info.min if nulls_first else info.max
+        rank = jnp.where(k.valid, rank, jnp.asarray(sentinel, rank.dtype))
+    return rank
 
 
 class DistCompiled:
@@ -165,12 +245,9 @@ def compile_distributed(
                     m,
                 )
             if isinstance(p, LWindow):
-                c, m = emit(p.child)
-                c = gather(c, m)
-                return window_op(c, p.partition_by, p.order_by, p.funcs), REPLICATED
+                return emit_window(p)
             if isinstance(p, LSort):
-                c, m = emit(p.child)
-                return sort_chunk(gather(c, m), p.keys, p.limit), REPLICATED
+                return emit_sort(p)
             if isinstance(p, LLimit):
                 c, m = emit(p.child)
                 return limit_chunk(gather(c, m), p.limit, p.offset), REPLICATED
@@ -189,18 +266,121 @@ def compile_distributed(
                 return emit_join(p)
             raise PlanError(f"cannot compile {type(p).__name__} distributed")
 
+        def emit_window(p: LWindow):
+            """PARTITION BY windows are independent per partition, so a
+            sharded input shuffles by partition key and each shard computes
+            its own partitions locally — no whole-table gather. Unpartitioned
+            windows (global ranks/running totals) still need the gather."""
+            c, m = emit(p.child)
+            if not p.partition_by or not _is_dist(m):
+                c = gather(c, m)
+                return window_op(c, p.partition_by, p.order_by, p.funcs), REPLICATED
+            hc = _hash_col(m)
+            # hash column among the partition keys => every partition is
+            # wholly on one shard already (subset colocation rule)
+            aligned = hc is not None and any(
+                isinstance(e, Col) and e.name == hc for e in p.partition_by
+            )
+            out_mode = m if aligned else SHARDED
+            if not aligned:
+                key = f"win_{ordinal(p)}"
+                bcap = caps.get(key, _default_bucket_cap(c.capacity, n_shards))
+                c, mxb = shuffle_chunk(
+                    c, tuple(p.partition_by), axis, n_shards, bcap
+                )
+                checks[key] = mxb[None]
+                if len(p.partition_by) == 1 and isinstance(p.partition_by[0], Col):
+                    out_mode = ("hash", p.partition_by[0].name)
+            return window_op(c, p.partition_by, p.order_by, p.funcs), out_mode
+
+        def emit_sort(p: LSort):
+            c, m = emit(p.child)
+            if not _is_dist(m):
+                return sort_chunk(c, p.keys, p.limit), REPLICATED
+            if p.limit is not None:
+                # distributed TopN: per-shard TopN, compact to ~limit rows,
+                # gather only those, final TopN (chunks_sorter_topn.h analog)
+                local = sort_chunk(c, p.keys, p.limit)
+                kcap = pad_capacity(p.limit)
+                if kcap < c.capacity:
+                    local, _ = compact(local, kcap)  # live<=limit: no overflow
+                gathered = all_gather_chunk(local, axis)
+                return sort_chunk(gathered, p.keys, p.limit), REPLICATED
+            rank = _single_sort_rank(c, p.keys)
+            if rank is None:
+                return sort_chunk(gather(c, m), p.keys, None), REPLICATED
+            # full distributed sort: range exchange by sampled splitters,
+            # then local sort — shards end range-ordered, so the final
+            # tiled all_gather concatenates into global order
+            key = f"sort_{ordinal(p)}"
+            bcap = caps.get(key, _default_bucket_cap(c.capacity, n_shards))
+            part, mxb = range_partition_chunk(c, rank, axis, n_shards, bcap)
+            checks[key] = mxb[None]
+            return sort_chunk(part, p.keys, None), RANGE_SHARDED
+
         def emit_agg(p: LAggregate):
             c, m = emit(p.child)
             key = f"agg_{ordinal(p)}"
-            cap = caps.get(key, 1024)
             if m == REPLICATED:
-                out, ng = hash_aggregate(c, p.group_by, p.aggs, cap)
+                out, ng = hash_aggregate(c, p.group_by, p.aggs,
+                                         caps.get(key, 1024))
                 checks[key] = ng[None]
                 return out, REPLICATED
+            final_group_by = tuple((n, Col(n)) for n, _ in p.group_by)
+            est = estimated_group_ndv(p, catalog)
+            hc = _hash_col(m)
+            hash_out = next(
+                (n for n, e in p.group_by
+                 if isinstance(e, Col) and e.name == hc),
+                None,
+            ) if hc is not None else None
+            if hash_out is not None:
+                # input hash-placed on a SUBSET of the group keys: every
+                # group lives entirely on one shard, so a single COMPLETE
+                # local agg is exact with zero collectives (colocate agg).
+                # Seed capacity from the NDV estimate (per-shard share, 2x
+                # skew headroom) so typical runs compile once.
+                default = 1024 if est is None else pad_capacity(
+                    int(min(est * 2 // n_shards + 1024, c.capacity))
+                )
+                out, ng = hash_aggregate(c, p.group_by, p.aggs,
+                                         caps.get(key, default))
+                checks[key] = ng[None]
+                return out, ("hash", hash_out)
+            if est is not None and est > SHUFFLE_AGG_MIN_GROUPS:
+                # high cardinality: shuffle partial states by group key so
+                # each shard finalizes only its own key range (SHUFFLE-final).
+                # Seed the partial capacity from the estimate (bounded by the
+                # input capacity) — the 1024 default would always overflow
+                cap = caps.get(key, pad_capacity(int(min(est, c.capacity))))
+                part, png = hash_aggregate(
+                    c, p.group_by, p.aggs, cap, mode=PARTIAL
+                )
+                checks[key] = png[None]
+                bkey = f"aggbkt_{ordinal(p)}"
+                bcap = caps.get(
+                    bkey, pad_capacity(max(cap // max(n_shards // 2, 1), 16))
+                )
+                key_cols = tuple(Col(n) for n, _ in p.group_by)
+                merged, mxb = shuffle_chunk(part, key_cols, axis, n_shards, bcap)
+                checks[bkey] = mxb[None]
+                # final capacity = received capacity: group count there is
+                # bounded by received rows, so the final phase cannot overflow
+                out, _ng = hash_aggregate(
+                    merged, final_group_by, final_agg_exprs(p.aggs),
+                    n_shards * bcap, mode=FINAL,
+                )
+                # output is hash-placed on the (single) group column's
+                # values with the standard shuffle recipe -> colocate-able
+                out_mode = (
+                    ("hash", p.group_by[0][0]) if len(p.group_by) == 1
+                    else SHARDED
+                )
+                return out, out_mode
             # two-phase: local partial -> all_gather -> final
+            cap = caps.get(key, 1024)
             part, png = hash_aggregate(c, p.group_by, p.aggs, cap, mode=PARTIAL)
             merged = all_gather_chunk(part, axis)
-            final_group_by = tuple((n, Col(n)) for n, _ in p.group_by)
             out, ng = hash_aggregate(
                 merged, final_group_by, final_agg_exprs(p.aggs), cap, mode=FINAL
             )
@@ -211,6 +391,10 @@ def compile_distributed(
         def emit_join(p: LJoin):
             lc, lm = emit(p.left)
             rc, rm = emit(p.right)
+            # joins reorder rows: a range-ordered input degrades to plain
+            # sharded (placement survives, global ordering does not)
+            lm = SHARDED if lm == RANGE_SHARDED else lm
+            rm = SHARDED if rm == RANGE_SHARDED else rm
             lcols = frozenset(p.left.output_names())
             rcols = frozenset(p.right.output_names())
 
@@ -277,22 +461,25 @@ def compile_distributed(
                 )
 
             # --- distribution strategy ---
-            def aligned(mode, keys):
+            def align_pos(mode, keys):
+                """Index of the equi-key pair this side is hash-placed on
+                (subset colocation: matching rows agree on ALL equi keys, so
+                placement by any ONE equated column keeps them together)."""
                 hc = _hash_col(mode)
-                return (
-                    hc is not None and len(keys) == 1
-                    and isinstance(keys[0], Col) and keys[0].name == hc
-                )
+                if hc is None:
+                    return None
+                for i, k in enumerate(keys):
+                    if isinstance(k, Col) and k.name == hc:
+                        return i
+                return None
 
             if _is_dist(lm) and _is_dist(rm):
-                la = aligned(lm, probe_keys)
-                ra = aligned(rm, build_keys)
-                # colocate: sides already hash-placed on their join keys with
-                # the same bucketing — no exchange at all
+                li = align_pos(lm, probe_keys)
+                ri = align_pos(rm, build_keys)
+
                 def shuffle_side(chunk, keys_, key_name):
                     cap_k = caps.get(
-                        key_name,
-                        pad_capacity(chunk.capacity // max(n_shards // 2, 1)),
+                        key_name, _default_bucket_cap(chunk.capacity, n_shards)
                     )
                     out, mx = shuffle_chunk(
                         chunk, tuple(keys_), axis, n_shards, cap_k, bit_widths
@@ -300,13 +487,24 @@ def compile_distributed(
                     checks[key_name] = mx[None]
                     return out
 
-                # each unaligned side shuffles into hash alignment
-                if not la:
+                # colocate when both sides sit on the same equated pair; a
+                # single aligned side pulls the other to ITS placement
+                # (shuffle by just the equated column); else shuffle both
+                # sides by the full key tuple
+                if li is not None and ri == li:
+                    anchor = li
+                elif li is not None:
+                    rc = shuffle_side(rc, [build_keys[li]], f"shufR_{ordinal(p)}")
+                    anchor = li
+                elif ri is not None:
+                    lc = shuffle_side(lc, [probe_keys[ri]], f"shufL_{ordinal(p)}")
+                    anchor = ri
+                else:
                     lc = shuffle_side(lc, probe_keys, f"shufL_{ordinal(p)}")
-                if not ra:
                     rc = shuffle_side(rc, build_keys, f"shufR_{ordinal(p)}")
-                if len(probe_keys) == 1 and isinstance(probe_keys[0], Col):
-                    out_mode = ("hash", probe_keys[0].name)
+                    anchor = 0 if len(probe_keys) == 1 else None
+                if anchor is not None and isinstance(probe_keys[anchor], Col):
+                    out_mode = ("hash", probe_keys[anchor].name)
                 else:
                     out_mode = SHARDED
             elif _is_dist(rm):  # probe replicated, build sharded -> gather build

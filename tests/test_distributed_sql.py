@@ -16,10 +16,13 @@ from ssb_queries import FLAT_QUERIES
 @pytest.fixture(scope="module")
 def sessions(eight_devices):
     old = D.SHARD_THRESHOLD_ROWS
+    old_sh = D.SHUFFLE_AGG_MIN_GROUPS
     D.SHARD_THRESHOLD_ROWS = 10_000  # SF0.01: lineitem+orders(≥15k) shard
+    D.SHUFFLE_AGG_MIN_GROUPS = 4_000  # SF0.01 orderkeys (15k) take SHUFFLE
     cat = tpch_catalog(sf=0.01)
     yield Session(cat), Session(cat, dist_shards=8)
     D.SHARD_THRESHOLD_ROWS = old
+    D.SHUFFLE_AGG_MIN_GROUPS = old_sh
 
 
 def _same(r1, r8, qid):
@@ -63,18 +66,18 @@ def test_ssb_distributed(eight_devices):
 
 def test_distributed_adaptive_recompile(sessions):
     s1, s8 = sessions
-    # high-cardinality group-by forces group-capacity overflow + recompile
-    q = """select l_orderkey, sum(l_quantity) q from lineitem
-           group by l_orderkey order by q desc limit 5"""
+    # high-cardinality group-by on an EXPRESSION (no NDV stats -> the planner
+    # can't seed capacity) forces group-capacity overflow + recompile
+    q = """select l_orderkey % 3000 k, sum(l_quantity) q from lineitem
+           group by l_orderkey % 3000 order by q desc, k limit 5"""
     r1, r8 = s1.sql(q).rows(), s8.sql(q).rows()
     assert [r[1] for r in r1] == [r[1] for r in r8]
     prof = s8.last_profile
     assert prof.find("attempt_1") is not None  # at least one recompile happened
 
 
-def test_colocate_join_no_shuffle(eight_devices):
-    """lineitem/orders share hash distribution on orderkey -> the join
-    compiles with ZERO all-to-all collectives (colocate join)."""
+def _lowered_hlo(s8, cat, q, return_modes=False):
+    """Compile a query through the distributed planner and return HLO text."""
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -85,6 +88,28 @@ def test_colocate_join_no_shuffle(eight_devices):
     from starrocks_tpu.sql.parser import parse
     from starrocks_tpu.sql.physical import Caps
 
+    plan = optimize(Analyzer(cat).analyze(parse(q)), cat)
+    ex = s8._dist_executor
+    comp = compile_distributed(plan, cat, Caps({}), 8)
+    meta = tuple(zip(comp.scans, comp.scan_modes))
+    inputs = ex._place(meta)
+    in_specs = tuple(
+        jax.tree_util.tree_map(
+            lambda _, mm=m: P() if mm == "replicated" else P("d"), c
+        )
+        for c, (_, m) in zip(inputs, meta)
+    )
+    low = jax.jit(shard_map(
+        comp.fn, mesh=ex.mesh, in_specs=(in_specs,),
+        out_specs=(P(), P("d")), check_vma=False,
+    )).lower(inputs)
+    txt = low.as_text()
+    return (txt, comp.scan_modes) if return_modes else txt
+
+
+def test_colocate_join_no_shuffle(eight_devices):
+    """lineitem/orders share hash distribution on orderkey -> the join
+    compiles with ZERO all-to-all collectives (colocate join)."""
     old = D.SHARD_THRESHOLD_ROWS
     D.SHARD_THRESHOLD_ROWS = 10_000
     try:
@@ -95,27 +120,119 @@ def test_colocate_join_no_shuffle(eight_devices):
                group by o_orderpriority order by 1"""
         assert s1.sql(q).rows() == s8.sql(q).rows()
 
-        plan = optimize(Analyzer(cat).analyze(parse(q)), cat)
-        ex = s8._dist_executor
-        comp = compile_distributed(plan, cat, Caps({}), 8)
-        meta = tuple(zip(comp.scans, comp.scan_modes))
-        inputs = ex._place(meta)
-        in_specs = tuple(
-            jax.tree_util.tree_map(
-                lambda _, mm=m: P() if mm == "replicated" else P("d"), c
-            )
-            for c, (_, m) in zip(inputs, meta)
-        )
-        low = jax.jit(shard_map(
-            comp.fn, mesh=ex.mesh, in_specs=(in_specs,),
-            out_specs=(P(), P("d")), check_vma=False,
-        )).lower(inputs)
-        assert low.as_text().count("all-to-all") == 0
+        txt, scan_modes = _lowered_hlo(s8, cat, q, return_modes=True)
+        assert txt.count("all_to_all") + txt.count("all-to-all") == 0
         # at least one scan went through hash placement
-        assert any(isinstance(m, tuple) and m[0] == "hash"
-                   for m in comp.scan_modes)
+        assert any(isinstance(m, tuple) and m[0] == "hash" for m in scan_modes)
     finally:
         D.SHARD_THRESHOLD_ROWS = old
+
+
+def test_shuffle_final_agg(sessions):
+    """High-cardinality GROUP BY on an UNALIGNED key routes partial states
+    through the HASH_PARTITIONED exchange (all_to_all in the HLO) instead of
+    all_gathering them, and still matches single-chip results."""
+    s1, s8 = sessions
+    old = D.SHUFFLE_AGG_MIN_GROUPS
+    D.SHUFFLE_AGG_MIN_GROUPS = 1_000  # SF0.01 partkeys (2000) take SHUFFLE
+    try:
+        q = ("select l_partkey, sum(l_quantity) q, count(*) c "
+             "from lineitem group by l_partkey")
+        _same(s1.sql(q).rows(), s8.sql(q).rows(), "shuffle-agg")
+        hlo = _lowered_hlo(s8, s1.catalog, q)
+        assert hlo.count("all_to_all") + hlo.count("all-to-all") >= 1
+    finally:
+        D.SHUFFLE_AGG_MIN_GROUPS = old
+
+
+def test_colocate_aggregation_no_exchange(sessions):
+    """GROUP BY on the table's hash-distribution key aggregates fully
+    shard-local: zero all_to_all AND zero partial/final split needed."""
+    s1, s8 = sessions
+    q = "select l_orderkey, sum(l_quantity) q, count(*) c from lineitem group by l_orderkey"
+    _same(s1.sql(q).rows(), s8.sql(q).rows(), "colocate-agg")
+    hlo = _lowered_hlo(s8, s1.catalog, q)
+    assert hlo.count("all_to_all") + hlo.count("all-to-all") == 0
+
+
+def test_distributed_full_sort_global_order(sessions):
+    """Full ORDER BY over a sharded table: range exchange + local sort must
+    produce EXACT global order (not just the right multiset)."""
+    s1, s8 = sessions
+    q = "select l_extendedprice from lineitem order by l_extendedprice desc"
+    r1, r8 = s1.sql(q).rows(), s8.sql(q).rows()
+    assert [r[0] for r in r1] == [r[0] for r in r8]
+    hlo = _lowered_hlo(s8, s1.catalog, q)
+    assert hlo.count("all_to_all") + hlo.count("all-to-all") >= 1  # the range exchange
+
+    # asc path over a date key, exact order again
+    q2 = "select l_shipdate from lineitem order by l_shipdate"
+    assert s1.sql(q2).rows() == s8.sql(q2).rows()
+
+
+def test_distributed_sort_nulls_and_dict_keys(eight_devices):
+    """Exact global order through the range exchange for the NULL-sentinel
+    branch (nullable int key, NULLS FIRST/LAST) and dict-encoded varchar
+    keys — the branches of _single_sort_rank the TPC-H columns never hit."""
+    import numpy as np
+
+    old = D.SHARD_THRESHOLD_ROWS
+    D.SHARD_THRESHOLD_ROWS = 300
+    try:
+        rng = np.random.default_rng(42)
+        n = 4000
+        s = Session()
+        s.sql("create table tnull (v int, g varchar)")
+        words = ["amber", "brick", "coral", "dune", "ember", "frost"]
+        rows = []
+        for i in range(n):
+            v = "null" if rng.random() < 0.1 else str(int(rng.integers(-500, 500)))
+            g = f"'{words[int(rng.integers(0, len(words)))]}'"
+            rows.append(f"({v}, {g})")
+        s.sql("insert into tnull values " + ", ".join(rows))
+        s8 = Session(s.catalog, dist_shards=8)
+        for q in [
+            "select v from tnull order by v",                    # nulls last (asc default)
+            "select v from tnull order by v desc",               # nulls first
+            "select v from tnull order by v asc nulls first",
+            "select v from tnull order by v desc nulls last",
+            "select g from tnull order by g",                    # dict codes
+            "select g from tnull order by g desc",
+        ]:
+            assert s.sql(q).rows() == s8.sql(q).rows(), q
+    finally:
+        D.SHARD_THRESHOLD_ROWS = old
+
+
+def test_distributed_topn_gathers_topk_only(sessions):
+    """ORDER BY..LIMIT: per-shard TopN + compact means the gather moves only
+    ~limit rows per shard, and the exact rows match single-chip."""
+    import re
+
+    s1, s8 = sessions
+    q = """select l_orderkey, l_linenumber, l_extendedprice from lineitem
+           order by l_extendedprice desc, l_orderkey, l_linenumber limit 37"""
+    assert s1.sql(q).rows() == s8.sql(q).rows()
+    # pin the optimization, not just the result: every all_gather operand
+    # must be the compacted pad_capacity(37)=1024 buffer, never the full
+    # per-shard scan capacity
+    hlo = _lowered_hlo(s8, s1.catalog, q)
+    dims = [int(m) for m in re.findall(r"all_gather\"?[^\n]*?tensor<(\d+)x", hlo)]
+    assert dims, "expected all_gather ops in the TopN plan"
+    assert max(dims) <= 1024, f"TopN gather moved full buffers: {dims}"
+
+
+def test_distributed_window_partition_shuffle(sessions):
+    """PARTITION BY windows run shard-local after a partition-key shuffle —
+    results must match the single-chip gather-everything plan."""
+    s1, s8 = sessions
+    q = """select l_orderkey, l_linenumber,
+                  sum(l_quantity) over (partition by l_orderkey
+                                        order by l_linenumber) rq,
+                  row_number() over (partition by l_orderkey
+                                     order by l_extendedprice desc) rn
+           from lineitem where l_orderkey < 1000"""
+    _same(s1.sql(q).rows(), s8.sql(q).rows(), "window-shuffle")
 
 
 def test_distributed_fuzz(eight_devices):
