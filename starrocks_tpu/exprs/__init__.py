@@ -1,6 +1,7 @@
 """Vectorized expression engine (reference: be/src/exprs/, SURVEY §2.1)."""
 
 from .compile import EVal, ExprCompiler, eval_expr, eval_predicate, like_to_regex
+from . import functions_ext  # noqa: F401  (registers the breadth-wave builtins)
 from .ir import (
     AggExpr,
     Call,

@@ -108,14 +108,18 @@ class WindowExpr(Expr):
 class AggExpr(Expr):
     """Aggregate function reference used in aggregation specs."""
 
-    fn: str  # sum | count | avg | min | max | count_star | count_distinct
+    fn: str  # sum | count | avg | min | max | stddev... (see aggregate.py)
     arg: Optional[Expr]  # None for count(*)
     distinct: bool = False
+    # additional arguments: the percentile fraction (Lit) for the percentile
+    # family, the second value column (Expr) for covar/corr
+    extra: tuple = ()
 
     def __repr__(self):
         a = "*" if self.arg is None else repr(self.arg)
         d = "DISTINCT " if self.distinct else ""
-        return f"{self.fn}({d}{a})"
+        x = "".join(f", {r!r}" for r in self.extra)
+        return f"{self.fn}({d}{a}{x})"
 
 
 # --- sugar builders ---------------------------------------------------------
@@ -181,6 +185,9 @@ def walk(e: Expr):
     elif isinstance(e, AggExpr):
         if e.arg is not None:
             yield from walk(e.arg)
+        for x in e.extra:
+            if isinstance(x, Expr):
+                yield from walk(x)
     elif isinstance(e, WindowExpr):
         if e.arg is not None:
             yield from walk(e.arg)

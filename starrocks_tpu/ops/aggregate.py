@@ -29,6 +29,23 @@ from ..column.column import Chunk, Field, Schema
 from ..exprs.compile import EVal, ExprCompiler
 from ..exprs.ir import AggExpr, Col, Expr
 from .common import boundaries, eval_keys, key_sort_arrays
+from .segment import (
+    _group_bounds_sorted, seg_count, seg_first_index, seg_max, seg_min,
+    seg_sum,
+)
+
+
+def _as_f64(a: EVal):
+    """Arg data as float64 (decimals unscale)."""
+    d = jnp.asarray(a.data)
+    if a.type.is_decimal:
+        return jnp.asarray(d, jnp.float64) / (10 ** a.type.scale)
+    return jnp.asarray(d, jnp.float64)
+
+
+def _read_state(cc, col_name, live_rows, reorder):
+    st = cc.eval(Col(col_name))
+    return jnp.where(live_rows, reorder(jnp.asarray(st.data)), 0)
 
 COMPLETE = "complete"
 PARTIAL = "partial"
@@ -54,6 +71,21 @@ def _minmax_identity(t: T.LogicalType, is_min: bool):
     return info.max if is_min else info.min
 
 
+# moment-sketch families: PARTIAL state = running sums of powers/products
+# (the decomposable form of the reference's AggregateFunction state objects,
+# be/src/exprs/agg/variance.h-style)
+_VAR_FNS = {"var_pop", "var_samp", "stddev_pop", "stddev_samp"}
+_COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
+# need the full value multiset -> cannot be split into partial/final
+_HOLISTIC_FNS = {"percentile_cont", "percentile_disc"}
+
+
+def decomposable(aggs: tuple) -> bool:
+    """True when every aggregate supports the PARTIAL/FINAL two-phase split
+    (drives the distributed planner's exchange strategy choice)."""
+    return all(a.fn not in _HOLISTIC_FNS for _, a in aggs)
+
+
 def _state_fields(name: str, agg: AggExpr, arg_t: Optional[T.LogicalType]):
     """State columns a PARTIAL aggregation emits for `agg` (name -> type)."""
     if agg.fn == "count" or agg.fn == "count_star":
@@ -64,6 +96,13 @@ def _state_fields(name: str, agg: AggExpr, arg_t: Optional[T.LogicalType]):
         return [(f"{name}", arg_t)]
     if agg.fn == "avg":
         return [(f"{name}__sum", _sum_out_type(arg_t)), (f"{name}__cnt", T.BIGINT)]
+    if agg.fn in _VAR_FNS:
+        return [(f"{name}__sum", T.DOUBLE), (f"{name}__ssq", T.DOUBLE),
+                (f"{name}__cnt", T.BIGINT)]
+    if agg.fn in _COVAR_FNS:
+        return [(f"{name}__sx", T.DOUBLE), (f"{name}__sy", T.DOUBLE),
+                (f"{name}__sxy", T.DOUBLE), (f"{name}__sxx", T.DOUBLE),
+                (f"{name}__syy", T.DOUBLE), (f"{name}__cnt", T.BIGINT)]
     raise NotImplementedError(f"aggregate {agg.fn}")
 
 
@@ -133,11 +172,9 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
     permutes rows into group order) and the low-cardinality packed-gid path
     (reorder is identity). live_rows is the row-liveness mask AFTER reorder."""
 
-    def seg_sum(vals):
-        return jax.ops.segment_sum(
-            vals, gid, num_segments=num_groups,
-            indices_are_sorted=indices_sorted,
-        )
+    def _seg_sum(vals, nbits=64):
+        return seg_sum(vals, gid, num_groups, sorted_gid=indices_sorted,
+                       nbits=nbits)
 
     out_fields, out_data, out_valid = [], [], []
     for name, agg in aggs:
@@ -145,9 +182,9 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
             if mode == FINAL:
                 st = cc.eval(Col(name))
                 v = jnp.where(live_rows, reorder(jnp.asarray(st.data, jnp.int64)), 0)
-                cnt = seg_sum(v)
+                cnt = _seg_sum(v)
             else:
-                cnt = seg_sum(jnp.asarray(live_rows, jnp.int64))
+                cnt = _seg_sum(live_rows, nbits=1)
             out_fields.append(Field(name, T.BIGINT, False))
             out_data.append(cnt)
             out_valid.append(None)
@@ -169,8 +206,8 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
                 )
                 vals = jnp.where(m, d, 0)
                 cnts = jnp.asarray(m, jnp.int64)
-            gsum = seg_sum(vals)
-            gcnt = seg_sum(cnts)
+            gsum = _seg_sum(vals)
+            gcnt = _seg_sum(cnts, nbits=1 if mode != FINAL else 64)
             if mode == PARTIAL:
                 out_fields.append(Field(f"{name}__sum", sum_t, False))
                 out_data.append(gsum)
@@ -189,6 +226,135 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
                 out_valid.append(gcnt > 0)
             continue
 
+        if agg.fn in _VAR_FNS:
+            if mode == FINAL:
+                s1 = _read_state(cc, f"{name}__sum", live_rows, reorder)
+                s2 = _read_state(cc, f"{name}__ssq", live_rows, reorder)
+                cnts = _read_state(cc, f"{name}__cnt", live_rows, reorder)
+            else:
+                a = cc.eval(agg.arg)
+                d = reorder(jnp.broadcast_to(_as_f64(a), (cap,)))
+                m = live_rows if a.valid is None else (
+                    live_rows & reorder(jnp.broadcast_to(a.valid, (cap,)))
+                )
+                s1 = jnp.where(m, d, 0.0)
+                s2 = jnp.where(m, d * d, 0.0)
+                cnts = jnp.asarray(m, jnp.int64)
+            gs1 = _seg_sum(s1)
+            gs2 = _seg_sum(s2)
+            gn = _seg_sum(cnts, nbits=1 if mode != FINAL else 64)
+            if mode == PARTIAL:
+                out_fields += [Field(f"{name}__sum", T.DOUBLE, False),
+                               Field(f"{name}__ssq", T.DOUBLE, False),
+                               Field(f"{name}__cnt", T.BIGINT, False)]
+                out_data += [gs1, gs2, gn]
+                out_valid += [None, None, None]
+            else:
+                samp = agg.fn.endswith("_samp")
+                denom = jnp.maximum(gn - (1 if samp else 0), 1)
+                var = jnp.maximum(
+                    (gs2 - gs1 * gs1 / jnp.maximum(gn, 1)) / denom, 0.0)
+                res = jnp.sqrt(var) if agg.fn.startswith("stddev") else var
+                out_fields.append(Field(name, T.DOUBLE, True))
+                out_data.append(res)
+                out_valid.append(gn > (1 if samp else 0))
+            continue
+
+        if agg.fn in _COVAR_FNS:
+            if mode == FINAL:
+                sx = _read_state(cc, f"{name}__sx", live_rows, reorder)
+                sy = _read_state(cc, f"{name}__sy", live_rows, reorder)
+                sxy = _read_state(cc, f"{name}__sxy", live_rows, reorder)
+                sxx = _read_state(cc, f"{name}__sxx", live_rows, reorder)
+                syy = _read_state(cc, f"{name}__syy", live_rows, reorder)
+                cnts = _read_state(cc, f"{name}__cnt", live_rows, reorder)
+            else:
+                ax = cc.eval(agg.arg)
+                ay = cc.eval(agg.extra[0])
+                dx = reorder(jnp.broadcast_to(_as_f64(ax), (cap,)))
+                dy = reorder(jnp.broadcast_to(_as_f64(ay), (cap,)))
+                m = live_rows
+                for v in (ax.valid, ay.valid):
+                    if v is not None:
+                        m = m & reorder(jnp.broadcast_to(v, (cap,)))
+                sx = jnp.where(m, dx, 0.0)
+                sy = jnp.where(m, dy, 0.0)
+                sxy = jnp.where(m, dx * dy, 0.0)
+                sxx = jnp.where(m, dx * dx, 0.0)
+                syy = jnp.where(m, dy * dy, 0.0)
+                cnts = jnp.asarray(m, jnp.int64)
+            gx, gy, gxy = _seg_sum(sx), _seg_sum(sy), _seg_sum(sxy)
+            gxx, gyy = _seg_sum(sxx), _seg_sum(syy)
+            gn = _seg_sum(cnts, nbits=1 if mode != FINAL else 64)
+            if mode == PARTIAL:
+                for suffix, dat in [("sx", gx), ("sy", gy), ("sxy", gxy),
+                                    ("sxx", gxx), ("syy", gyy)]:
+                    out_fields.append(Field(f"{name}__{suffix}", T.DOUBLE, False))
+                    out_data.append(dat)
+                    out_valid.append(None)
+                out_fields.append(Field(f"{name}__cnt", T.BIGINT, False))
+                out_data.append(gn)
+                out_valid.append(None)
+            else:
+                nf = jnp.maximum(gn, 1)
+                if agg.fn == "corr":
+                    num = gn * gxy - gx * gy
+                    den2 = (gn * gxx - gx * gx) * (gn * gyy - gy * gy)
+                    den = jnp.sqrt(jnp.maximum(den2, 0.0))
+                    res = num / jnp.where(den > 0, den, 1.0)
+                    ok = (gn > 0) & (den > 0)
+                else:
+                    cov = gxy - gx * gy / nf
+                    if agg.fn == "covar_samp":
+                        res = cov / jnp.maximum(gn - 1, 1)
+                        ok = gn > 1
+                    else:
+                        res = cov / nf
+                        ok = gn > 0
+                out_fields.append(Field(name, T.DOUBLE, True))
+                out_data.append(res)
+                out_valid.append(ok)
+            continue
+
+        if agg.fn in _HOLISTIC_FNS:
+            if mode != COMPLETE:
+                raise NotImplementedError(
+                    f"{agg.fn} cannot be split into partial/final")
+            a = cc.eval(agg.arg)
+            assert not a.type.is_string, f"{agg.fn} over strings"
+            frac = float(agg.extra[0].value)
+            d = reorder(jnp.broadcast_to(jnp.asarray(a.data), (cap,)))
+            m = live_rows if a.valid is None else (
+                live_rows & reorder(jnp.broadcast_to(a.valid, (cap,)))
+            )
+            gidm = jnp.where(m, jnp.asarray(gid, jnp.int32), num_groups)
+            order2 = jnp.lexsort((d, gidm))
+            g2 = gidm[order2]
+            v2 = d[order2]
+            left, right = _group_bounds_sorted(g2, num_groups)
+            cnt = right - left
+            ok = cnt > 0
+            if agg.fn == "percentile_cont":
+                vf = (jnp.asarray(v2, jnp.float64) / (10 ** a.type.scale)
+                      if a.type.is_decimal else jnp.asarray(v2, jnp.float64))
+                fpos = frac * jnp.asarray(cnt - 1, jnp.float64)
+                lo = jnp.clip(jnp.floor(fpos).astype(jnp.int64), 0, None)
+                hi = jnp.clip(jnp.ceil(fpos).astype(jnp.int64), 0, None)
+                t = fpos - lo
+                vlo = vf[jnp.clip(left + lo, 0, cap - 1)]
+                vhi = vf[jnp.clip(left + hi, 0, cap - 1)]
+                res = vlo * (1 - t) + vhi * t
+                out_fields.append(Field(name, T.DOUBLE, True))
+            else:  # percentile_disc: smallest value with cum_dist >= frac
+                k = jnp.clip(
+                    jnp.ceil(frac * jnp.asarray(cnt, jnp.float64)).astype(
+                        jnp.int64) - 1, 0, jnp.maximum(cnt - 1, 0))
+                res = v2[jnp.clip(left + k, 0, cap - 1)]
+                out_fields.append(Field(name, a.type, True, a.dict))
+            out_data.append(res)
+            out_valid.append(ok)
+            continue
+
         # sum / min / max / count(x)
         a = cc.eval(Col(name)) if mode == FINAL else cc.eval(agg.arg)
         m = live_rows if a.valid is None else (
@@ -198,17 +364,17 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
         if agg.fn == "count":
             if mode == FINAL:
                 vals = jnp.where(m, reorder(jnp.asarray(a.data, jnp.int64)), 0)
-                res = seg_sum(vals)
+                res = _seg_sum(vals)
             else:
-                res = seg_sum(jnp.asarray(m, jnp.int64))
+                res = _seg_sum(m, nbits=1)
             out_fields.append(Field(name, T.BIGINT, False))
             out_data.append(res)
             out_valid.append(None)
         elif agg.fn == "sum":
             out_t = a.type if mode == FINAL else _sum_out_type(a.type)
             d = reorder(jnp.broadcast_to(_to_rep(a, out_t), (cap,)))
-            res = seg_sum(jnp.where(m, d, 0))
-            nonempty = seg_sum(jnp.asarray(m, jnp.int64)) > 0
+            res = _seg_sum(jnp.where(m, d, 0))
+            nonempty = _seg_sum(m, nbits=1) > 0
             out_fields.append(Field(name, out_t, True))
             out_data.append(res)
             out_valid.append(nonempty)
@@ -217,10 +383,10 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
             ident = _minmax_identity(a.type, is_min)
             d = reorder(jnp.broadcast_to(jnp.asarray(a.data), (cap,)))
             dd = jnp.where(m, d, jnp.asarray(ident, a.type.dtype))
-            seg = jax.ops.segment_min if is_min else jax.ops.segment_max
-            res = seg(dd, gid, num_segments=num_groups,
-                      indices_are_sorted=indices_sorted)
-            nonempty = seg_sum(jnp.asarray(m, jnp.int64)) > 0
+            segfn = seg_min if is_min else seg_max
+            res = segfn(dd, gid, num_groups, identity=ident,
+                        sorted_gid=indices_sorted)
+            nonempty = _seg_sum(m, nbits=1) > 0
             out_fields.append(Field(name, a.type, True, a.dict))
             out_data.append(res)
             out_valid.append(nonempty)
@@ -271,11 +437,7 @@ def hash_aggregate(
     out_fields, out_data, out_valid = [], [], []
 
     # --- group key columns ---------------------------------------------------
-    pos = jnp.arange(cap)
-    first_pos = jax.ops.segment_min(
-        jnp.where(live_s, pos, cap), gid, num_segments=num_groups,
-        indices_are_sorted=True,
-    )
+    first_pos = seg_first_index(gid, num_groups, cap)
     safe_first = jnp.clip(first_pos, 0, cap - 1)
     for (kname, _), k in zip(group_by, keys):
         ks = k.data[order][safe_first]
@@ -324,6 +486,8 @@ def final_agg_exprs(aggs: tuple) -> tuple:
             out.append((name, AggExpr("max", Col(name))))
         elif agg.fn == "avg":
             out.append((name, AggExpr("avg", None)))
+        elif agg.fn in _VAR_FNS or agg.fn in _COVAR_FNS:
+            out.append((name, AggExpr(agg.fn, None)))
         else:
             raise NotImplementedError(agg.fn)
     return tuple(out)
@@ -342,9 +506,7 @@ def _aggregate_with_gid(chunk, cc, group_by, aggs, num_groups, mode,
         out_data.append(code)
         out_valid.append(kvalid)
 
-    group_count = jax.ops.segment_sum(
-        jnp.asarray(live, jnp.int64), gid, num_segments=num_groups
-    )
+    group_count = seg_count(live, gid, num_groups)
     agg_fields, agg_data, agg_valid = _emit_agg_columns(
         cc, aggs, mode, cap, live, lambda x: x, gid, num_groups,
         indices_sorted=False,

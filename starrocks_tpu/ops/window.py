@@ -69,8 +69,6 @@ def window_op(
     # peer groups: rows equal on partition+order keys
     peer_new = boundaries(pkeys + okeys, live, order) if okeys else part_new
 
-    seg = jnp.cumsum(part_new) - 1  # partition id per sorted row
-    seg = jnp.clip(seg, 0, cap - 1)
     part_start, _ = _seg_cummax_from_flags(pos, part_new)
     row_in_part = pos - part_start
     # "end" searches must stop at the live/dead boundary: treat the first
@@ -180,34 +178,24 @@ def window_op(
                 ident = _mm_ident(v.type, fn == "min")
                 vals = jnp.where(m, d, jnp.asarray(ident, v.type.dtype))
 
+        # frame end: current peer group (running) or whole partition
+        end_flags = end_peer_flags if running else end_part_flags
         if fn in ("min", "max"):
             op = jnp.minimum if fn == "min" else jnp.maximum
-            if running:
-                run = _segmented_scan(vals, part_new, op)
-                res = _peer_extend(run, end_peer_flags, pos)
-            else:
-                segmin = (jax.ops.segment_min if fn == "min" else jax.ops.segment_max)(
-                    vals, seg, num_segments=cap, indices_are_sorted=True
-                )
-                res = segmin[seg]
-            cnt = _part_count(m, seg, cap, running, part_new, end_peer_flags, pos)
+            run = _segmented_scan(vals, part_new, op)
+            res = _peer_extend(run, end_flags, pos)
+            cnt = _part_count(m, part_new, end_flags, pos)
             new_fields.append(Field(out_name, out_t, True, dict_))
             new_data.append(res)
             new_valid.append(cnt > 0)
             continue
 
-        # sum / count / avg
-        if running:
-            csum = _segmented_scan(jnp.asarray(vals), part_new, jnp.add)
-            csum = _peer_extend(csum, end_peer_flags, pos)
-            total = csum
-            ccnt = _segmented_scan(jnp.asarray(m, jnp.int64), part_new, jnp.add)
-            ccnt = _peer_extend(ccnt, end_peer_flags, pos)
-        else:
-            total = jax.ops.segment_sum(vals, seg, num_segments=cap, indices_are_sorted=True)[seg]
-            ccnt = jax.ops.segment_sum(
-                jnp.asarray(m, jnp.int64), seg, num_segments=cap, indices_are_sorted=True
-            )[seg]
+        # sum / count / avg — segmented running scan read at the frame end
+        # (whole partition when there is no ORDER BY): never a scatter
+        total = _peer_extend(
+            _segmented_scan(jnp.asarray(vals), part_new, jnp.add), end_flags, pos
+        )
+        ccnt = _part_count(m, part_new, end_flags, pos)
         if fn == "count":
             new_fields.append(Field(out_name, T.BIGINT, False))
             new_data.append(ccnt)
@@ -268,13 +256,9 @@ def _peer_extend(run, peer_start_flags, pos):
     return run[end]
 
 
-def _part_count(m, seg, cap, running, part_new, end_peer_flags, pos):
-    if running:
-        c = _segmented_scan(jnp.asarray(m, jnp.int64), part_new, jnp.add)
-        return _peer_extend(c, end_peer_flags, pos)
-    return jax.ops.segment_sum(
-        jnp.asarray(m, jnp.int64), seg, num_segments=cap, indices_are_sorted=True
-    )[seg]
+def _part_count(m, part_new, end_flags, pos):
+    c = _segmented_scan(jnp.asarray(m, jnp.int64), part_new, jnp.add)
+    return _peer_extend(c, end_flags, pos)
 
 
 def _agg_out_type(fn, t):

@@ -28,7 +28,7 @@ from ..column import Chunk
 from ..column.column import Schema, chunk_from_arrays, pad_capacity
 from ..exprs.ir import Col
 from ..ops import filter_chunk, hash_aggregate, limit_chunk, project, sort_chunk
-from ..ops.aggregate import FINAL, PARTIAL, final_agg_exprs
+from ..ops.aggregate import FINAL, PARTIAL, decomposable, final_agg_exprs
 from ..ops.setops import concat_many
 from ..sql.logical import (
     LAggregate, LFilter, LLimit, LProject, LScan, LSort, LogicalPlan,
@@ -56,6 +56,8 @@ def match_batchable(plan: LogicalPlan) -> BatchablePlan | None:
     if not isinstance(node, LAggregate):
         return None
     agg = node
+    if not decomposable(agg.aggs):
+        return None  # holistic aggs (percentile) need all rows in one batch
     chain = []
     node = agg.child
     while isinstance(node, (LFilter, LProject)):

@@ -93,6 +93,14 @@ config.define("enable_zonemap_pruning", True, True, "prune parquet rowsets by zo
 config.define("enable_runtime_filters", True, True, "build-side min/max filters applied to join probes")
 config.define("enable_lowcard_agg", True, True,
               "sort-free packed-code aggregation for dictionary-bounded group keys")
+config.define("enable_scatter_free_segments", True, True,
+              "lower segment reductions to one-hot matmuls / sorted prefix "
+              "tricks instead of XLA scatters (TPU scatter serializes on "
+              "duplicate indices)")
+config.define("matmul_segsum_groups_max", 1024, True,
+              "max group count for the one-hot-matmul segment-sum strategy")
+config.define("bcast_segreduce_groups_max", 64, True,
+              "max group count for broadcast-reduce segment min/max/float-sum")
 config.define("batch_rows_threshold", 0, True,
               "stream scan-aggregations in host batches when a table exceeds "
               "this many rows (0 = off); the spill/host-offload path")

@@ -246,7 +246,10 @@ class Executor:
                 return InList(fix_expr(e.arg), e.values, e.negated)
             if isinstance(e, AggExpr):
                 return AggExpr(
-                    e.fn, fix_expr(e.arg) if e.arg is not None else None, e.distinct
+                    e.fn, fix_expr(e.arg) if e.arg is not None else None,
+                    e.distinct,
+                    tuple(fix_expr(x) if isinstance(x, Expr) else x
+                          for x in e.extra),
                 )
             return e
 

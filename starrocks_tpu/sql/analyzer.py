@@ -415,7 +415,12 @@ class Analyzer:
                 if e.arg is not None
                 else None
             )
-            return AggExpr(e.fn, arg, e.distinct)
+            extra = tuple(
+                self._lower(x, scope, ctes, allow_agg=False)
+                if not isinstance(x, Lit) else x
+                for x in e.extra
+            )
+            return AggExpr(e.fn, arg, e.distinct, extra)
         if isinstance(e, Call):
             return Call(e.fn, *[self._lower(a, scope, ctes, allow_agg) for a in e.args])
         if isinstance(e, Case):

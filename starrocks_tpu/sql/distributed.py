@@ -40,7 +40,7 @@ from ..ops import (
     filter_chunk, hash_aggregate, hash_join_expand, hash_join_unique,
     limit_chunk, project, sort_chunk,
 )
-from ..ops.aggregate import FINAL, PARTIAL, final_agg_exprs
+from ..ops.aggregate import FINAL, PARTIAL, decomposable, final_agg_exprs
 from ..ops.common import compact, eval_keys
 from ..ops.sort import _descending
 from ..ops.window import window_op
@@ -347,6 +347,15 @@ def compile_distributed(
                                          caps.get(key, default))
                 checks[key] = ng[None]
                 return out, ("hash", hash_out)
+            if not decomposable(p.aggs):
+                # holistic aggregates (percentile family) need every group
+                # value in one place and the input is not colocated on the
+                # group keys: gather rows, aggregate COMPLETE.
+                gathered = all_gather_chunk(c, axis)
+                out, ng = hash_aggregate(gathered, p.group_by, p.aggs,
+                                         caps.get(key, 1024))
+                checks[key] = ng[None]
+                return out, REPLICATED
             if est is not None and est > SHUFFLE_AGG_MIN_GROUPS:
                 # high cardinality: shuffle partial states by group key so
                 # each shard finalizes only its own key range (SHUFFLE-final).

@@ -151,7 +151,11 @@ def rewrite_distinct_aggs(plan: LogicalPlan) -> LogicalPlan:
     l1_aggs, l2_aggs, post = [], [], {}
     for name, a in plan.aggs:
         if a.distinct:
-            l2_aggs.append((name, AggExpr(a.fn, Col("__darg"))))
+            if any(isinstance(x, Expr) and not isinstance(x, Lit)
+                   for x in a.extra):
+                raise NotImplementedError(
+                    f"DISTINCT with two-argument aggregate {a.fn}")
+            l2_aggs.append((name, AggExpr(a.fn, Col("__darg"), extra=a.extra)))
         elif a.fn in ("count", "count_star"):
             l1_aggs.append((name, a))
             l2_aggs.append((name, AggExpr("sum", Col(name))))
@@ -167,8 +171,33 @@ def rewrite_distinct_aggs(plan: LogicalPlan) -> LogicalPlan:
             l2_aggs.append((f"{name}__ds", AggExpr("sum", Col(f"{name}__ds"))))
             l2_aggs.append((f"{name}__dc", AggExpr("sum", Col(f"{name}__dc"))))
             post[name] = Call("divide", Col(f"{name}__ds"), Col(f"{name}__dc"))
+        elif a.fn in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            # carry moment sums through level 1 like avg's sum/count pair
+            from .. import types as _T
+
+            dx = Cast(a.arg, _T.DOUBLE)
+            l1_aggs.append((f"{name}__s", AggExpr("sum", dx)))
+            l1_aggs.append((f"{name}__q",
+                            AggExpr("sum", Call("multiply", dx, dx))))
+            l1_aggs.append((f"{name}__c", AggExpr("count", a.arg)))
+            for sfx in ("__s", "__q", "__c"):
+                l2_aggs.append((f"{name}{sfx}",
+                                AggExpr("sum", Col(f"{name}{sfx}"))))
+            n = Col(f"{name}__c")
+            s_ = Col(f"{name}__s")
+            q = Col(f"{name}__q")
+            samp = a.fn.endswith("_samp")
+            denom = Call("subtract", n, Lit(1)) if samp else n
+            var = Call("greatest", Call("divide", Call(
+                "subtract", q, Call("divide", Call("multiply", s_, s_), n)),
+                denom), Lit(0.0))
+            e = Call("sqrt", var) if a.fn.startswith("stddev") else var
+            post[name] = Case(
+                ((Call("gt", n, Lit(1 if samp else 0)), e),), Lit(None))
         else:
-            raise NotImplementedError(f"aggregate {a.fn} with DISTINCT rewrite")
+            raise NotImplementedError(
+                f"non-distinct aggregate {a.fn} cannot be combined with a "
+                f"DISTINCT aggregate in the same query yet")
 
     l1 = LAggregate(plan.child, l1_group, tuple(l1_aggs))
     l2_group = tuple((n, Col(n)) for n, _ in plan.group_by)
@@ -234,7 +263,10 @@ def substitute(e: Expr, mapping: dict) -> Expr:
         return InList(substitute(e.arg, mapping), e.values, e.negated)
     if isinstance(e, AggExpr):
         return AggExpr(
-            e.fn, substitute(e.arg, mapping) if e.arg is not None else None, e.distinct
+            e.fn, substitute(e.arg, mapping) if e.arg is not None else None,
+            e.distinct,
+            tuple(substitute(x, mapping) if isinstance(x, Expr) else x
+                  for x in e.extra),
         )
     if isinstance(e, SemiJoinMark):
         return SemiJoinMark(
@@ -811,6 +843,9 @@ def prune_columns(plan: LogicalPlan, required: frozenset | None = None) -> Logic
         for _, a in kept_aggs:
             if a.arg is not None:
                 need |= expr_cols(a.arg)
+            for x in a.extra:
+                if isinstance(x, Expr):
+                    need |= expr_cols(x)
         if not need:
             # count(*) etc: keep one child column
             need = set(plan.child.output_names()[:1])

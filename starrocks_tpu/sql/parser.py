@@ -7,6 +7,8 @@ scalar expressions (unresolved RawCol/RawFunc forms).
 
 from __future__ import annotations
 
+from ..exprs import functions_ext as _fext  # noqa: F401 (fills the registry)
+from ..exprs.compile import _FUNCTIONS as _SCALAR_REGISTRY
 from ..exprs.ir import AggExpr, Call, Case, Cast, Expr, InList, Lit, WindowExpr
 from .. import types as T
 from . import ast
@@ -17,7 +19,21 @@ class ParseError(ValueError):
     pass
 
 
-AGG_FUNCS = {"sum", "count", "avg", "min", "max"}
+AGG_FUNCS = {"sum", "count", "avg", "min", "max",
+             "stddev_pop", "stddev_samp", "var_pop", "var_samp",
+             "covar_pop", "covar_samp", "corr",
+             "percentile_cont", "percentile_disc"}
+# aliases resolving to a canonical aggregate (MySQL/reference naming:
+# std/stddev/variance are population forms; any_value picks an arbitrary
+# row — min is a valid choice; ndv/approx_count_distinct answer exactly here)
+AGG_ALIASES = {
+    "std": "stddev_pop", "stddev": "stddev_pop", "variance": "var_pop",
+    "any_value": "min", "arbitrary": "min",
+    "bool_and": "min", "bool_or": "max",
+}
+# aggregates whose second positional argument is part of the spec
+AGG_EXTRA_ARG = {"covar_pop", "covar_samp", "corr",
+                 "percentile_cont", "percentile_disc"}
 
 # scalar function name -> registry name (None = same)
 SCALAR_FUNCS = {
@@ -576,7 +592,8 @@ class Parser:
                 sub = self.parse_select()
                 self.expect_op(")")
                 return ast.Exists(sub)
-            if t.value in ("year", "month", "day", "if", "substring"):
+            if t.value in ("year", "month", "day", "if", "substring", "left",
+                           "right", "second", "replace", "values", "week"):
                 # function-style keywords
                 if self.peek(1).kind == "op" and self.peek(1).value == "(":
                     return self.parse_func_call(self.next().value)
@@ -605,11 +622,21 @@ class Parser:
             return ast.RawCol(None, name)
         raise ParseError(f"unexpected token {t.value!r} (pos {t.pos})")
 
+    # functions taking a leading bare unit keyword (MySQL style):
+    # timestampdiff(DAY, a, b), date_trunc(month, x), extract-like forms
+    _UNIT_ARG_FNS = {"timestampdiff", "timestampadd", "date_trunc"}
+    _UNITS = {"year", "quarter", "month", "week", "day", "hour", "minute",
+              "second"}
+
     def parse_func_call(self, name: str) -> Expr:
         name = name.lower()
         self.expect_op("(")
         distinct = self.accept_kw("distinct")
         args = []
+        if (name in self._UNIT_ARG_FNS and self.peek().kind in ("kw", "ident")
+                and self.peek().value.lower() in self._UNITS):
+            args.append(Lit(self.next().value.lower()))
+            self.expect_op(",")
         if self.at_op("*"):
             self.next()
             args = [ast.Star()]
@@ -620,12 +647,33 @@ class Parser:
         self.expect_op(")")
         if self.at_kw("over"):
             return self.parse_over(name, args, distinct)
+        name = AGG_ALIASES.get(name, name)
+        if name in ("median", "approx_count_distinct", "ndv") and not args:
+            raise ParseError(f"{name} takes one argument")
+        if name == "median":
+            return AggExpr("percentile_cont", args[0], distinct,
+                           extra=(Lit(0.5),))
+        if name in ("approx_count_distinct", "ndv"):
+            # exact distinct count (a zero-error "approximation"; the
+            # reference uses HLL, be/src/types/hll.h)
+            return AggExpr("count", args[0], True)
         if name in AGG_FUNCS:
             if name == "count" and args and isinstance(args[0], ast.Star):
                 return AggExpr("count", None, distinct)
+            if name in AGG_EXTRA_ARG:
+                if len(args) < 2:
+                    raise ParseError(f"{name} takes two arguments")
+                if name.startswith("percentile"):
+                    frac = args[1]
+                    if not (isinstance(frac, Lit)
+                            and isinstance(frac.value, (int, float))
+                            and 0.0 <= float(frac.value) <= 1.0):
+                        raise ParseError(
+                            f"{name} fraction must be a literal in [0, 1]")
+                return AggExpr(name, args[0], distinct, extra=(args[1],))
             return AggExpr(name, args[0] if args else None, distinct)
-        reg = SCALAR_FUNCS.get(name)
-        if reg is not None:
+        reg = SCALAR_FUNCS.get(name, name)
+        if reg in _SCALAR_REGISTRY:
             return Call(reg, *args)
         return ast.RawFunc(name, tuple(args), distinct)
 
