@@ -1,26 +1,66 @@
-"""Benchmark: TPC-H Q1 (scan + filter + group-by aggregation) on one chip.
+"""Benchmark suite: the BASELINE.json configs on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default mode ("suite") times every config family — TPC-H Q1 (hand-built plan,
+the headline), TPC-H Q3/Q9 (joins + partial-agg), four SSB flat queries (wide
+scan), TPC-DS Q67 (high-cardinality group-by + window) — each against a
+single-process pandas implementation of the same query on the same host (the
+stand-in for the reference BE's single-node vectorized CPU path; BASELINE.md
+has the reference's published cluster numbers).
+
+Prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", "suite_geomean_vs_pandas", "suite"}
 - value: lineitem rows/sec through the full jitted Q1 plan (post-compile,
-  best of N timed runs, data resident on device).
-- vs_baseline: speedup vs a single-process pandas implementation of the same
-  query on the same host (the stand-in for the reference BE's single-node
-  vectorized CPU path; see BASELINE.md for the reference's published cluster
-  numbers).
+  best of N timed runs, data resident on device) — comparable across rounds.
+- vs_baseline: Q1 speedup vs pandas.
+- suite_geomean_vs_pandas: geomean speedup across every suite query.
+Full per-query numbers land in BENCH_DETAIL.json.
 
 Scale factor via SR_TPU_BENCH_SF (default 1.0 -> ~6M lineitem rows).
-SR_TPU_BENCH_QUERY selects the workload: q1 (default, hand-built plan) |
-sql_q1 .. sql_q22 (full SQL path) | ssb_q1.1 .. | tpcds_q67.
+SR_TPU_BENCH_QUERY selects the workload: suite (default) | q1 (hand-built
+plan only) | sql_q1 .. sql_q22 | ssb_q1.1 .. | tpcds_q67.
 """
 
 import json
+import math
 import os
 import sys
 import time
 
 
+def _best(fn, repeats):
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.time()
+        fn()
+        best = min(best, time.time() - t0)
+    return best
+
+
+def _bench_sql(session, text, rows_base, repeats, oracle=None):
+    """Time one query through the full SQL path on an existing session.
+
+    Returns a detail dict. Wall times include the host->device command
+    roundtrip (~65ms through the axon tunnel), so `device_ms` is an upper
+    bound on true device latency for small queries.
+    """
+    t0 = time.time()
+    session.sql(text)  # plan + compile + first run
+    compile_s = time.time() - t0
+    best = _best(lambda: session.sql(text), repeats)
+    out = {
+        "rows_per_sec": round(rows_base / best),
+        "device_ms": round(best * 1000, 2),
+        "compile_s": round(compile_s, 1),
+    }
+    if oracle is not None:
+        pbest = _best(oracle, max(2, repeats // 2))
+        out["pandas_ms"] = round(pbest * 1000, 2)
+        out["vs_pandas"] = round(pbest / best, 3)
+    return out
+
+
 def run_sql_bench(query_key: str, sf: float, repeats: int):
-    """Benchmark a query through the full SQL path (parse->plan->jit)."""
+    """Benchmark a single query through the full SQL path (parse->plan->jit)."""
     from starrocks_tpu.runtime.session import Session
 
     if query_key.startswith("sql_q"):
@@ -47,25 +87,17 @@ def run_sql_bench(query_key: str, sf: float, repeats: int):
     else:
         raise ValueError(f"unknown bench query {query_key!r}")
 
-    s = Session(cat)
-    t0 = time.time()
-    s.sql(text)  # compile + first run
-    compile_s = time.time() - t0
-    best = float("inf")
-    for _ in range(repeats):
-        t1 = time.time()
-        s.sql(text)
-        best = min(best, time.time() - t1)
     import jax
 
+    d = _bench_sql(Session(cat), text, rows_base, repeats)
     print(json.dumps({
         "metric": f"{query_key}_sf{sf:g}_rows_per_sec",
-        "value": round(rows_base / best),
+        "value": d["rows_per_sec"],
         "unit": "rows/sec/chip",
         "vs_baseline": 0.0,
     }))
     print(f"# backend={jax.default_backend()} rows={rows_base} "
-          f"compile={compile_s:.1f}s best={best*1000:.1f}ms", file=sys.stderr)
+          f"compile={d['compile_s']}s best={d['device_ms']}ms", file=sys.stderr)
 
 
 def _device_seconds_per_run(dispatch, n_small: int = 4, n_big: int = 32,
@@ -127,14 +159,9 @@ def _ensure_live_backend(probe_timeout_s: int = 120):
     jax.config.update("jax_platforms", "cpu")
 
 
-def main():
-    sf = float(os.environ.get("SR_TPU_BENCH_SF", "1.0"))
-    repeats = int(os.environ.get("SR_TPU_BENCH_REPEATS", "5"))
-    query_key = os.environ.get("SR_TPU_BENCH_QUERY", "q1")
-    _ensure_live_backend()
-    if query_key != "q1":
-        return run_sql_bench(query_key, sf, repeats)
-
+def run_q1_handplan(sf: float, repeats: int):
+    """The headline config: TPC-H Q1 through the hand-built plan, with a
+    pandas baseline and a correctness guard. Returns a detail dict."""
     import jax
 
     from __graft_entry__ import _q1_plan
@@ -147,7 +174,6 @@ def main():
     n_rows = li.num_rows
     gen_s = time.time() - t0
 
-    # --- pandas baseline (single-node CPU stand-in) --------------------------
     df = li.to_pandas()
     import pandas as pd
 
@@ -156,13 +182,12 @@ def main():
     expected = q1_pandas(df, cutoff)
     pandas_s = time.time() - t0
 
-    # --- device path ----------------------------------------------------------
     chunk = li.to_chunk()  # host->device
     fn = jax.jit(_q1_plan)
+    t0 = time.time()
     out, ng = fn(chunk)  # compile + first run
-    int(ng)  # host fetch forces completion (block_until_ready is a no-op
-    #          through the axon tunnel -- see BENCH notes)
-    compile_s = time.time() - t0 - pandas_s
+    int(ng)  # host fetch forces completion
+    compile_s = time.time() - t0
 
     best = _device_seconds_per_run(lambda: fn(chunk)[1], trials=repeats)
 
@@ -174,20 +199,139 @@ def main():
         rel = abs(row[2] - exp["sum_qty"]) / max(abs(exp["sum_qty"]), 1)
         assert rel < 1e-9, (row, exp)
 
-    rows_per_sec = n_rows / best
-    result = {
-        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
-        "value": round(rows_per_sec),
-        "unit": "rows/sec/chip",
-        "vs_baseline": round(pandas_s / best, 3),
-    }
-    print(json.dumps(result))
     print(
-        f"# backend={jax.default_backend()} rows={n_rows} gen={gen_s:.2f}s "
+        f"# q1 backend={jax.default_backend()} rows={n_rows} gen={gen_s:.2f}s "
         f"pandas={pandas_s*1000:.0f}ms compile={compile_s:.1f}s "
-        f"best_device={best*1000:.1f}ms",
+        f"best_device={best*1000:.2f}ms",
         file=sys.stderr,
     )
+    return {
+        "rows": n_rows,
+        "rows_per_sec": round(n_rows / best),
+        "device_ms": round(best * 1000, 2),
+        "pandas_ms": round(pandas_s * 1000, 2),
+        "vs_pandas": round(pandas_s / best, 3),
+        "compile_s": round(compile_s, 1),
+    }
+
+
+def run_suite(sf: float, repeats: int):
+    """All BASELINE.json config families; one JSON line + BENCH_DETAIL.json."""
+    import jax
+
+    from starrocks_tpu.runtime.session import Session
+
+    detail = {"backend": jax.default_backend(), "sf": sf}
+    q1d = run_q1_handplan(sf, repeats)
+    detail["tpch_q1_handplan"] = q1d
+    speedups = [q1d["vs_pandas"]]
+
+    def try_entry(name, fn):
+        try:
+            d = fn()
+            detail[name] = d
+            if "vs_pandas" in d:
+                speedups.append(d["vs_pandas"])
+            print(f"# {name}: {d.get('device_ms')}ms device, "
+                  f"{d.get('pandas_ms')}ms pandas, "
+                  f"{d.get('vs_pandas')}x", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — one failure must not kill the bench
+            detail[name] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# {name}: FAILED {type(e).__name__}: {e}", file=sys.stderr)
+
+    # --- TPC-H Q3 + Q9 (joins, partial-agg exchange shape single-chip) ------
+    # family setup lives inside try-blocks too: one family failing to build
+    # must not kill the suite (same contract as try_entry)
+    try:
+        from starrocks_tpu.storage.catalog import tpch_catalog
+        from tests import tpch_oracle
+        from tests.tpch_queries import QUERIES
+
+        tcat = tpch_catalog(sf=sf)
+        tsess = Session(tcat)
+        frames = tpch_oracle.load_frames(tcat)
+        nrows_li = tcat.get_table("lineitem").row_count
+    except Exception as e:  # noqa: BLE001
+        detail["tpch_setup"] = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        for qn in (3, 9):
+            try_entry(
+                f"tpch_q{qn}",
+                lambda qn=qn: _bench_sql(
+                    tsess, QUERIES[qn], nrows_li, repeats,
+                    oracle=lambda: getattr(tpch_oracle, f"q{qn}")(frames)),
+            )
+
+    # --- SSB flat (wide scan + predicate pushdown) --------------------------
+    try:
+        from starrocks_tpu.storage.datagen.ssb import ssb_catalog
+        from tests.ssb_queries import FLAT_QUERIES
+        from tests.test_ssb_sql import _oracle as ssb_oracle
+
+        scat = ssb_catalog(sf=sf)
+        ssess = Session(scat)
+        sdf = scat.get_table("lineorder_flat").table.to_pandas()
+        nrows_ssb = scat.get_table("lineorder_flat").row_count
+    except Exception as e:  # noqa: BLE001
+        detail["ssb_setup"] = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        for qid in ("q1.1", "q2.1", "q3.1", "q4.1"):
+            try_entry(
+                f"ssb_{qid}",
+                lambda qid=qid: _bench_sql(
+                    ssess, FLAT_QUERIES[qid], nrows_ssb, repeats,
+                    oracle=lambda: ssb_oracle(sdf, qid)),
+            )
+
+    # --- TPC-DS Q67 (high-card group-by + window) ---------------------------
+    def q67_entry():
+        from starrocks_tpu.storage.datagen.tpcds import tpcds_catalog
+        from tests.test_tpcds_q67 import Q67, oracle as q67_oracle
+
+        dcat = tpcds_catalog(sf=sf)
+        dsess = Session(dcat)
+        return _bench_sql(
+            dsess, Q67, dcat.get_table("store_sales").row_count, repeats,
+            oracle=lambda: q67_oracle(dcat))
+
+    try_entry("tpcds_q67", q67_entry)
+
+    geomean = round(
+        math.exp(sum(math.log(s) for s in speedups) / len(speedups)), 3)
+    detail["suite_geomean_vs_pandas"] = geomean
+    with open(os.path.join(os.path.dirname(__file__) or ".",
+                           "BENCH_DETAIL.json"), "w") as f:
+        json.dump(detail, f, indent=1)
+
+    print(json.dumps({
+        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
+        "value": q1d["rows_per_sec"],
+        "unit": "rows/sec/chip",
+        "vs_baseline": q1d["vs_pandas"],
+        "suite_geomean_vs_pandas": geomean,
+        "suite_queries": len(speedups),
+    }))
+
+
+def main():
+    sf = float(os.environ.get("SR_TPU_BENCH_SF", "1.0"))
+    repeats = int(os.environ.get("SR_TPU_BENCH_REPEATS", "5"))
+    query_key = os.environ.get("SR_TPU_BENCH_QUERY", "suite")
+    _ensure_live_backend()
+    if query_key == "suite":
+        return run_suite(sf, repeats)
+    if query_key != "q1":
+        return run_sql_bench(query_key, sf, repeats)
+
+    import json as _json
+
+    d = run_q1_handplan(sf, repeats)
+    print(_json.dumps({
+        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
+        "value": d["rows_per_sec"],
+        "unit": "rows/sec/chip",
+        "vs_baseline": d["vs_pandas"],
+    }))
 
 
 if __name__ == "__main__":

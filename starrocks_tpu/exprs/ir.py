@@ -98,6 +98,11 @@ class WindowExpr(Expr):
     order_by: tuple = ()  # tuple[(Expr, asc, nulls_first)]
     offset: int = 1  # lead/lag distance (also ntile bucket count)
     default: object = None  # lead/lag default value (python literal)
+    # explicit frame (mode, start_kind, start_off, end_kind, end_off) where
+    # mode is "rows"|"range" and kinds are "up" (UNBOUNDED PRECEDING),
+    # "p" (n PRECEDING), "cr" (CURRENT ROW), "f" (n FOLLOWING),
+    # "uf" (UNBOUNDED FOLLOWING). None = the SQL default frame.
+    frame: tuple = None
 
     def __repr__(self):
         a = "" if self.arg is None else repr(self.arg)

@@ -76,10 +76,73 @@ def window_op(
     dead_start = ~live_s
     end_peer_flags = peer_new | part_new | dead_start
     end_part_flags = part_new | dead_start
+    peer_start, _ = _seg_cummax_from_flags(pos, peer_new | part_new)
+    _nxt_peer = jnp.concatenate([end_peer_flags[1:], jnp.ones((1,), jnp.bool_)])
+    peer_end = _carry_scan(pos[::-1], _nxt_peer[::-1])[::-1]
+    _nxt_part = jnp.concatenate([end_part_flags[1:], jnp.ones((1,), jnp.bool_)])
+    part_end = _carry_scan(pos[::-1], _nxt_part[::-1])[::-1]
+
+    def frame_bounds(frame):
+        """Per-row inclusive [start, end] positions of an explicit frame in
+        the sorted order, clamped to the row's partition. start > end means
+        an empty frame. Reference frame semantics: be/src/exec/analytor.h:54."""
+        mode, st, so, et, eo = frame
+        if mode == "rows":
+            start = {"up": part_start, "p": pos - int(so or 0), "cr": pos,
+                     "f": pos + int(so or 0)}[st]
+            end = {"p": pos - int(eo or 0), "cr": pos, "f": pos + int(eo or 0),
+                   "uf": part_end}[et]
+        else:  # RANGE: CURRENT ROW = the whole peer group
+            start = {"up": part_start, "cr": peer_start}.get(st)
+            end = {"cr": peer_end, "uf": part_end}.get(et)
+            if start is None or end is None:
+                k = okeys[0]
+                if k.dict is not None:
+                    raise NotImplementedError(
+                        "RANGE frame offsets require a numeric ORDER BY key")
+                # offsets are user-unit; decimal keys are scaled-int reps
+                unit = 10 ** k.type.scale if k.type.is_decimal else 1
+                so = None if so is None else so * unit
+                eo = None if eo is None else eo * unit
+                asc = order_by[0][1]
+                nf = order_by[0][2]
+                ks = jnp.asarray(jnp.asarray(k.data)[order], jnp.float64)
+                if k.valid is not None:
+                    kv = jnp.asarray(k.valid)[order]
+                    # nulls sort as a block at one end; pin them to the
+                    # matching sentinel so the partition stays monotone
+                    at_min = nf if asc else not nf
+                    ks = jnp.where(kv, ks, -jnp.inf if at_min else jnp.inf)
+                else:
+                    kv = jnp.ones((cap,), jnp.bool_)
+                iters = cap.bit_length() + 1
+                hi0 = part_end + 1
+                if start is None:
+                    sgn = -1.0 if st == "p" else 1.0
+                    t = ks + (sgn * float(so) if asc else -sgn * float(so))
+                    cmp = (lambda a, b: a >= b) if asc else (lambda a, b: a <= b)
+                    start = _bsearch_first(ks, part_start, hi0, t, cmp, iters)
+                    start = jnp.where(kv, start, peer_start)
+                if end is None:
+                    sgn = -1.0 if et == "p" else 1.0
+                    t = ks + (sgn * float(eo) if asc else -sgn * float(eo))
+                    cmp = (lambda a, b: a > b) if asc else (lambda a, b: a < b)
+                    end = _bsearch_first(ks, part_start, hi0, t, cmp, iters) - 1
+                    end = jnp.where(kv, end, peer_end)
+        start = jnp.maximum(start, part_start)
+        end = jnp.minimum(end, part_end)
+        # detect emptiness BEFORE clamping into gather range (a frame wholly
+        # outside its partition must stay empty); encode empty as (1, 0)
+        empty = (start > end) | ~live_s
+        start = jnp.clip(start, 0, cap - 1)
+        end = jnp.clip(end, 0, cap - 1)
+        return jnp.where(empty, 1, start), jnp.where(empty, 0, end)
 
     cc = ExprCompiler(sorted_chunk)
     new_fields, new_data, new_valid = [], [], []
-    for out_name, fn, arg, f_offset, f_default in funcs:
+    for spec in funcs:
+        out_name, fn, arg, f_offset, f_default, *_rest = spec
+        f_frame = _rest[0] if _rest else None
         if fn == "row_number":
             new_fields.append(Field(out_name, T.BIGINT, False))
             new_data.append(row_in_part + 1)
@@ -87,7 +150,6 @@ def window_op(
             continue
         if fn in ("rank", "dense_rank"):
             if fn == "rank":
-                peer_start, _ = _seg_cummax_from_flags(pos, peer_new | part_new)
                 r = peer_start - part_start + 1
             else:
                 in_part_newpeer = (peer_new | part_new) & ~part_new
@@ -129,15 +191,22 @@ def window_op(
         if fn in ("first_value", "last_value"):
             v = cc.eval(arg)
             d = jnp.broadcast_to(jnp.asarray(v.data), (cap,))
+            if f_frame is not None:
+                starts, ends = frame_bounds(f_frame)
+                idx = starts if fn == "first_value" else ends
+                empty = starts > ends
+                vv = (jnp.broadcast_to(v.valid, (cap,))[idx]
+                      if v.valid is not None else jnp.ones((cap,), jnp.bool_))
+                new_fields.append(Field(out_name, v.type, True, v.dict))
+                new_data.append(d[idx])
+                new_valid.append(vv & ~empty)
+                continue
             if fn == "first_value":
                 idx = part_start
             else:
                 # default frame: end of the current peer group (stops at the
                 # live/dead boundary)
-                nxt = jnp.concatenate(
-                    [end_peer_flags[1:], jnp.ones((1,), jnp.bool_)]
-                )
-                idx = _carry_scan(pos[::-1], nxt[::-1])[::-1]
+                idx = peer_end
             val = d[idx]
             vv = (jnp.broadcast_to(v.valid, (cap,))[idx]
                   if v.valid is not None else None)
@@ -148,8 +217,6 @@ def window_op(
         if fn == "ntile":
             n_tiles = int(f_offset)
             # partition size = end - start + 1 (end stops at live/dead edge)
-            nxt = jnp.concatenate([end_part_flags[1:], jnp.ones((1,), jnp.bool_)])
-            part_end = _carry_scan(pos[::-1], nxt[::-1])[::-1]
             psize = part_end - part_start + 1
             tile = (row_in_part * n_tiles) // jnp.maximum(psize, 1) + 1
             new_fields.append(Field(out_name, T.BIGINT, False))
@@ -177,6 +244,58 @@ def window_op(
             else:  # min/max
                 ident = _mm_ident(v.type, fn == "min")
                 vals = jnp.where(m, d, jnp.asarray(ident, v.type.dtype))
+
+        if f_frame is not None:
+            # explicit ROWS/RANGE frame: prefix-sum differences for
+            # sum/count/avg; scans or a doubling sparse table for min/max
+            starts, ends = frame_bounds(f_frame)
+            empty = starts > ends
+            sm = starts - 1
+
+            def pref_diff(P, empty=empty, ends=ends, sm=sm):
+                a = P[ends]
+                b = jnp.where(sm >= 0, P[jnp.clip(sm, 0, cap - 1)], 0)
+                return jnp.where(empty, 0, a - b)
+
+            cntf = pref_diff(jnp.cumsum(jnp.asarray(m, jnp.int64)))
+            if fn in ("min", "max"):
+                op = jnp.minimum if fn == "min" else jnp.maximum
+                ident = jnp.asarray(_mm_ident(v.type, fn == "min"), vals.dtype)
+                st_kind, et_kind = f_frame[1], f_frame[3]
+                if st_kind == "up":
+                    res = _segmented_scan(vals, part_new, op)[ends]
+                elif et_kind == "uf":
+                    is_end = pos == part_end
+                    res = _segmented_scan(
+                        vals[::-1], is_end[::-1], op)[::-1][starts]
+                else:
+                    res = _range_reduce(vals, op, ident, starts, ends, cap)
+                new_fields.append(Field(out_name, out_t, True, dict_))
+                new_data.append(jnp.where(empty, ident, res))
+                new_valid.append(cntf > 0)
+                continue
+            if fn == "count":
+                new_fields.append(Field(out_name, T.BIGINT, False))
+                new_data.append(cntf)
+                new_valid.append(None)
+                continue
+            total = pref_diff(jnp.cumsum(vals))
+            if fn == "sum":
+                new_fields.append(Field(out_name, out_t, True))
+                new_data.append(total)
+                new_valid.append(cntf > 0)
+                continue
+            if fn != "avg":
+                raise NotImplementedError(f"window frame for {fn}")
+            denom = jnp.maximum(cntf, 1)
+            if out_t.is_decimal:
+                res = jnp.asarray(total, jnp.float64) / (10 ** out_t.scale) / denom
+            else:
+                res = jnp.asarray(total, jnp.float64) / denom
+            new_fields.append(Field(out_name, T.DOUBLE, True))
+            new_data.append(res)
+            new_valid.append(cntf > 0)
+            continue
 
         # frame end: current peer group (running) or whole partition
         end_flags = end_peer_flags if running else end_part_flags
@@ -217,6 +336,44 @@ def window_op(
             raise NotImplementedError(f"window function {fn}")
 
     return sorted_chunk.with_columns(new_fields, new_data, new_valid)
+
+
+def _bsearch_first(ks, lo0, hi0, thresh, cmp, iters):
+    """Vectorized binary search: for each row, the first index j in
+    [lo0, hi0) with cmp(ks[j], thresh) true (ks monotone over that span);
+    hi0 when none. All arguments may be per-row arrays."""
+    lo, hi = lo0, hi0
+    n = ks.shape[0]
+    for _ in range(iters):
+        mid = jnp.clip((lo + hi) // 2, 0, n - 1)
+        p = cmp(ks[mid], thresh)
+        cont = lo < hi
+        lo = jnp.where(cont & ~p, mid + 1, lo)
+        hi = jnp.where(cont & p, mid, hi)
+    return lo
+
+
+def _range_reduce(vals, op, ident, starts, ends, cap):
+    """min/max over arbitrary inclusive [starts, ends] spans: doubling sparse
+    table (O(n log n) build, two gathers per row). The TPU answer to sliding
+    frame min/max — no per-row loops, no scatters."""
+    levels = max(1, (cap - 1).bit_length())
+    tables = [vals]
+    prev = vals
+    for k in range(1, levels + 1):
+        h = 1 << (k - 1)
+        pad = jnp.full((h,), ident, prev.dtype)
+        prev = op(prev, jnp.concatenate([prev[h:], pad]))
+        tables.append(prev)
+    stacked = jnp.stack(tables)  # (levels+1, cap)
+    ln = jnp.maximum(ends - starts + 1, 1)
+    k = jnp.asarray(jnp.floor(jnp.log2(jnp.asarray(ln, jnp.float64))),
+                    jnp.int32)
+    k = jnp.clip(k, 0, levels)
+    two_k = jnp.left_shift(jnp.asarray(1, starts.dtype), k.astype(starts.dtype))
+    a = stacked[k, jnp.clip(starts, 0, cap - 1)]
+    b = stacked[k, jnp.clip(ends - two_k + 1, 0, cap - 1)]
+    return op(a, b)
 
 
 def _segmented_scan(vals, seg_start_flags, op):

@@ -283,8 +283,8 @@ class Executor:
                     tuple(fix_expr(x) for x in p.partition_by),
                     tuple((fix_expr(e), a, nf) for e, a, nf in p.order_by),
                     tuple(
-                        (n, fn, fix_expr(a) if a is not None else None, off, d)
-                        for n, fn, a, off, d in p.funcs
+                        (n, fn, fix_expr(a) if a is not None else None, *rest)
+                        for n, fn, a, *rest in p.funcs
                     ),
                 )
             return p

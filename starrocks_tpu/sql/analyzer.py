@@ -406,7 +406,8 @@ class Analyzer:
                 (self._lower(o, scope, ctes, allow_agg=False), asc, nf)
                 for o, asc, nf in e.order_by
             )
-            return WindowExpr(e.fn, arg, part, order, e.offset, e.default)
+            return WindowExpr(e.fn, arg, part, order, e.offset, e.default,
+                              e.frame)
         if isinstance(e, AggExpr):
             if not allow_agg:
                 raise AnalyzerError(f"aggregate {e} not allowed here")
@@ -533,7 +534,7 @@ class Analyzer:
                     replace(e.arg) if e.arg is not None else None,
                     tuple(replace(p) for p in e.partition_by),
                     tuple((replace(o), a, nf) for o, a, nf in e.order_by),
-                    e.offset, e.default,
+                    e.offset, e.default, e.frame,
                 )
             if isinstance(e, (ScalarSubquery, SemiJoinMark)):
                 return e
@@ -568,7 +569,7 @@ class Analyzer:
                 name = f"win_{len(mapping)}"
                 mapping[e] = name
                 specs.setdefault((e.partition_by, e.order_by), []).append(
-                    (name, e.fn, e.arg, e.offset, e.default)
+                    (name, e.fn, e.arg, e.offset, e.default, e.frame)
                 )
                 return
             if isinstance(e, Call):

@@ -19,6 +19,7 @@ KEYWORDS = {
     "nulls", "first", "last", "explain", "analyze", "year", "month", "day",
     "distributed", "hash", "buckets", "properties", "substring", "any",
     "over", "partition", "rows", "range", "unbounded", "preceding", "current",
+    "following", "row",
     "show", "describe", "desc", "tables", "delete", "truncate",
     "primary", "key", "update", "set", "intersect", "except",
     "view", "materialized", "refresh", "full",

@@ -120,7 +120,7 @@ class LWindow(LogicalPlan):
         return (self.child,)
 
     def output_names(self):
-        return self.child.output_names() + tuple(n for n, _, _ in self.funcs)
+        return self.child.output_names() + tuple(n for n, *_ in self.funcs)
 
     def __repr__(self):
         return f"Window[{[n for n, *_ in self.funcs]} part={list(self.partition_by)}]"

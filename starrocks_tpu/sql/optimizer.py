@@ -860,7 +860,7 @@ def prune_columns(plan: LogicalPlan, required: frozenset | None = None) -> Logic
             need |= expr_cols(p)
         for o, _, _ in plan.order_by:
             need |= expr_cols(o)
-        for _, _, a, _, _ in plan.funcs:
+        for _, _, a, *_ in plan.funcs:
             if a is not None:
                 need |= expr_cols(a)
         if not need:

@@ -100,6 +100,8 @@ class Parser:
         "year", "month", "day", "date", "first", "last", "tables", "values",
         "show", "key", "primary", "update", "set", "delete", "truncate",
         "describe", "desc", "view", "materialized", "refresh",
+        "row", "rows", "range", "following", "unbounded", "preceding",
+        "current",
     })
 
     def expect_ident(self) -> str:
@@ -702,8 +704,58 @@ class Parser:
                 order.append((o.expr, o.asc, nf))
                 if not self.accept_op(","):
                     break
+        frame = None
         if self.at_kw("rows", "range"):
-            raise ParseError("explicit window frames unsupported (default frame only)")
+            mode = "rows" if self.accept_kw("rows") else None
+            if mode is None:
+                self.expect_kw("range")
+                mode = "range"
+
+            def bound():
+                if self.accept_kw("unbounded"):
+                    if self.accept_kw("preceding"):
+                        return ("up", None)
+                    self.expect_kw("following")
+                    return ("uf", None)
+                if self.accept_kw("current"):
+                    self.expect_kw("row")
+                    return ("cr", None)
+                v = self.parse_expr()
+                if not (isinstance(v, Lit)
+                        and isinstance(v.value, (int, float))
+                        and not isinstance(v.value, bool)):
+                    raise ParseError("frame offset must be a numeric literal")
+                if v.value < 0:
+                    raise ParseError("frame offset must be non-negative")
+                if mode == "rows" and not isinstance(v.value, int):
+                    raise ParseError("ROWS frame offset must be an integer")
+                if self.accept_kw("preceding"):
+                    return ("p", v.value)
+                self.expect_kw("following")
+                return ("f", v.value)
+
+            if self.accept_kw("between"):
+                s = bound()
+                self.expect_kw("and")
+                e = bound()
+            else:
+                s = bound()
+                e = ("cr", None)
+            rank = {"up": 0, "p": 1, "cr": 2, "f": 3, "uf": 4}
+            if s[0] == "uf" or e[0] == "up" or rank[s[0]] > rank[e[0]]:
+                raise ParseError(
+                    f"invalid frame bounds ({s[0]} .. {e[0]})")
+            if not order:
+                raise ParseError("a window frame requires ORDER BY")
+            if (mode == "range"
+                    and any(k in ("p", "f") for k in (s[0], e[0]))
+                    and len(order) != 1):
+                raise ParseError(
+                    "RANGE with an offset requires exactly one ORDER BY key")
+            if name in self.WINDOW_ONLY and name not in (
+                    "first_value", "last_value"):
+                raise ParseError(f"{name} does not accept a window frame")
+            frame = (mode, s[0], s[1], e[0], e[1])
         self.expect_op(")")
         arg = None
         offset = 1
@@ -725,7 +777,7 @@ class Parser:
             offset = args[0].value
             arg = None
         return WindowExpr(name, arg, tuple(partition), tuple(order),
-                          offset, default)
+                          offset, default, frame)
 
     def parse_case(self) -> Expr:
         self.expect_kw("case")

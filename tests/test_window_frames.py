@@ -1,0 +1,248 @@
+"""Explicit window frame (ROWS/RANGE BETWEEN) tests vs python oracles.
+
+Reference behavior: be/src/exec/analytor.h:54 — frame-based analytic
+evaluation with ROWS/RANGE offsets clamped to partition bounds."""
+
+import math
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.sql.parser import ParseError, parse
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session()
+    rng = np.random.default_rng(42)
+    n = 400
+    g = np.sort(rng.integers(0, 8, n))
+    df = pd.DataFrame({"g": g})
+    # unique, non-contiguous order key per partition (deterministic ROWS)
+    df["k"] = df.groupby("g").cumcount() * 3 + rng.integers(0, 3, n)
+    v = np.round(rng.normal(50, 20, n), 2)
+    nulls = rng.random(n) < 0.12
+    s.sql("create table wf (g int, k int, v double)")
+    rows = ", ".join(
+        f"({a}, {b}, {'null' if nu else c})"
+        for a, b, c, nu in zip(df.g, df.k, v, nulls))
+    s.sql(f"insert into wf values {rows}")
+    s._df = pd.DataFrame(
+        {"g": df.g, "k": df.k, "v": np.where(nulls, np.nan, v)}
+    ).sort_values(["g", "k"]).reset_index(drop=True)
+    return s
+
+
+def oracle(df, fn, mode, s, e):
+    """Row-by-row frame evaluation per partition (df sorted by g, k)."""
+    out = []
+    for _, grp in df.groupby("g", sort=True):
+        vals = grp["v"].to_numpy()
+        keys = grp["k"].to_numpy()
+        n = len(grp)
+        for i in range(n):
+            if mode == "rows":
+                lo = {"up": 0, "p": i - (s[1] or 0), "cr": i,
+                      "f": i + (s[1] or 0)}[s[0]]
+                hi = {"uf": n - 1, "p": i - (e[1] or 0), "cr": i,
+                      "f": i + (e[1] or 0)}[e[0]]
+            else:  # range over k (ints, no ties by construction)
+                lo = {"up": 0, "cr": i}.get(s[0])
+                hi = {"uf": n - 1, "cr": i}.get(e[0])
+                if lo is None:
+                    t = keys[i] + (-s[1] if s[0] == "p" else s[1])
+                    lo = int(np.searchsorted(keys, t, side="left"))
+                if hi is None:
+                    t = keys[i] + (-e[1] if e[0] == "p" else e[1])
+                    hi = int(np.searchsorted(keys, t, side="right")) - 1
+            lo, hi = max(lo, 0), min(hi, n - 1)
+            w = vals[lo:hi + 1] if lo <= hi else np.array([])
+            wv = w[~np.isnan(w)]
+            if fn == "count":
+                out.append(len(wv))
+            elif len(wv) == 0:
+                out.append(np.nan)
+            elif fn == "sum":
+                out.append(wv.sum())
+            elif fn == "avg":
+                out.append(wv.mean())
+            elif fn == "min":
+                out.append(wv.min())
+            elif fn == "max":
+                out.append(wv.max())
+            elif fn == "first_value":
+                out.append(w[0] if len(w) else np.nan)
+            elif fn == "last_value":
+                out.append(w[-1] if len(w) else np.nan)
+    return np.array(out, dtype=float)
+
+
+def run(sess, frame_sql, fns=("sum", "avg", "min", "max", "count")):
+    cols = ", ".join(
+        f"{fn}(v) over (partition by g order by k {frame_sql}) c{i}"
+        for i, fn in enumerate(fns))
+    r = sess.sql(f"select g, k, {cols} from wf order by g, k")
+    return pd.DataFrame(
+        r.rows(), columns=["g", "k"] + [f"c{i}" for i in range(len(fns))])
+
+
+def check(sess, mode, s, e, frame_sql,
+          fns=("sum", "avg", "min", "max", "count")):
+    got = run(sess, frame_sql, fns)
+    for i, fn in enumerate(fns):
+        exp = oracle(sess._df, fn, mode, s, e)
+        g = got[f"c{i}"].astype(float).to_numpy()
+        np.testing.assert_allclose(g, exp, rtol=1e-9, atol=1e-9,
+                                   err_msg=f"{fn} {frame_sql}")
+
+
+def test_rows_preceding_current(sess):
+    check(sess, "rows", ("p", 2), ("cr", None),
+          "rows between 2 preceding and current row")
+
+
+def test_rows_single_bound_shorthand(sess):
+    check(sess, "rows", ("p", 3), ("cr", None), "rows 3 preceding")
+
+
+def test_rows_mixed_bounds(sess):
+    check(sess, "rows", ("p", 1), ("f", 2),
+          "rows between 1 preceding and 2 following")
+
+
+def test_rows_unbounded_to_following(sess):
+    check(sess, "rows", ("up", None), ("f", 1),
+          "rows between unbounded preceding and 1 following")
+
+
+def test_rows_current_to_unbounded(sess):
+    check(sess, "rows", ("cr", None), ("uf", None),
+          "rows between current row and unbounded following")
+
+
+def test_rows_empty_frames(sess):
+    check(sess, "rows", ("f", 3), ("f", 5),
+          "rows between 3 following and 5 following")
+    check(sess, "rows", ("p", 5), ("p", 3),
+          "rows between 5 preceding and 3 preceding")
+
+
+def test_range_offsets(sess):
+    check(sess, "range", ("p", 5), ("f", 5),
+          "range between 5 preceding and 5 following")
+    check(sess, "range", ("p", 7), ("cr", None),
+          "range between 7 preceding and current row")
+
+
+def test_range_unbounded_combo(sess):
+    check(sess, "range", ("up", None), ("f", 4),
+          "range between unbounded preceding and 4 following")
+
+
+def test_first_last_value_frames(sess):
+    got = run(sess, "rows between 1 preceding and 1 following",
+              fns=("first_value", "last_value"))
+    for i, fn in enumerate(("first_value", "last_value")):
+        exp = oracle(sess._df, fn, "rows", ("p", 1), ("f", 1))
+        g = got[f"c{i}"].astype(float).to_numpy()
+        both_nan = np.isnan(g) & np.isnan(exp)
+        np.testing.assert_allclose(
+            np.where(both_nan, 0, g), np.where(both_nan, 0, exp),
+            rtol=1e-9, err_msg=fn)
+
+
+def test_desc_order_rows_frame(sess):
+    r = sess.sql("""select g, k,
+        sum(v) over (partition by g order by k desc
+                     rows between 2 preceding and current row) s
+        from wf order by g, k""")
+    got = pd.DataFrame(r.rows(), columns=["g", "k", "s"])
+    # oracle: reverse each partition, rolling(3), reverse back
+    exp = []
+    for _, grp in sess._df.groupby("g", sort=True):
+        vals = grp["v"].to_numpy()[::-1]
+        roll = pd.Series(vals).rolling(3, min_periods=1).sum().to_numpy()[::-1]
+        exp.extend(roll)
+    exp = np.array(exp)
+    g = got["s"].astype(float).to_numpy()
+    both_nan = np.isnan(g) & np.isnan(exp)
+    np.testing.assert_allclose(np.where(both_nan, 0, g),
+                               np.where(both_nan, 0, exp), rtol=1e-9)
+
+
+def test_desc_order_range_frame(sess):
+    r = sess.sql("""select g, k,
+        sum(v) over (partition by g order by k desc
+                     range between 6 preceding and current row) s
+        from wf order by g, k""")
+    got = pd.DataFrame(r.rows(), columns=["g", "k", "s"])
+    exp = []
+    for _, grp in sess._df.groupby("g", sort=True):
+        vals = grp["v"].to_numpy()
+        keys = grp["k"].to_numpy()
+        for i in range(len(grp)):
+            # DESC: "6 preceding" = keys in [k_i, k_i + 6]
+            m = (keys >= keys[i]) & (keys <= keys[i] + 6)
+            w = vals[m]
+            w = w[~np.isnan(w)]
+            exp.append(w.sum() if len(w) else np.nan)
+    exp = np.array(exp)
+    g = got["s"].astype(float).to_numpy()
+    both_nan = np.isnan(g) & np.isnan(exp)
+    np.testing.assert_allclose(np.where(both_nan, 0, g),
+                               np.where(both_nan, 0, exp), rtol=1e-9)
+
+
+def test_running_sum_matches_explicit_default(sess):
+    """The explicit default frame must agree with the implicit one."""
+    a = sess.sql("""select sum(v) over (partition by g order by k) s
+                    from wf order by g, k""").rows()
+    b = sess.sql("""select sum(v) over (partition by g order by k
+        range between unbounded preceding and current row) s
+        from wf order by g, k""").rows()
+    ga = np.array([r[0] for r in a], dtype=float)
+    gb = np.array([r[0] for r in b], dtype=float)
+    both_nan = np.isnan(ga) & np.isnan(gb)
+    np.testing.assert_allclose(np.where(both_nan, 0, ga),
+                               np.where(both_nan, 0, gb), rtol=1e-12)
+
+
+def test_range_frame_decimal_key():
+    """RANGE offsets are user-unit even though DECIMAL keys are scaled ints."""
+    s = Session()
+    s.sql("create table wd (g int, k decimal(10, 2), v double)")
+    ks = [1.00, 1.25, 1.50, 3.00, 3.10, 9.99]
+    vs = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0]
+    s.sql("insert into wd values " + ", ".join(
+        f"(1, {k}, {v})" for k, v in zip(ks, vs)))
+    r = s.sql("""select k, sum(v) over (order by k
+        range between 0.5 preceding and current row) s
+        from wd order by k""")
+    got = [row[1] for row in r.rows()]
+    exp = []
+    for i, k in enumerate(ks):
+        exp.append(sum(v for kk, v in zip(ks, vs) if k - 0.5 <= kk <= k))
+    np.testing.assert_allclose(got, exp, rtol=1e-9)
+
+
+def test_frame_parse_errors():
+    with pytest.raises(ParseError):
+        parse("select sum(v) over (order by k rows between -1 preceding "
+              "and current row) from t")
+    with pytest.raises(ParseError):
+        parse("select sum(v) over (order by k rows 1.5 preceding) from t")
+    with pytest.raises(ParseError):
+        parse("select sum(v) over (partition by g rows 2 preceding) from t")
+    with pytest.raises(ParseError):
+        parse("select sum(v) over (order by k rows between current row "
+              "and 2 preceding) from t")
+    with pytest.raises(ParseError):
+        parse("select sum(v) over (order by k rows between unbounded "
+              "following and current row) from t")
+    with pytest.raises(ParseError):
+        parse("select rank() over (order by k rows 2 preceding) from t")
+    with pytest.raises(ParseError):
+        parse("select sum(v) over (order by k, g range between 2 preceding "
+              "and current row) from t")
