@@ -264,9 +264,13 @@ def run_suite(sf: float, repeats: int):
 
     # --- SSB flat (wide scan + predicate pushdown) --------------------------
     try:
+        # tests/ is not a package; its modules use bare sibling imports that
+        # resolve only with the directory itself on sys.path
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tests"))
         from starrocks_tpu.storage.datagen.ssb import ssb_catalog
-        from tests.ssb_queries import FLAT_QUERIES
-        from tests.test_ssb_sql import _oracle as ssb_oracle
+        from ssb_queries import FLAT_QUERIES
+        from test_ssb_sql import _oracle as ssb_oracle
 
         scat = ssb_catalog(sf=sf)
         ssess = Session(scat)

@@ -251,10 +251,11 @@ class Analyzer:
 
         if has_agg:
             plan, lowered_items, having, order_items = self._build_aggregate(
-                plan, group_exprs, lowered_items, having, order_items
+                plan, group_exprs, lowered_items, having, order_items,
+                grouping_mode=sel.rollup,
             )
             if sel.rollup:
-                plan = self._rollup_expand(plan)
+                plan = self._grouping_expand(plan, sel.rollup)
             if having is not None:
                 plan = LFilter(plan, having)
 
@@ -397,13 +398,17 @@ class Analyzer:
         if isinstance(e, Lit):
             return e
         if isinstance(e, WindowExpr):
+            # window args/keys may contain aggregates in a grouped query
+            # (e.g. avg(sum(x)) over (...)); the aggregate builder replaces
+            # them with refs to the aggregate's outputs
             arg = (
-                self._lower(e.arg, scope, ctes, allow_agg=False)
+                self._lower(e.arg, scope, ctes, allow_agg=allow_agg)
                 if e.arg is not None else None
             )
-            part = tuple(self._lower(p, scope, ctes, allow_agg=False) for p in e.partition_by)
+            part = tuple(self._lower(p, scope, ctes, allow_agg=allow_agg)
+                         for p in e.partition_by)
             order = tuple(
-                (self._lower(o, scope, ctes, allow_agg=False), asc, nf)
+                (self._lower(o, scope, ctes, allow_agg=allow_agg), asc, nf)
                 for o, asc, nf in e.order_by
             )
             return WindowExpr(e.fn, arg, part, order, e.offset, e.default,
@@ -449,6 +454,10 @@ class Analyzer:
                 raise AnalyzerError("IN subquery must produce one column")
             return SemiJoinMark(plan, corr, probe, inner[0], e.negated)
         if isinstance(e, ast.RawFunc):
+            if e.name == "grouping" and len(e.args) == 1:
+                # resolved to a 0/1 level marker by the aggregate builder
+                return Call("grouping",
+                            self._lower(e.args[0], scope, ctes, allow_agg=False))
             raise AnalyzerError(f"unknown function {e.name!r}")
         if isinstance(e, ast.Star):
             raise AnalyzerError("* only allowed as a top-level select item")
@@ -486,10 +495,12 @@ class Analyzer:
         return plan, corr
 
     # --- aggregates ----------------------------------------------------------
-    def _build_aggregate(self, plan, group_exprs, items, having, order_items):
+    def _build_aggregate(self, plan, group_exprs, items, having, order_items,
+                         grouping_mode=False):
         """Split select items into (pre-projection, aggregate, post-projection)."""
         aggs = {}
         pre = {}
+        grouping_refs = set()  # __grouping_i columns referenced via grouping()
 
         def agg_name(a: AggExpr) -> str:
             for n, existing in aggs.items():
@@ -513,6 +524,17 @@ class Analyzer:
                     return Col(gname)
             if isinstance(e, AggExpr):
                 return Col(agg_name(e))
+            if isinstance(e, Call) and e.fn == "grouping":
+                if not grouping_mode:
+                    return Lit(0)  # no ROLLUP/CUBE/SETS: always base level
+                arg = e.args[0]
+                for i, (gname, gexpr) in enumerate(group_named):
+                    if arg == gexpr or (isinstance(arg, Col)
+                                        and arg.name == gname):
+                        grouping_refs.add(f"__grouping_{i}")
+                        return Col(f"__grouping_{i}")
+                raise AnalyzerError(
+                    f"grouping() argument {arg!r} is not a GROUP BY key")
             if isinstance(e, Call):
                 return Call(e.fn, *[replace(a) for a in e.args])
             if isinstance(e, Case):
@@ -545,7 +567,7 @@ class Analyzer:
         new_order = [(replace(e), asc, nf) for e, asc, nf in order_items]
 
         # validate: non-agg select items must now only reference group keys/aggs
-        allowed = {n for n, _ in group_named} | set(aggs)
+        allowed = {n for n, _ in group_named} | set(aggs) | grouping_refs
         for n, e in new_items:
             for c in _cols_of(e):
                 if c not in allowed:
@@ -615,43 +637,90 @@ class Analyzer:
         new_order = [(subst(e), a, nf) for e, a, nf in order_items]
         return plan, new_items, new_order
 
-    def _rollup_expand(self, agg) -> LogicalPlan:
-        """GROUP BY ROLLUP(k1..kn) -> UNION ALL of n+1 levels, each
+    def _grouping_expand(self, agg, mode) -> LogicalPlan:
+        """GROUP BY ROLLUP/CUBE/GROUPING SETS -> UNION ALL of levels, each
         re-aggregated from the finest level (shared subtree; the physical
         emitters memoize node emission so the finest agg computes once).
-        Dropped keys become typed NULL columns via null_of()."""
+        Dropped keys become typed NULL columns via null_of(); every level
+        also emits __grouping_i 0/1 markers for grouping(). AVG splits into
+        sum+count at the base so coarser levels merge exactly.
+        Reference: fe-core/.../sql/ast/GroupByClause.java grouping types."""
         if not isinstance(agg, LAggregate) or not agg.group_by:
             return agg
-        for _, a in agg.aggs:
-            if a.fn == "avg":
-                raise AnalyzerError("AVG with ROLLUP is not supported yet")
+        n = len(agg.group_by)
+        if mode[0] == "rollup":
+            subsets = [tuple(range(k)) for k in range(n, -1, -1)]
+        elif mode[0] == "cube":
+            if n > 6:
+                raise AnalyzerError("CUBE over more than 6 keys")
+            subsets = [
+                tuple(i for i in range(n) if (mask >> i) & 1)
+                for mask in range((1 << n) - 1, -1, -1)
+            ]
+        else:  # ("sets", index-subsets)
+            subsets = [tuple(s) for s in mode[1]]
+            for s in subsets:
+                if any(not (0 <= i < n) for i in s):
+                    raise AnalyzerError("GROUPING SETS key out of range")
+
+        # split AVG into mergeable sum+count parts at the base level
+        base_aggs, avg_map = [], {}
+        for nm, a in agg.aggs:
             if a.distinct:
                 raise AnalyzerError(
-                    "DISTINCT aggregates with ROLLUP are not supported yet"
-                )
+                    "DISTINCT aggregates with ROLLUP/CUBE/GROUPING SETS "
+                    "are not supported yet")
+            if a.fn == "avg":
+                sn, cn = f"__avs_{nm}", f"__avc_{nm}"
+                base_aggs.append((sn, AggExpr("sum", a.arg)))
+                base_aggs.append((cn, AggExpr("count", a.arg)))
+                avg_map[nm] = (sn, cn)
+            else:
+                base_aggs.append((nm, a))
+        base = LAggregate(agg.child, agg.group_by, tuple(base_aggs))
 
         def merge_of(name, a):
             if a.fn in ("count", "count_star", "sum"):
                 return AggExpr("sum", Col(name))
             if a.fn in ("min", "max"):
                 return AggExpr(a.fn, Col(name))
-            raise AnalyzerError(f"{a.fn} with ROLLUP is not supported yet")
+            raise AnalyzerError(
+                f"{a.fn} with ROLLUP/CUBE/GROUPING SETS is not supported yet")
 
-        n = len(agg.group_by)
-        out_names = agg.output_names()
-        levels = [LProject(agg, tuple((nm, Col(nm)) for nm in out_names))]
-        for k in range(n - 1, -1, -1):
-            keep = agg.group_by[:k]
-            dropped = agg.group_by[k:]
-            sub_group = tuple((nm, Col(nm)) for nm, _ in keep)
-            sub_aggs = tuple(
-                (nm, merge_of(nm, a)) for nm, a in agg.aggs
-            ) + tuple((nm, AggExpr("min", Col(nm))) for nm, _ in dropped)
-            lvl = LAggregate(agg, sub_group, sub_aggs)
-            proj = (
-                tuple((nm, Col(nm)) for nm, _ in keep)
-                + tuple((nm, Call("null_of", Col(nm))) for nm, _ in dropped)
-                + tuple((nm, Col(nm)) for nm, _ in agg.aggs)
+        def avg_result(nm):
+            sn, cn = avg_map[nm]
+            from .. import types as T
+
+            return Call("divide", Cast(Col(sn), T.DOUBLE), Col(cn))
+
+        full = tuple(range(n))
+        levels = []
+        for subset in subsets:
+            sset = frozenset(subset)
+            if tuple(sorted(subset)) == full:
+                lvl = base
+            else:
+                sub_group = tuple(
+                    (nm, Col(nm))
+                    for i, (nm, _) in enumerate(agg.group_by) if i in sset)
+                dropped = [
+                    nm for i, (nm, _) in enumerate(agg.group_by)
+                    if i not in sset]
+                # dropped keys ride along (any value) so null_of() can type
+                # the NULL output columns
+                sub_aggs = tuple(
+                    (nm, merge_of(nm, a)) for nm, a in base_aggs
+                ) + tuple((nm, AggExpr("min", Col(nm))) for nm in dropped)
+                lvl = LAggregate(base, sub_group, sub_aggs)
+            proj = tuple(
+                (nm, Col(nm) if i in sset else Call("null_of", Col(nm)))
+                for i, (nm, _) in enumerate(agg.group_by)
+            ) + tuple(
+                (nm, avg_result(nm) if nm in avg_map else Col(nm))
+                for nm, _ in agg.aggs
+            ) + tuple(
+                (f"__grouping_{i}", Lit(0 if i in sset else 1))
+                for i in range(n)
             )
             levels.append(LProject(lvl, proj))
         return LUnion(tuple(levels))
@@ -683,6 +752,14 @@ def _contains_agg(e: Expr) -> bool:
         return _contains_agg(e.arg)
     if isinstance(e, InList):
         return _contains_agg(e.arg)
+    if isinstance(e, WindowExpr):
+        # an aggregate inside a window arg/key makes the query grouped
+        # (e.g. rank() over (order by sum(x)) with no GROUP BY)
+        return (
+            (e.arg is not None and _contains_agg(e.arg))
+            or any(_contains_agg(p) for p in e.partition_by)
+            or any(_contains_agg(o) for o, _, _ in e.order_by)
+        )
     return False
 
 

@@ -268,15 +268,45 @@ class Parser:
         rollup = False
         if self.accept_kw("group"):
             self.expect_kw("by")
-            if (self.peek().kind == "ident" and self.peek().value.lower() == "rollup"
-                    and self.peek(1).kind == "op" and self.peek(1).value == "("):
+            w = self.peek()
+            word = w.value.lower() if w.kind == "ident" else None
+            nxt = self.peek(1)
+            if (word in ("rollup", "cube")
+                    and nxt.kind == "op" and nxt.value == "("):
                 self.next()
                 self.next()
-                rollup = True
+                rollup = (word,)
                 g = [self.parse_expr()]
                 while self.accept_op(","):
                     g.append(self.parse_expr())
                 self.expect_op(")")
+            elif (word == "grouping" and nxt.kind == "ident"
+                    and nxt.value.lower() == "sets"):
+                self.next()
+                self.next()
+                self.expect_op("(")
+                set_exprs = []
+                while True:
+                    cur = []
+                    if self.accept_op("("):
+                        if not self.at_op(")"):
+                            cur.append(self.parse_expr())
+                            while self.accept_op(","):
+                                cur.append(self.parse_expr())
+                        self.expect_op(")")
+                    else:
+                        cur.append(self.parse_expr())
+                    set_exprs.append(tuple(cur))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                g = []
+                for se in set_exprs:
+                    for e in se:
+                        if e not in g:
+                            g.append(e)
+                rollup = ("sets", tuple(
+                    tuple(g.index(e) for e in se) for se in set_exprs))
             else:
                 g = [self.parse_expr()]
                 while self.accept_op(","):
@@ -612,7 +642,9 @@ class Parser:
             t.kind == "kw"
             and t.value in ("key", "primary", "update", "set", "delete",
                             "truncate", "tables", "show", "first", "last",
-                            "view", "materialized", "refresh")
+                            "view", "materialized", "refresh", "row", "rows",
+                            "range", "following", "unbounded", "preceding",
+                            "current")
         ):
             # func call / qualified col / bare col
             if self.peek(1).kind == "op" and self.peek(1).value == "(":
@@ -745,6 +777,10 @@ class Parser:
             if s[0] == "uf" or e[0] == "up" or rank[s[0]] > rank[e[0]]:
                 raise ParseError(
                     f"invalid frame bounds ({s[0]} .. {e[0]})")
+            if (s[0] == e[0] == "p" and s[1] < e[1]) or (
+                    s[0] == e[0] == "f" and s[1] > e[1]):
+                raise ParseError(
+                    "frame start must not be after frame end")
             if not order:
                 raise ParseError("a window frame requires ORDER BY")
             if (mode == "range"
