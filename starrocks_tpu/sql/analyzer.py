@@ -103,7 +103,11 @@ class Analyzer:
         ctes = dict(ctes)
         for name, sub in so.ctes:
             ctes[name.lower()] = sub
-        plans = [self._analyze_select(s, outer, ctes) for s in so.selects]
+        plans = [
+            self._analyze_setop(s, outer, ctes) if isinstance(s, ast.SetOp)
+            else self._analyze_select(s, outer, ctes)
+            for s in so.selects
+        ]
         arities = {len(p.output_names()) for p in plans}
         if len(arities) != 1:
             raise AnalyzerError(f"UNION inputs have different arities: {arities}")
@@ -117,9 +121,10 @@ class Analyzer:
                 ))
             )
         if so.kind in ("intersect", "except"):
-            if len(aligned) != 2:
-                raise AnalyzerError(f"{so.kind.upper()} chains of >2 inputs unsupported")
-            plan = self._setop_filtered(aligned, names, so.kind)
+            # left-associative n-ary chain: fold pairwise
+            plan = aligned[0]
+            for rhs in aligned[1:]:
+                plan = self._setop_filtered([plan, rhs], names, so.kind)
         else:
             plan = LUnion(tuple(aligned))
             if not so.all:
@@ -248,6 +253,11 @@ class Analyzer:
             or any(_contains_agg(e) for _, e in lowered_items)
             or (having is not None and _contains_agg(having))
         )
+        if not group_exprs and any(
+            isinstance(x, Call) and x.fn == "grouping"
+            for _, e in lowered_items for x in _walk_expr(e)
+        ):
+            raise AnalyzerError("grouping() requires GROUP BY")
 
         if has_agg:
             plan, lowered_items, having, order_items = self._build_aggregate(
@@ -455,6 +465,10 @@ class Analyzer:
             return SemiJoinMark(plan, corr, probe, inner[0], e.negated)
         if isinstance(e, ast.RawFunc):
             if e.name == "grouping" and len(e.args) == 1:
+                if not allow_agg:
+                    raise AnalyzerError(
+                        "grouping() is only allowed in grouped select "
+                        "items / HAVING / ORDER BY")
                 # resolved to a 0/1 level marker by the aggregate builder
                 return Call("grouping",
                             self._lower(e.args[0], scope, ctes, allow_agg=False))

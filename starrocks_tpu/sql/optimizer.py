@@ -639,6 +639,27 @@ def _apply_marker(outer_plan: LogicalPlan, conjunct: Expr, catalog) -> LogicalPl
     #   expr CMP (select agg(...) from ... where inner = @outer.col ...)
     marker = _find_scalar_marker(conjunct)
     if marker is None:
+        marks = _find_semijoin_marks(conjunct)
+        if marks:
+            if _mark_under_not(conjunct):
+                # not(x in (sub)) under OR would need true NOT IN NULL
+                # semantics, which the is-not-null mark flag cannot express
+                raise NotImplementedError(
+                    "negated subquery inside a general predicate is not "
+                    "supported yet")
+            # Case C: mark join — EXISTS/IN embedded in a general predicate
+            # (typically under OR, e.g. TPC-DS Q45). LEFT-join distinct
+            # subquery keys and substitute the mark with IS NOT NULL on the
+            # joined key, then restore the outer schema.
+            plan = outer_plan
+            repl = {}
+            for idx, m in enumerate(marks):
+                plan, flag = _apply_mark_join(plan, m, idx, catalog)
+                repl[id(m)] = flag
+            new_pred = _subst_marks(conjunct, repl)
+            filtered = LFilter(plan, new_pred)
+            keep = tuple((n, Col(n)) for n in outer_plan.output_names())
+            return LProject(filtered, keep)
         raise NotImplementedError(f"unsupported subquery pattern: {conjunct!r}")
     if not marker.correlated:
         # uncorrelated scalar: leave in place; the executor evaluates it first
@@ -679,6 +700,117 @@ def _apply_marker(outer_plan: LogicalPlan, conjunct: Expr, catalog) -> LogicalPl
     # drop the helper columns again
     keep = tuple((n, Col(n)) for n in outer_plan.output_names())
     return LProject(filtered, keep)
+
+
+def _mark_under_not(e: Expr, under_not: bool = False) -> bool:
+    """True when any SemiJoinMark sits beneath a NOT (any depth)."""
+    if isinstance(e, SemiJoinMark):
+        return under_not
+    if isinstance(e, Call):
+        inner = under_not or e.fn == "not"
+        return any(_mark_under_not(a, inner) for a in e.args)
+    if isinstance(e, Cast):
+        return _mark_under_not(e.arg, under_not)
+    if isinstance(e, Case):
+        return any(
+            _mark_under_not(c, under_not) or _mark_under_not(v, under_not)
+            for c, v in e.whens
+        ) or (e.orelse is not None and _mark_under_not(e.orelse, under_not))
+    if isinstance(e, InList):
+        return _mark_under_not(e.arg, under_not)
+    return False
+
+
+def _find_semijoin_marks(e: Expr, out=None):
+    if out is None:
+        out = []
+    if isinstance(e, SemiJoinMark):
+        out.append(e)
+    elif isinstance(e, Call):
+        for a in e.args:
+            _find_semijoin_marks(a, out)
+    elif isinstance(e, Cast):
+        _find_semijoin_marks(e.arg, out)
+    elif isinstance(e, Case):
+        for c, v in e.whens:
+            _find_semijoin_marks(c, out)
+            _find_semijoin_marks(v, out)
+        if e.orelse is not None:
+            _find_semijoin_marks(e.orelse, out)
+    elif isinstance(e, InList):
+        _find_semijoin_marks(e.arg, out)
+    return out
+
+
+def _subst_marks(e: Expr, repl: dict) -> Expr:
+    if isinstance(e, SemiJoinMark):
+        flag = repl.get(id(e))
+        if flag is not None:
+            return Call("is_not_null", Col(flag))
+        return e
+    if isinstance(e, Call):
+        return Call(e.fn, *[_subst_marks(a, repl) for a in e.args])
+    if isinstance(e, Cast):
+        return Cast(_subst_marks(e.arg, repl), e.to)
+    if isinstance(e, Case):
+        return Case(
+            tuple((_subst_marks(c, repl), _subst_marks(v, repl))
+                  for c, v in e.whens),
+            _subst_marks(e.orelse, repl) if e.orelse is not None else None,
+        )
+    if isinstance(e, InList):
+        return InList(_subst_marks(e.arg, repl), e.values, e.negated)
+    return e
+
+
+def _apply_mark_join(outer_plan: LogicalPlan, m: SemiJoinMark, idx: int,
+                     catalog):
+    """LEFT-join the subquery's distinct key columns onto the outer plan;
+    the last joined key doubles as the match flag (non-NULL = matched).
+    Returns (joined_plan, flag_column_name). Reference analog: the CBO's
+    mark-join for disjunctive subqueries."""
+    if m.negated:
+        raise NotImplementedError(
+            "NOT IN / NOT EXISTS inside OR is not supported yet")
+    removed: list = []
+    sub = _strip_correlation(m.plan, removed)
+    sub = rewrite_full_joins(sub)
+    sub = rewrite_distinct_aggs(sub)
+    sub = rewrite_subqueries(sub, catalog)
+    corr_set = set(m.correlated)
+    for c in removed:
+        ok = (
+            isinstance(c, Call) and c.fn == "eq" and len(c.args) == 2
+            and isinstance(c.args[0], Col) and isinstance(c.args[1], Col)
+            and (
+                (c.args[0].name[len("@outer."):], c.args[1].name) in corr_set
+                or (c.args[1].name[len("@outer."):], c.args[0].name)
+                in corr_set
+            )
+        )
+        if not ok:
+            raise NotImplementedError(
+                "non-equi correlated predicate in a subquery inside OR")
+    inner_names = [ic for _, ic in m.correlated]
+    if m.inner_col is not None and m.inner_col not in inner_names:
+        inner_names.append(m.inner_col)
+    if not inner_names:
+        raise NotImplementedError("uncorrelated EXISTS inside OR")
+    sub = _expose_columns(sub, inner_names)
+    renames = {ic: f"__mark{idx}_{j}" for j, ic in enumerate(inner_names)}
+    # distinct keys (renamed to collision-proof mark columns) so the LEFT
+    # join cannot duplicate outer rows
+    sub = LAggregate(
+        sub, tuple((renames[ic], Col(ic)) for ic in inner_names), ())
+    outer_keys = [Col(oc) for oc, _ in m.correlated]
+    inner_keys = [Col(renames[ic]) for _, ic in m.correlated]
+    if m.probe_expr is not None:
+        outer_keys.append(m.probe_expr)
+        inner_keys.append(Col(renames[m.inner_col]))
+    cond = and_all(
+        [Call("eq", ok_, ik) for ok_, ik in zip(outer_keys, inner_keys)])
+    joined = LJoin(outer_plan, sub, "left", cond)
+    return joined, inner_keys[-1].name
 
 
 def _find_scalar_marker(e: Expr):

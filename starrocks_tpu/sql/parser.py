@@ -118,7 +118,7 @@ class Parser:
             self.next()
             analyze = self.accept_kw("analyze")
             return ast.Explain(self.parse_statement(), analyze)
-        if self.at_kw("select", "with"):
+        if self.at_kw("select", "with") or self._at_paren_select():
             s = self.parse_select()
             self.accept_op(";")
             return s
@@ -202,14 +202,41 @@ class Parser:
         return name
 
     # --- SELECT --------------------------------------------------------------
+    def _at_paren_select(self) -> bool:
+        """True at '(' whose first non-'(' token is SELECT/WITH (a
+        parenthesized select / set-op chain)."""
+        if not self.at_op("("):
+            return False
+        k = 0
+        while self.peek(k).kind == "op" and self.peek(k).value == "(":
+            k += 1
+        return (self.peek(k).kind == "kw"
+                and self.peek(k).value in ("select", "with"))
+
+    def _parse_set_operand(self):
+        """One operand of a set-op chain: a SELECT core, or a parenthesized
+        select/chain. Returns (node, was_parenthesized)."""
+        if self._at_paren_select():
+            self.next()
+            sub = self.parse_select()
+            self.expect_op(")")
+            return sub, True
+        return self.parse_select_core(), False
+
     def parse_select(self):
         """SELECT core optionally followed by UNION [ALL] chains."""
-        first = self.parse_select_core()
+        first, first_paren = self._parse_set_operand()
         if not self.at_kw("union", "intersect", "except"):
+            if first_paren and self.at_kw("order", "limit"):
+                # (select ...) order by ... — hoist trailing clauses
+                order_by, limit, offset = self._parse_trailing_order_limit()
+                return ast.SetOp((first,), True, "union", order_by, limit,
+                                 offset, first.ctes)
             return first
         selects = [first]
         all_flags = []
         kinds = []
+        last_paren = first_paren
         while self.at_kw("union", "intersect", "except"):
             kinds.append(self.next().value)
             if kinds[-1] == "union":
@@ -221,22 +248,48 @@ class Parser:
                         "semantics); use plain " + kinds[-1].upper()
                     )
                 all_flags.append(False)
-            selects.append(self.parse_select_core())
+            s, last_paren = self._parse_set_operand()
+            selects.append(s)
         if len(set(kinds)) > 1:
             raise ParseError("mixing UNION/INTERSECT/EXCEPT is unsupported")
         if kinds[0] == "union" and len(set(all_flags)) > 1:
             raise ParseError("mixing UNION and UNION ALL is unsupported")
-        # order/limit parsed into the LAST core bind to the whole union
-        last = selects[-1]
-        order_by, limit, offset = last.order_by, last.limit, last.offset
-        selects[-1] = ast.Select(
-            last.items, last.from_, last.where, last.group_by, last.having,
-            (), None, 0, last.distinct, last.ctes, last.rollup,
-        )
+        if last_paren:
+            # parenthesized last operand keeps its own clauses; outer
+            # ORDER BY / LIMIT may follow the chain
+            order_by, limit, offset = self._parse_trailing_order_limit()
+        else:
+            # order/limit parsed into the LAST core bind to the whole chain
+            last = selects[-1]
+            order_by, limit, offset = last.order_by, last.limit, last.offset
+            selects[-1] = ast.Select(
+                last.items, last.from_, last.where, last.group_by,
+                last.having, (), None, 0, last.distinct, last.ctes,
+                last.rollup,
+            )
         return ast.SetOp(
             tuple(selects), all_flags[0], kinds[0], order_by, limit, offset,
             selects[0].ctes,
         )
+
+    def _parse_trailing_order_limit(self):
+        order_by = ()
+        limit = None
+        offset = 0
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            o = [self.parse_order_item()]
+            while self.accept_op(","):
+                o.append(self.parse_order_item())
+            order_by = tuple(o)
+        if self.accept_kw("limit"):
+            limit = int(self.next().value)
+            if self.accept_op(","):
+                offset = limit
+                limit = int(self.next().value)
+            elif self.accept_kw("offset"):
+                offset = int(self.next().value)
+        return order_by, limit, offset
 
     def parse_select_core(self) -> ast.Select:
         ctes = ()
@@ -315,22 +368,7 @@ class Parser:
         having = None
         if self.accept_kw("having"):
             having = self.parse_expr()
-        order_by = ()
-        if self.accept_kw("order"):
-            self.expect_kw("by")
-            o = [self.parse_order_item()]
-            while self.accept_op(","):
-                o.append(self.parse_order_item())
-            order_by = tuple(o)
-        limit = None
-        offset = 0
-        if self.accept_kw("limit"):
-            limit = int(self.next().value)
-            if self.accept_op(","):
-                offset = limit
-                limit = int(self.next().value)
-            elif self.accept_kw("offset"):
-                offset = int(self.next().value)
+        order_by, limit, offset = self._parse_trailing_order_limit()
         return ast.Select(
             tuple(items), from_, where, group_by, having, tuple(order_by),
             limit, offset, distinct, ctes, rollup,
@@ -416,7 +454,9 @@ class Parser:
 
     def parse_table_primary(self):
         if self.accept_op("("):
-            if self.at_kw("select", "with"):
+            # "((select" starts a parenthesized set-op chain, not a
+            # parenthesized join
+            if self.at_kw("select", "with") or self._at_paren_select():
                 sub = self.parse_select()
                 self.expect_op(")")
                 self.accept_kw("as")

@@ -432,7 +432,10 @@ def gen_tpcds(sf: float = 0.01, seed: int = 11) -> dict:
     # --- catalog_sales + catalog_returns ------------------------------------
     ncs = max(int(1_440_000 * sf), 1000)
     f = base_fact(ncs)
-    cs_order = np.arange(1, ncs + 1, dtype=np.int64)
+    # ~3 lines per order (multi-warehouse orders make Q16-style
+    # EXISTS-other-line predicates non-degenerate)
+    cs_order = np.sort(rng.integers(1, max(ncs // 3, 10) + 1, ncs)
+                       ).astype(np.int64)
     cs_cc = rng.integers(1, ncc + 1, ncs).astype(np.int64)
     out["catalog_sales"] = HostTable.from_pydict(
         {
@@ -498,7 +501,8 @@ def gen_tpcds(sf: float = 0.01, seed: int = 11) -> dict:
     # --- web_sales + web_returns --------------------------------------------
     nws = max(int(720_000 * sf), 600)
     f = base_fact(nws)
-    ws_order = np.arange(1, nws + 1, dtype=np.int64)
+    ws_order = np.sort(rng.integers(1, max(nws // 3, 10) + 1, nws)
+                       ).astype(np.int64)
     out["web_sales"] = HostTable.from_pydict(
         {
             "ws_sold_date_sk": f["date_sk"],

@@ -47,16 +47,16 @@ def _norm(v):
     return v
 
 
-def _key(row):
-    out = []
-    for v in row:
-        if v is None:
-            out.append((0, ""))
-        elif _is_float(v) or isinstance(v, float):
-            out.append((1, round(float(v), 1)))
-        else:
-            out.append((2, str(v)))
-    return tuple(out)
+def _nf_key(row):
+    """Bucket key from the non-float cells only (floats masked): rows are
+    aligned exactly on discrete cells, then floats matched by sorted order
+    within the bucket — no rounding-boundary misalignment."""
+    return tuple("\0f" if _is_float(v) else (v if v is not None else "\0n")
+                 for v in row)
+
+
+def _floats_of(row):
+    return tuple(float(v) for v in row if _is_float(v))
 
 
 def compare(got_rows, exp_df, limit=None):
@@ -68,16 +68,29 @@ def compare(got_rows, exp_df, limit=None):
             "tighten the query's filters so truncation can't be ambiguous")
     got_rows = [tuple(_norm(v) for v in r) for r in got_rows]
     assert len(got_rows) == len(exp_rows), (len(got_rows), len(exp_rows))
-    for g, e in zip(sorted(got_rows, key=_key), sorted(exp_rows, key=_key)):
-        assert len(g) == len(e)
-        for gv, ev in zip(g, e):
-            if gv is None or ev is None:
-                assert gv is None and ev is None, (g, e)
-            elif _is_float(gv) or _is_float(ev):
-                assert np.isclose(float(gv), float(ev),
-                                  rtol=1e-6, atol=1e-2), (g, e)
-            else:
-                assert gv == ev, (g, e)
+    from collections import defaultdict
+
+    gb, eb = defaultdict(list), defaultdict(list)
+    for r in got_rows:
+        gb[_nf_key(r)].append(r)
+    for r in exp_rows:
+        eb[_nf_key(r)].append(r)
+    assert set(gb) == set(eb), (
+        f"row-key mismatch: only-got={list(set(gb) - set(eb))[:3]} "
+        f"only-exp={list(set(eb) - set(gb))[:3]}")
+    for k, grows in gb.items():
+        erows = eb[k]
+        assert len(grows) == len(erows), (k, len(grows), len(erows))
+        for g, e in zip(sorted(grows, key=_floats_of),
+                        sorted(erows, key=_floats_of)):
+            for gv, ev in zip(g, e):
+                if gv is None or ev is None:
+                    assert gv is None and ev is None, (g, e)
+                elif _is_float(gv) or _is_float(ev):
+                    assert np.isclose(float(gv), float(ev),
+                                      rtol=1e-6, atol=1e-2), (g, e)
+                else:
+                    assert gv == ev, (g, e)
 
 
 def run(env, qid, oracle, limit=100):
@@ -478,3 +491,408 @@ def test_q36(env):
         return g[["gross_margin", "i_category", "i_class", "lochierarchy",
                   "rank_within_parent"]]
     run(env, "q36", oracle, limit=10000)
+
+
+# --- EXISTS / set-ops / correlated-scalar family ----------------------------
+
+def test_q16(env):
+    def oracle(F):
+        cs, cr, dd = F["catalog_sales"], F["catalog_returns"], F["date_dim"]
+        multi_wh = cs.groupby("cs_order_number").cs_warehouse_sk.nunique()
+        multi_wh = set(multi_wh[multi_wh > 1].index)
+        returned = set(cr.cr_order_number)
+        x = (cs.merge(dd[(dd.d_date >= pd.Timestamp("2002-02-01"))
+                         & (dd.d_date <= pd.Timestamp("2002-04-02"))],
+                      left_on="cs_ship_date_sk", right_on="d_date_sk")
+             .merge(F["customer_address"][
+                 F["customer_address"].ca_state == "GA"],
+                 left_on="cs_bill_addr_sk", right_on="ca_address_sk")
+             .merge(F["call_center"], left_on="cs_call_center_sk",
+                    right_on="cc_call_center_sk"))
+        x = x[x.cs_order_number.isin(multi_wh)
+              & ~x.cs_order_number.isin(returned)]
+        return pd.DataFrame([{
+            "order_count": x.cs_order_number.nunique(),
+            "total_shipping_cost": x.cs_ext_list_price.sum(min_count=1),
+            "total_net_profit": x.cs_net_profit.sum(min_count=1)}])
+    run(env, "q16", oracle)
+
+
+def test_q94(env):
+    def oracle(F):
+        ws, wr, dd = F["web_sales"], F["web_returns"], F["date_dim"]
+        multi_wh = ws.groupby("ws_order_number").ws_warehouse_sk.nunique()
+        multi_wh = set(multi_wh[multi_wh > 1].index)
+        returned = set(wr.wr_order_number)
+        web = F["web_site"]
+        x = (ws.merge(dd[(dd.d_date >= pd.Timestamp("1999-02-01"))
+                         & (dd.d_date <= pd.Timestamp("1999-04-02"))],
+                      left_on="ws_ship_date_sk", right_on="d_date_sk")
+             .merge(F["customer_address"][
+                 F["customer_address"].ca_state == "IL"],
+                 left_on="ws_bill_addr_sk", right_on="ca_address_sk")
+             .merge(web[web.web_company_name == "pri0"],
+                    left_on="ws_web_site_sk", right_on="web_site_sk"))
+        x = x[x.ws_order_number.isin(multi_wh)
+              & ~x.ws_order_number.isin(returned)]
+        return pd.DataFrame([{
+            "order_count": x.ws_order_number.nunique(),
+            "total_shipping_cost": x.ws_ext_list_price.sum(min_count=1),
+            "total_net_profit": x.ws_net_profit.sum(min_count=1)}])
+    run(env, "q94", oracle)
+
+
+def test_q20(env):
+    run(env, "q20",
+        lambda F: _ratio_oracle(F, "catalog_sales", "cs", "cs_sold_date_sk",
+                                "cs_item_sk", "cs_ext_sales_price"))
+
+
+def test_q25(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        d1 = dd[(dd.d_moy == 4) & (dd.d_year == 2000)]
+        d23 = dd[dd.d_moy.between(4, 10) & (dd.d_year == 2000)]
+        x = (F["store_sales"]
+             .merge(d1[["d_date_sk"]], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(F["item"], left_on="ss_item_sk", right_on="i_item_sk")
+             .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(F["store_returns"],
+                    left_on=["ss_customer_sk", "ss_item_sk",
+                             "ss_ticket_number"],
+                    right_on=["sr_customer_sk", "sr_item_sk",
+                              "sr_ticket_number"])
+             .merge(d23[["d_date_sk"]].rename(
+                 columns={"d_date_sk": "d2sk"}),
+                 left_on="sr_returned_date_sk", right_on="d2sk")
+             .merge(F["catalog_sales"],
+                    left_on=["sr_customer_sk", "sr_item_sk"],
+                    right_on=["cs_bill_customer_sk", "cs_item_sk"])
+             .merge(d23[["d_date_sk"]].rename(
+                 columns={"d_date_sk": "d3sk"}),
+                 left_on="cs_sold_date_sk", right_on="d3sk"))
+        return x.groupby(
+            ["i_item_id", "i_item_desc", "s_store_id", "s_store_name"],
+            as_index=False).agg(
+                store_sales_profit=("ss_net_profit", "sum"),
+                store_returns_loss=("sr_net_loss", "sum"),
+                catalog_sales_profit=("cs_net_profit", "sum"))
+    run(env, "q25", oracle)
+
+
+def _discount_oracle(F, fact, item_col, date_col, amt_col):
+    dd = F["date_dim"]
+    win = dd[(dd.d_date >= pd.Timestamp("2000-01-27"))
+             & (dd.d_date <= pd.Timestamp("2000-04-26"))]
+    s = F[fact].merge(win[["d_date_sk"]], left_on=date_col,
+                      right_on="d_date_sk")
+    thresh = 1.3 * s.groupby(item_col)[amt_col].transform("mean")
+    it = F["item"]
+    picked = s[(s[amt_col] > thresh)
+               & s[item_col].isin(it[it.i_manufact_id == 7].i_item_sk)]
+    return pd.DataFrame([{
+        "excess_discount_amount": picked[amt_col].sum(min_count=1)}])
+
+
+def test_q32(env):
+    run(env, "q32", lambda F: _discount_oracle(
+        F, "catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+        "cs_ext_discount_amt"))
+
+
+def test_q92(env):
+    run(env, "q92", lambda F: _discount_oracle(
+        F, "web_sales", "ws_item_sk", "ws_sold_date_sk",
+        "ws_ext_discount_amt"))
+
+
+def _inv_item_oracle(F, fact, item_col, lo, hi, d_lo, d_hi):
+    dd, it = F["date_dim"], F["item"]
+    cand = it[(it.i_current_price.between(lo, hi))
+              & it.i_manufact_id.isin(range(1, 9))]
+    x = (F["inventory"]
+         .merge(cand, left_on="inv_item_sk", right_on="i_item_sk")
+         .merge(dd[(dd.d_date >= pd.Timestamp(d_lo))
+                   & (dd.d_date <= pd.Timestamp(d_hi))],
+                left_on="inv_date_sk", right_on="d_date_sk"))
+    x = x[x.inv_quantity_on_hand.between(100, 500)]
+    sold = set(F[fact][item_col])
+    x = x[x.i_item_sk.isin(sold)]
+    return x[["i_item_id", "i_item_desc", "i_current_price"]
+             ].drop_duplicates()
+
+
+def test_q37(env):
+    run(env, "q37", lambda F: _inv_item_oracle(
+        F, "catalog_sales", "cs_item_sk", 20, 50,
+        "2000-02-01", "2000-04-01"))
+
+
+def test_q82(env):
+    run(env, "q82", lambda F: _inv_item_oracle(
+        F, "store_sales", "ss_item_sk", 30, 60,
+        "2000-05-25", "2000-07-24"))
+
+
+def _channel_cust_dates(F, fact, date_col, cust_col):
+    dd = F["date_dim"]
+    x = (F[fact]
+         .merge(dd[dd.d_month_seq.between(24, 35)],
+                left_on=date_col, right_on="d_date_sk")
+         .merge(F["customer"], left_on=cust_col, right_on="c_customer_sk"))
+    return set(map(tuple, x[["c_last_name", "c_first_name", "d_date"]
+                            ].itertuples(index=False)))
+
+
+def test_q38(env):
+    def oracle(F):
+        a = _channel_cust_dates(F, "store_sales", "ss_sold_date_sk",
+                                "ss_customer_sk")
+        b = _channel_cust_dates(F, "catalog_sales", "cs_sold_date_sk",
+                                "cs_bill_customer_sk")
+        c = _channel_cust_dates(F, "web_sales", "ws_sold_date_sk",
+                                "ws_bill_customer_sk")
+        return pd.DataFrame([{"cnt": len(a & b & c)}])
+    run(env, "q38", oracle)
+
+
+def test_q87(env):
+    def oracle(F):
+        a = _channel_cust_dates(F, "store_sales", "ss_sold_date_sk",
+                                "ss_customer_sk")
+        b = _channel_cust_dates(F, "catalog_sales", "cs_sold_date_sk",
+                                "cs_bill_customer_sk")
+        c = _channel_cust_dates(F, "web_sales", "ws_sold_date_sk",
+                                "ws_bill_customer_sk")
+        return pd.DataFrame([{"cnt": len(a - b - c)}])
+    run(env, "q87", oracle)
+
+
+def test_q45(env):
+    def oracle(F):
+        dd, it = F["date_dim"], F["item"]
+        zips = {"85669", "86197", "88274", "83405", "86475",
+                "85392", "85460", "80348", "81792"}
+        ids = set(it[it.i_item_sk.isin(
+            [2, 3, 5, 7, 11, 13, 17, 19, 23])].i_item_id)
+        x = (F["web_sales"]
+             .merge(F["customer"], left_on="ws_bill_customer_sk",
+                    right_on="c_customer_sk")
+             .merge(F["customer_address"], left_on="c_current_addr_sk",
+                    right_on="ca_address_sk")
+             .merge(it, left_on="ws_item_sk", right_on="i_item_sk")
+             .merge(dd[(dd.d_qoy == 2) & (dd.d_year == 2001)],
+                    left_on="ws_sold_date_sk", right_on="d_date_sk"))
+        x = x[x.ca_zip.str[:5].isin(zips) | x.i_item_id.isin(ids)]
+        return x.groupby(["ca_zip", "ca_city"], as_index=False)[
+            "ws_sales_price"].sum()
+    run(env, "q45", oracle)
+
+
+def test_q50(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        d2 = dd[(dd.d_year == 2001) & (dd.d_moy == 8)]
+        x = (F["store_sales"]
+             .merge(F["store_returns"],
+                    left_on=["ss_ticket_number", "ss_item_sk",
+                             "ss_customer_sk"],
+                    right_on=["sr_ticket_number", "sr_item_sk",
+                              "sr_customer_sk"])
+             .merge(d2[["d_date_sk"]], left_on="sr_returned_date_sk",
+                    right_on="d_date_sk")
+             .merge(F["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk"))
+        d = x.sr_returned_date_sk - x.ss_sold_date_sk
+        x = x.assign(d30=(d <= 30).astype(int),
+                     d60=((d > 30) & (d <= 60)).astype(int),
+                     d90=((d > 60) & (d <= 90)).astype(int),
+                     d120=(d > 90).astype(int))
+        return x.groupby(["s_store_name", "s_store_id", "s_state"],
+                         as_index=False)[["d30", "d60", "d90", "d120"]].sum()
+    run(env, "q50", oracle)
+
+
+def test_q61(env):
+    def oracle(F):
+        dd, st, it = F["date_dim"], F["store"], F["item"]
+        base = (F["store_sales"]
+                .merge(dd[(dd.d_year == 1998) & (dd.d_moy == 11)],
+                       left_on="ss_sold_date_sk", right_on="d_date_sk")
+                .merge(st[st.s_gmt_offset == -5.0],
+                       left_on="ss_store_sk", right_on="s_store_sk")
+                .merge(F["customer"], left_on="ss_customer_sk",
+                       right_on="c_customer_sk")
+                .merge(F["customer_address"][
+                    F["customer_address"].ca_gmt_offset == -5.0],
+                    left_on="c_current_addr_sk", right_on="ca_address_sk")
+                .merge(it[it.i_category == "Jewelry"],
+                       left_on="ss_item_sk", right_on="i_item_sk"))
+        p = F["promotion"]
+        promo = p[(p.p_channel_dmail == "Y") | (p.p_channel_email == "Y")
+                  | (p.p_channel_tv == "Y")]
+        promos = base.merge(promo, left_on="ss_promo_sk",
+                            right_on="p_promo_sk").ss_ext_sales_price.sum()
+        total = base.ss_ext_sales_price.sum()
+        return pd.DataFrame([{
+            "promotions": promos, "total": total,
+            "ratio": promos / total * 100}])
+    run(env, "q61", oracle)
+
+
+def test_q65(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        x = F["store_sales"].merge(
+            dd[dd.d_month_seq.between(24, 35)],
+            left_on="ss_sold_date_sk", right_on="d_date_sk")
+        sa = x.groupby(["ss_store_sk", "ss_item_sk"], as_index=False)[
+            "ss_sales_price"].sum().rename(
+                columns={"ss_sales_price": "revenue"})
+        sb = sa.groupby("ss_store_sk", as_index=False).revenue.mean(
+            ).rename(columns={"revenue": "ave"})
+        sc = sa.merge(sb, on="ss_store_sk")
+        sc = sc[sc.revenue <= 0.1 * sc.ave]
+        out = (sc.merge(F["store"], left_on="ss_store_sk",
+                        right_on="s_store_sk")
+               .merge(F["item"], left_on="ss_item_sk",
+                      right_on="i_item_sk"))
+        return out[["s_store_name", "i_item_desc", "revenue",
+                    "i_current_price", "i_brand"]]
+    run(env, "q65", oracle)
+
+
+def test_q68(env):
+    def oracle(F):
+        dd, st, hd = F["date_dim"], F["store"], F["household_demographics"]
+        x = (F["store_sales"]
+             .merge(dd[dd.d_dom.between(1, 2)
+                       & dd.d_year.isin([1999, 2000, 2001])],
+                    left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(st[st.s_city.isin(["Midway", "Fairview"])],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(hd[(hd.hd_dep_count == 4) | (hd.hd_vehicle_count == 3)],
+                    left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+             .merge(F["customer_address"], left_on="ss_addr_sk",
+                    right_on="ca_address_sk"))
+        dn = x.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                        "ca_city"], as_index=False).agg(
+            extended_price=("ss_ext_sales_price", "sum"),
+            list_price=("ss_ext_list_price", "sum"),
+            extended_tax=("ss_ext_tax", "sum")).rename(
+                columns={"ca_city": "bought_city"})
+        out = (dn.merge(F["customer"], left_on="ss_customer_sk",
+                        right_on="c_customer_sk")
+               .merge(F["customer_address"], left_on="c_current_addr_sk",
+                      right_on="ca_address_sk"))
+        out = out[out.ca_city != out.bought_city]
+        out = out.sort_values(["c_last_name", "ss_ticket_number"]).head(100)
+        return out[["c_last_name", "c_first_name", "ca_city", "bought_city",
+                    "ss_ticket_number", "extended_price", "extended_tax",
+                    "list_price"]]
+    run(env, "q68", oracle, limit=None)
+
+
+def test_q69(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        win = dd[(dd.d_year == 2001) & dd.d_moy.between(4, 6)]
+        ss_c = set(F["store_sales"].merge(
+            win[["d_date_sk"]], left_on="ss_sold_date_sk",
+            right_on="d_date_sk").ss_customer_sk)
+        ws_c = set(F["web_sales"].merge(
+            win[["d_date_sk"]], left_on="ws_sold_date_sk",
+            right_on="d_date_sk").ws_bill_customer_sk)
+        cs_c = set(F["catalog_sales"].merge(
+            win[["d_date_sk"]], left_on="cs_sold_date_sk",
+            right_on="d_date_sk").cs_bill_customer_sk)
+        c = (F["customer"]
+             .merge(F["customer_address"][
+                 F["customer_address"].ca_state.isin(["KS", "GA", "NY"])],
+                 left_on="c_current_addr_sk", right_on="ca_address_sk")
+             .merge(F["customer_demographics"], left_on="c_current_cdemo_sk",
+                    right_on="cd_demo_sk"))
+        c = c[c.c_customer_sk.isin(ss_c)
+              & ~c.c_customer_sk.isin(ws_c)
+              & ~c.c_customer_sk.isin(cs_c)]
+        g = c.groupby(["cd_gender", "cd_marital_status",
+                       "cd_education_status", "cd_purchase_estimate",
+                       "cd_credit_rating"], as_index=False).size()
+        g["cnt1"] = g["size"]
+        return g[["cd_gender", "cd_marital_status", "cd_education_status",
+                  "cnt1", "cd_purchase_estimate", "size",
+                  "cd_credit_rating"]].assign(cnt3=g["size"])[
+            ["cd_gender", "cd_marital_status", "cd_education_status",
+             "cnt1", "cd_purchase_estimate", "size", "cd_credit_rating",
+             "cnt3"]]
+    run(env, "q69", oracle)
+
+
+def test_q79(env):
+    def oracle(F):
+        dd, st, hd = F["date_dim"], F["store"], F["household_demographics"]
+        x = (F["store_sales"]
+             .merge(dd[(dd.d_dow == 1) & dd.d_year.isin([1999, 2000, 2001])],
+                    left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(st[st.s_number_employees.between(200, 295)],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(hd[(hd.hd_dep_count == 6) | (hd.hd_vehicle_count > 2)],
+                    left_on="ss_hdemo_sk", right_on="hd_demo_sk"))
+        ms = x.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                        "s_city"], as_index=False).agg(
+            amt=("ss_coupon_amt", "sum"), profit=("ss_net_profit", "sum"))
+        out = ms.merge(F["customer"], left_on="ss_customer_sk",
+                       right_on="c_customer_sk")
+        out["city30"] = out.s_city.str[:30]
+        out = out.sort_values(
+            ["c_last_name", "c_first_name", "city30", "profit",
+             "ss_ticket_number"]).head(100)
+        return out[["c_last_name", "c_first_name", "city30",
+                    "ss_ticket_number", "amt", "profit"]]
+    run(env, "q79", oracle, limit=None)
+
+
+def test_q88(env):
+    def oracle(F):
+        td, hd, st = (F["time_dim"], F["household_demographics"], F["store"])
+        hdm = hd[((hd.hd_dep_count == 4) & (hd.hd_vehicle_count <= 6))
+                 | ((hd.hd_dep_count == 2) & (hd.hd_vehicle_count <= 4))
+                 | ((hd.hd_dep_count == 0) & (hd.hd_vehicle_count <= 2))]
+        base = (F["store_sales"]
+                .merge(hdm, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+                .merge(st[st.s_store_name == "store a"],
+                       left_on="ss_store_sk", right_on="s_store_sk")
+                .merge(td, left_on="ss_sold_time_sk", right_on="t_time_sk"))
+
+        def cnt(h, half):
+            if half == 0:
+                return len(base[(base.t_hour == h) & (base.t_minute < 30)])
+            return len(base[(base.t_hour == h) & (base.t_minute >= 30)])
+        return pd.DataFrame([{
+            "h8_30_to_9": cnt(8, 1), "h9_to_9_30": cnt(9, 0),
+            "h9_30_to_10": cnt(9, 1), "h10_to_10_30": cnt(10, 0)}])
+    run(env, "q88", oracle)
+
+
+def test_q99(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        x = (F["catalog_sales"]
+             .merge(dd[dd.d_month_seq.between(24, 35)],
+                    left_on="cs_ship_date_sk", right_on="d_date_sk")
+             .merge(F["warehouse"], left_on="cs_warehouse_sk",
+                    right_on="w_warehouse_sk")
+             .merge(F["ship_mode"], left_on="cs_ship_mode_sk",
+                    right_on="sm_ship_mode_sk")
+             .merge(F["call_center"], left_on="cs_call_center_sk",
+                    right_on="cc_call_center_sk"))
+        d = x.cs_ship_date_sk - x.cs_sold_date_sk
+        x = x.assign(wname=x.w_warehouse_name.str[:20],
+                     d30=(d <= 30).astype(int),
+                     d60=((d > 30) & (d <= 60)).astype(int),
+                     d90=((d > 60) & (d <= 90)).astype(int),
+                     d120=(d > 90).astype(int))
+        return x.groupby(["wname", "sm_type", "cc_name"], as_index=False)[
+            ["d30", "d60", "d90", "d120"]].sum()
+    run(env, "q99", oracle)

@@ -338,3 +338,440 @@ group by i_item_id, i_item_desc, i_category, i_class, i_current_price
 order by i_category, i_class, i_item_id, i_item_desc, revenueratio
 limit 100
 """
+
+QUERIES["q16"] = """
+select count(distinct cs_order_number) order_count,
+       sum(cs_ext_list_price) total_shipping_cost,
+       sum(cs_net_profit) total_net_profit
+from catalog_sales cs1, date_dim, customer_address, call_center
+where d_date between date '2002-02-01' and date '2002-04-02'
+  and cs1.cs_ship_date_sk = d_date_sk
+  and cs1.cs_bill_addr_sk = ca_address_sk
+  and ca_state = 'GA'
+  and cs1.cs_call_center_sk = cc_call_center_sk
+  and exists (select * from catalog_sales cs2
+              where cs1.cs_order_number = cs2.cs_order_number
+                and cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  and not exists (select * from catalog_returns cr1
+                  where cs1.cs_order_number = cr1.cr_order_number)
+order by count(distinct cs_order_number)
+limit 100
+"""
+
+QUERIES["q20"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(cs_ext_sales_price) itemrevenue,
+       sum(cs_ext_sales_price) * 100 /
+         sum(sum(cs_ext_sales_price)) over (partition by i_class)
+         revenueratio
+from catalog_sales, item, date_dim
+where cs_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and cs_sold_date_sk = d_date_sk
+  and d_year = 1999 and d_moy in (2, 3)
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+"""
+
+QUERIES["q25"] = """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) store_sales_profit,
+       sum(sr_net_loss) store_returns_loss,
+       sum(cs_net_profit) catalog_sales_profit
+from store_sales, store_returns, catalog_sales,
+     date_dim d1, date_dim d2, date_dim d3, store, item
+where d1.d_moy = 4 and d1.d_year = 2000
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 10 and d2.d_year = 2000
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_moy between 4 and 10 and d3.d_year = 2000
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+"""
+
+QUERIES["q32"] = """
+select sum(cs_ext_discount_amt) excess_discount_amount
+from catalog_sales, item, date_dim
+where i_manufact_id = 7
+  and i_item_sk = cs_item_sk
+  and d_date between date '2000-01-27' and date '2000-04-26'
+  and d_date_sk = cs_sold_date_sk
+  and cs_ext_discount_amt > (
+    select 1.3 * avg(cs_ext_discount_amt)
+    from catalog_sales, date_dim
+    where cs_item_sk = i_item_sk
+      and d_date between date '2000-01-27' and date '2000-04-26'
+      and d_date_sk = cs_sold_date_sk)
+limit 100
+"""
+
+QUERIES["q37"] = """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, catalog_sales
+where i_current_price between 20 and 50
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between date '2000-02-01' and date '2000-04-01'
+  and i_manufact_id in (1, 2, 3, 4, 5, 6, 7, 8)
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+"""
+
+QUERIES["q38"] = """
+select count(*) cnt from (
+  select distinct c_last_name, c_first_name, d_date
+  from store_sales, date_dim, customer
+  where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+    and store_sales.ss_customer_sk = customer.c_customer_sk
+    and d_month_seq between 24 and 35
+  intersect
+  select distinct c_last_name, c_first_name, d_date
+  from catalog_sales, date_dim, customer
+  where catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+    and catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+    and d_month_seq between 24 and 35
+  intersect
+  select distinct c_last_name, c_first_name, d_date
+  from web_sales, date_dim, customer
+  where web_sales.ws_sold_date_sk = date_dim.d_date_sk
+    and web_sales.ws_bill_customer_sk = customer.c_customer_sk
+    and d_month_seq between 24 and 35
+) hot_cust
+limit 100
+"""
+
+QUERIES["q45"] = """
+select ca_zip, ca_city, sum(ws_sales_price) total
+from web_sales, customer, customer_address, date_dim, item
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ws_item_sk = i_item_sk
+  and (substr(ca_zip, 1, 5) in
+         ('85669', '86197', '88274', '83405', '86475',
+          '85392', '85460', '80348', '81792')
+       or i_item_id in (select i_item_id from item
+                        where i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19, 23)))
+  and ws_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2001
+group by ca_zip, ca_city
+order by ca_zip, ca_city
+limit 100
+"""
+
+QUERIES["q50"] = """
+select s_store_name, s_store_id, s_state,
+  sum(case when (sr_returned_date_sk - ss_sold_date_sk <= 30)
+           then 1 else 0 end) d30,
+  sum(case when (sr_returned_date_sk - ss_sold_date_sk > 30)
+            and (sr_returned_date_sk - ss_sold_date_sk <= 60)
+           then 1 else 0 end) d60,
+  sum(case when (sr_returned_date_sk - ss_sold_date_sk > 60)
+            and (sr_returned_date_sk - ss_sold_date_sk <= 90)
+           then 1 else 0 end) d90,
+  sum(case when (sr_returned_date_sk - ss_sold_date_sk > 90)
+           then 1 else 0 end) d120
+from store_sales, store_returns, store, date_dim d1, date_dim d2
+where d2.d_year = 2001 and d2.d_moy = 8
+  and ss_ticket_number = sr_ticket_number
+  and ss_item_sk = sr_item_sk
+  and ss_sold_date_sk = d1.d_date_sk
+  and sr_returned_date_sk = d2.d_date_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_store_sk = s_store_sk
+group by s_store_name, s_store_id, s_state
+order by s_store_name, s_store_id, s_state
+limit 100
+"""
+
+QUERIES["q61"] = """
+select promotions, total, promotions / total * 100 ratio
+from (select sum(ss_ext_sales_price) promotions
+      from store_sales, store, promotion, date_dim, customer,
+           customer_address, item
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_promo_sk = p_promo_sk
+        and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk
+        and ss_item_sk = i_item_sk
+        and ca_gmt_offset = -5
+        and i_category = 'Jewelry'
+        and (p_channel_dmail = 'Y' or p_channel_email = 'Y'
+             or p_channel_tv = 'Y')
+        and s_gmt_offset = -5
+        and d_year = 1998 and d_moy = 11) promotional_sales,
+     (select sum(ss_ext_sales_price) total
+      from store_sales, store, date_dim, customer, customer_address, item
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk
+        and ss_item_sk = i_item_sk
+        and ca_gmt_offset = -5
+        and i_category = 'Jewelry'
+        and s_gmt_offset = -5
+        and d_year = 1998 and d_moy = 11) all_sales
+order by promotions, total
+limit 100
+"""
+
+QUERIES["q65"] = """
+select s_store_name, i_item_desc, sc.revenue, i_current_price, i_brand
+from store, item,
+     (select ss_store_sk, avg(revenue) as ave
+      from (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+            from store_sales, date_dim
+            where ss_sold_date_sk = d_date_sk and d_month_seq between 24 and 35
+            group by ss_store_sk, ss_item_sk) sa
+      group by ss_store_sk) sb,
+     (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk and d_month_seq between 24 and 35
+      group by ss_store_sk, ss_item_sk) sc
+where sb.ss_store_sk = sc.ss_store_sk
+  and sc.revenue <= 0.1 * sb.ave
+  and s_store_sk = sc.ss_store_sk
+  and i_item_sk = sc.ss_item_sk
+order by s_store_name, i_item_desc
+limit 100
+"""
+
+QUERIES["q68"] = """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       extended_price, extended_tax, list_price
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_ext_sales_price) extended_price,
+             sum(ss_ext_list_price) list_price,
+             sum(ss_ext_tax) extended_tax
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and store_sales.ss_addr_sk = customer_address.ca_address_sk
+        and date_dim.d_dom between 1 and 2
+        and (household_demographics.hd_dep_count = 4
+             or household_demographics.hd_vehicle_count = 3)
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_city in ('Midway', 'Fairview')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100
+"""
+
+QUERIES["q69"] = """
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2, cd_credit_rating, count(*) cnt3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_state in ('KS', 'GA', 'NY')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2001 and d_moy between 4 and 6)
+  and not exists (select * from web_sales, date_dim
+                  where c.c_customer_sk = ws_bill_customer_sk
+                    and ws_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy between 4 and 6)
+  and not exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_bill_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy between 4 and 6)
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+limit 100
+"""
+
+QUERIES["q79"] = """
+select c_last_name, c_first_name, substr(s_city, 1, 30) city30,
+       ss_ticket_number, amt, profit
+from (select ss_ticket_number, ss_customer_sk, store.s_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and (household_demographics.hd_dep_count = 6
+             or household_demographics.hd_vehicle_count > 2)
+        and date_dim.d_dow = 1
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_number_employees between 200 and 295
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, store.s_city) ms,
+     customer
+where ss_customer_sk = c_customer_sk
+order by c_last_name, c_first_name, city30, profit, ss_ticket_number
+limit 100
+"""
+
+QUERIES["q82"] = """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, store_sales
+where i_current_price between 30 and 60
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between date '2000-05-25' and date '2000-07-24'
+  and i_manufact_id in (1, 2, 3, 4, 5, 6, 7, 8)
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+"""
+
+QUERIES["q87"] = """
+select count(*) cnt from (
+  (select distinct c_last_name, c_first_name, d_date
+   from store_sales, date_dim, customer
+   where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+     and store_sales.ss_customer_sk = customer.c_customer_sk
+     and d_month_seq between 24 and 35)
+  except
+  (select distinct c_last_name, c_first_name, d_date
+   from catalog_sales, date_dim, customer
+   where catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+     and catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+     and d_month_seq between 24 and 35)
+  except
+  (select distinct c_last_name, c_first_name, d_date
+   from web_sales, date_dim, customer
+   where web_sales.ws_sold_date_sk = date_dim.d_date_sk
+     and web_sales.ws_bill_customer_sk = customer.c_customer_sk
+     and d_month_seq between 24 and 35)
+) cool_cust
+"""
+
+QUERIES["q88"] = """
+select *
+from (select count(*) h8_30_to_9
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 8 and time_dim.t_minute >= 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'store a') s1,
+     (select count(*) h9_to_9_30
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 9 and time_dim.t_minute < 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'store a') s2,
+     (select count(*) h9_30_to_10
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 9 and time_dim.t_minute >= 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'store a') s3,
+     (select count(*) h10_to_10_30
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 10 and time_dim.t_minute < 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'store a') s4
+"""
+
+QUERIES["q92"] = """
+select sum(ws_ext_discount_amt) excess_discount_amount
+from web_sales, item, date_dim
+where i_manufact_id = 7
+  and i_item_sk = ws_item_sk
+  and d_date between date '2000-01-27' and date '2000-04-26'
+  and d_date_sk = ws_sold_date_sk
+  and ws_ext_discount_amt > (
+    select 1.3 * avg(ws_ext_discount_amt)
+    from web_sales, date_dim
+    where ws_item_sk = i_item_sk
+      and d_date between date '2000-01-27' and date '2000-04-26'
+      and d_date_sk = ws_sold_date_sk)
+order by sum(ws_ext_discount_amt)
+limit 100
+"""
+
+QUERIES["q94"] = """
+select count(distinct ws_order_number) order_count,
+       sum(ws_ext_list_price) total_shipping_cost,
+       sum(ws_net_profit) total_net_profit
+from web_sales ws1, date_dim, customer_address, web_site
+where d_date between date '1999-02-01' and date '1999-04-02'
+  and ws1.ws_ship_date_sk = d_date_sk
+  and ws1.ws_bill_addr_sk = ca_address_sk
+  and ca_state = 'IL'
+  and ws1.ws_web_site_sk = web_site_sk
+  and web_company_name = 'pri0'
+  and exists (select * from web_sales ws2
+              where ws1.ws_order_number = ws2.ws_order_number
+                and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  and not exists (select * from web_returns wr1
+                  where ws1.ws_order_number = wr1.wr_order_number)
+order by count(distinct ws_order_number)
+limit 100
+"""
+
+QUERIES["q99"] = """
+select substr(w_warehouse_name, 1, 20) wname, sm_type, cc_name,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk <= 30)
+           then 1 else 0 end) d30,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 30)
+            and (cs_ship_date_sk - cs_sold_date_sk <= 60)
+           then 1 else 0 end) d60,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 60)
+            and (cs_ship_date_sk - cs_sold_date_sk <= 90)
+           then 1 else 0 end) d90,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 90)
+           then 1 else 0 end) d120
+from catalog_sales, warehouse, ship_mode, call_center, date_dim
+where d_month_seq between 24 and 35
+  and cs_ship_date_sk = d_date_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_ship_mode_sk = sm_ship_mode_sk
+  and cs_call_center_sk = cc_call_center_sk
+group by substr(w_warehouse_name, 1, 20), sm_type, cc_name
+order by wname, sm_type, cc_name
+limit 100
+"""
