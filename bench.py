@@ -1,19 +1,28 @@
 """Benchmark suite: the BASELINE.json configs on one chip.
 
-Default mode ("suite") times every config family — TPC-H Q1 (hand-built plan,
-the headline), TPC-H Q3/Q9 (joins + partial-agg), four SSB flat queries (wide
-scan), TPC-DS Q67 (high-cardinality group-by + window) — each against a
-single-process pandas implementation of the same query on the same host (the
-stand-in for the reference BE's single-node vectorized CPU path; BASELINE.md
-has the reference's published cluster numbers).
+Contract with the driver (hardened in round 3 after BENCH_r02 timed out
+before printing anything): the headline JSON line is printed IMMEDIATELY
+after the Q1 config completes — before any other family runs — so a
+timeout mid-suite can no longer erase the round's metric.  The rest of
+the suite then runs under a wall-clock budget (SR_TPU_BENCH_BUDGET_S,
+default 480s): each family checks the deadline before starting and is
+skipped (recorded as such) once the budget is spent.  BENCH_DETAIL.json
+is rewritten incrementally after every entry.  At the end a second,
+enriched JSON line (same metric/value, plus suite geomean) is printed —
+either line satisfies the driver.
 
-Prints ONE JSON line:
-  {"metric", "value", "unit", "vs_baseline", "suite_geomean_vs_pandas", "suite"}
+Families: TPC-H Q1 (hand-built plan, the headline), TPC-H Q3/Q9 (joins +
+partial-agg), four SSB flat queries (wide scan), TPC-DS Q67 (high-card
+group-by + window) — each against a single-process pandas implementation
+of the same query on the same host (the stand-in for the reference BE's
+single-node vectorized CPU path; BASELINE.md has the reference's
+published cluster numbers).
+
+Headline line fields:
+  {"metric", "value", "unit", "vs_baseline"}
 - value: lineitem rows/sec through the full jitted Q1 plan (post-compile,
   best of N timed runs, data resident on device) — comparable across rounds.
 - vs_baseline: Q1 speedup vs pandas.
-- suite_geomean_vs_pandas: geomean speedup across every suite query.
-Full per-query numbers land in BENCH_DETAIL.json.
 
 Scale factor via SR_TPU_BENCH_SF (default 1.0 -> ~6M lineitem rows).
 SR_TPU_BENCH_QUERY selects the workload: suite (default) | q1 (hand-built
@@ -25,6 +34,16 @@ import math
 import os
 import sys
 import time
+
+_T0 = time.time()
+
+
+def _budget_s() -> float:
+    return float(os.environ.get("SR_TPU_BENCH_BUDGET_S", "480"))
+
+
+def _remaining_s() -> float:
+    return _budget_s() - (time.time() - _T0)
 
 
 def _best(fn, repeats):
@@ -134,11 +153,15 @@ def _ensure_live_backend(probe_timeout_s: int = 120):
     """Probe the accelerator in a SUBPROCESS first: a wedged TPU tunnel hangs
     the first device op indefinitely (not an exception), which would hang the
     whole benchmark. If the probe can't complete, fall back to CPU so the
-    bench always produces its JSON line."""
+    bench always produces its JSON line.  The probe's own stderr tail is
+    echoed so a wedged tunnel is diagnosable from the bench log."""
     import subprocess
 
     probe = (
-        "import jax, jax.numpy as jnp; jnp.arange(4).sum().block_until_ready();"
+        "import sys, faulthandler; faulthandler.dump_traceback_later("
+        f"{max(probe_timeout_s - 15, 5)}, file=sys.stderr);"
+        "import jax, jax.numpy as jnp;"
+        "jnp.arange(4).sum().block_until_ready();"
         "print(jax.default_backend())"
     )
     try:
@@ -150,8 +173,16 @@ def _ensure_live_backend(probe_timeout_s: int = 120):
             backend = r.stdout.strip().splitlines()[-1]
             print(f"# device probe ok: {backend}", file=sys.stderr)
             return
-    except subprocess.TimeoutExpired:
-        pass
+        tail = (r.stderr or "")[-2000:]
+        print(f"# device probe rc={r.returncode}; stderr tail:\n{tail}",
+              file=sys.stderr)
+    except subprocess.TimeoutExpired as e:
+        tail = e.stderr
+        if isinstance(tail, bytes):
+            tail = tail.decode("utf-8", "replace")
+        print("# device probe TIMED OUT after "
+              f"{probe_timeout_s}s; stderr tail:\n{(tail or '')[-2000:]}",
+              file=sys.stderr)
     print("# device probe FAILED (wedged tunnel?); falling back to CPU",
           file=sys.stderr)
     import jax
@@ -216,17 +247,42 @@ def run_q1_handplan(sf: float, repeats: int):
 
 
 def run_suite(sf: float, repeats: int):
-    """All BASELINE.json config families; one JSON line + BENCH_DETAIL.json."""
+    """All BASELINE.json config families.  Headline JSON line prints right
+    after Q1; the rest runs under the wall-clock budget with incremental
+    BENCH_DETAIL.json writes."""
     import jax
 
     from starrocks_tpu.runtime.session import Session
 
-    detail = {"backend": jax.default_backend(), "sf": sf}
+    detail = {"backend": jax.default_backend(), "sf": sf,
+              "budget_s": _budget_s()}
+    detail_path = os.path.join(os.path.dirname(__file__) or ".",
+                               "BENCH_DETAIL.json")
+
+    def flush_detail():
+        with open(detail_path, "w") as f:
+            json.dump(detail, f, indent=1)
+
     q1d = run_q1_handplan(sf, repeats)
     detail["tpch_q1_handplan"] = q1d
+    flush_detail()
     speedups = [q1d["vs_pandas"]]
 
+    # The round's metric, printed BEFORE any other family can stall/die.
+    headline = {
+        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
+        "value": q1d["rows_per_sec"],
+        "unit": "rows/sec/chip",
+        "vs_baseline": q1d["vs_pandas"],
+    }
+    print(json.dumps(headline), flush=True)
+
     def try_entry(name, fn):
+        if _remaining_s() <= 0:
+            detail[name] = {"skipped": "wall-clock budget exhausted"}
+            print(f"# {name}: SKIPPED (budget)", file=sys.stderr)
+            flush_detail()
+            return
         try:
             d = fn()
             detail[name] = d
@@ -238,8 +294,9 @@ def run_suite(sf: float, repeats: int):
         except Exception as e:  # noqa: BLE001 — one failure must not kill the bench
             detail[name] = {"error": f"{type(e).__name__}: {e}"}
             print(f"# {name}: FAILED {type(e).__name__}: {e}", file=sys.stderr)
+        flush_detail()
 
-    # --- TPC-H Q3 + Q9 (joins, partial-agg exchange shape single-chip) ------
+    # --- TPC-H joins (partial-agg exchange shape single-chip) ---------------
     # family setup lives inside try-blocks too: one family failing to build
     # must not kill the suite (same contract as try_entry)
     try:
@@ -253,6 +310,7 @@ def run_suite(sf: float, repeats: int):
         nrows_li = tcat.get_table("lineitem").row_count
     except Exception as e:  # noqa: BLE001
         detail["tpch_setup"] = {"error": f"{type(e).__name__}: {e}"}
+        flush_detail()
     else:
         for qn in (3, 9):
             try_entry(
@@ -278,6 +336,7 @@ def run_suite(sf: float, repeats: int):
         nrows_ssb = scat.get_table("lineorder_flat").row_count
     except Exception as e:  # noqa: BLE001
         detail["ssb_setup"] = {"error": f"{type(e).__name__}: {e}"}
+        flush_detail()
     else:
         for qid in ("q1.1", "q2.1", "q3.1", "q4.1"):
             try_entry(
@@ -303,15 +362,12 @@ def run_suite(sf: float, repeats: int):
     geomean = round(
         math.exp(sum(math.log(s) for s in speedups) / len(speedups)), 3)
     detail["suite_geomean_vs_pandas"] = geomean
-    with open(os.path.join(os.path.dirname(__file__) or ".",
-                           "BENCH_DETAIL.json"), "w") as f:
-        json.dump(detail, f, indent=1)
+    flush_detail()
 
+    # Enriched final line: same metric/value as the headline (either line
+    # satisfies the driver), plus the suite geomean.
     print(json.dumps({
-        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
-        "value": q1d["rows_per_sec"],
-        "unit": "rows/sec/chip",
-        "vs_baseline": q1d["vs_pandas"],
+        **headline,
         "suite_geomean_vs_pandas": geomean,
         "suite_queries": len(speedups),
     }))
@@ -322,6 +378,8 @@ def main():
     repeats = int(os.environ.get("SR_TPU_BENCH_REPEATS", "5"))
     query_key = os.environ.get("SR_TPU_BENCH_QUERY", "suite")
     _ensure_live_backend()
+    global _T0
+    _T0 = time.time()  # budget clock starts after the device probe
     if query_key == "suite":
         return run_suite(sf, repeats)
     if query_key != "q1":

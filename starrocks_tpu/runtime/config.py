@@ -121,8 +121,12 @@ def _wire_compilation_cache(path: str):
         return
     import jax as _jax
 
+    import os as _os
+
+    _os.makedirs(path, exist_ok=True)
     _jax.config.update("jax_compilation_cache_dir", path)
     _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 
 config.on_set("compilation_cache_dir", _wire_compilation_cache)

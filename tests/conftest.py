@@ -24,6 +24,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite is compile-dominated (>9 min
+# cold); warm runs reuse compiled programs across processes and rounds.
+# Routed through the repo's own config knob so there is one wiring path.
+from starrocks_tpu.runtime.config import config as _sr_config  # noqa: E402
+
+if not _sr_config.get("compilation_cache_dir"):
+    _sr_config.set(
+        "compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                     ".xla_cache"),
+        force=True,  # not runtime-mutable; the harness sets it pre-backend
+    )
+
 import pytest  # noqa: E402
 
 
