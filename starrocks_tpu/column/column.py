@@ -31,12 +31,19 @@ from .dict_encoding import StringDict
 
 @dataclasses.dataclass(frozen=True)
 class Field:
-    """Schema entry for one column. Hashable (StringDict hashes by identity)."""
+    """Schema entry for one column. Hashable (StringDict hashes by identity).
+
+    bounds: optional (lo, hi) value range from catalog stats, attached at
+    scan time and propagated through the expression compiler — drives the
+    sort-free bounded-domain aggregation path. Baked into the trace (schema
+    is jit aux data), so stale bounds force a retrace, never a wrong answer.
+    """
 
     name: str
     type: LogicalType
     nullable: bool = True
     dict: Optional[StringDict] = None
+    bounds: Optional[tuple] = None
 
     def __repr__(self):
         n = "" if self.nullable else " NOT NULL"

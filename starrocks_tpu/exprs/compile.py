@@ -48,6 +48,9 @@ class EVal:
     valid: Optional[jnp.ndarray]
     type: T.LogicalType
     dict: Optional[StringDict] = None
+    # static (lo, hi) value bounds known at trace time (from catalog stats),
+    # propagated through a few closed-form functions; None = unbounded
+    bounds: Optional[tuple] = None
 
 
 def _and_valid(*valids):
@@ -183,7 +186,7 @@ class ExprCompiler:
         if isinstance(e, Col):
             data, valid = self.chunk.col(e.name)
             f = self.chunk.field(e.name)
-            return EVal(data, valid, f.type, f.dict)
+            return EVal(data, valid, f.type, f.dict, bounds=f.bounds)
         if isinstance(e, Lit):
             hv, lt = _infer_lit(e.value, e.type)
             if lt.kind is T.TypeKind.NULL:
@@ -616,25 +619,48 @@ def _as_days(v: EVal):
     raise TypeError(f"expected date/datetime, got {v.type}")
 
 
+def _py_year_of_days(days: int) -> int:
+    """Host-side civil year of a days-since-epoch value (bounds math)."""
+    import datetime
+
+    return (datetime.date(1970, 1, 1)
+            + datetime.timedelta(days=int(days))).year
+
+
+def _date_bounds_days(a: EVal):
+    """arg bounds as days-since-epoch, or None."""
+    if a.bounds is None:
+        return None
+    lo, hi = a.bounds
+    if a.type.kind is T.TypeKind.DATETIME:
+        return (int(lo) // 86_400_000_000, int(hi) // 86_400_000_000)
+    if a.type.kind is T.TypeKind.DATE:
+        return (int(lo), int(hi))
+    return None
+
+
 @function("year")
 def _f_year(cc, a):
     a = _lit_as_date_if_str(a)
     y, m, d = _civil_from_days(_as_days(a))
-    return EVal(y, a.valid, T.INT)
+    db = _date_bounds_days(a)
+    yb = ((_py_year_of_days(db[0]), _py_year_of_days(db[1]))
+          if db is not None else None)
+    return EVal(y, a.valid, T.INT, bounds=yb)
 
 
 @function("month")
 def _f_month(cc, a):
     a = _lit_as_date_if_str(a)
     y, m, d = _civil_from_days(_as_days(a))
-    return EVal(m, a.valid, T.INT)
+    return EVal(m, a.valid, T.INT, bounds=(1, 12))
 
 
 @function("day")
 def _f_day(cc, a):
     a = _lit_as_date_if_str(a)
     y, m, d = _civil_from_days(_as_days(a))
-    return EVal(d, a.valid, T.INT)
+    return EVal(d, a.valid, T.INT, bounds=(1, 31))
 
 
 @function("date_add_days")

@@ -106,6 +106,48 @@ def _state_fields(name: str, agg: AggExpr, arg_t: Optional[T.LogicalType]):
     raise NotImplementedError(f"aggregate {agg.fn}")
 
 
+def _key_domain(k) -> Optional[tuple]:
+    """(base, lo) static value domain of one group key, or None when
+    unbounded. Shared by the planner's capacity seeding (bounded_domain) and
+    the runtime packed-gid path (_try_lowcard) so the two can never disagree
+    about which keys are coverable."""
+    if k.dict is not None:
+        return max(len(k.dict), 1), 0
+    if k.type.kind is T.TypeKind.BOOLEAN:
+        return 2, 0
+    if (k.bounds is not None
+            and jnp.issubdtype(jnp.asarray(k.data).dtype, jnp.integer)):
+        # stats-bounded integer/date domain (bounds propagate through the
+        # expr compiler, e.g. extract(year FROM ...)): codes are value - lo
+        lo, hi = int(k.bounds[0]), int(k.bounds[1])
+        return hi - lo + 1, lo
+    return None
+
+
+def bounded_domain(chunk: Chunk, group_by) -> Optional[int]:
+    """Static size of the group-key domain when every key is bounded
+    (dict codes, booleans, stats-bounded ints) — planner uses it to seed the
+    aggregation capacity so the sort-free packed-gid path covers dense
+    high-cardinality keys (e.g. GROUP BY l_orderkey) too."""
+    from ..runtime.config import config as _cfg
+
+    if not group_by or not _cfg.get("enable_lowcard_agg"):
+        # seeding a domain-sized capacity is only useful if _try_lowcard
+        # will actually take it; otherwise the lexsort path would pay for
+        # domain-many output slots
+        return None
+    keys = eval_keys(chunk, tuple(e for _, e in group_by))
+    total = 1
+    for k in keys:
+        dom = _key_domain(k)
+        if dom is None:
+            return None
+        total *= dom[0] + (1 if k.valid is not None else 0)
+        if total > (1 << 26):  # give up early on huge domains
+            return None
+    return total
+
+
 def _try_lowcard(chunk, group_by, keys, live, num_groups: int, mode: str):
     """Sort-free fast path when every group key has a bounded domain
     (dictionary codes / booleans): group id = mixed-radix packed codes, and
@@ -123,21 +165,20 @@ def _try_lowcard(chunk, group_by, keys, live, num_groups: int, mode: str):
     infos = []
     total = 1
     for k in keys:
-        if k.dict is not None:
-            base = max(len(k.dict), 1)
-        elif k.type.kind is T.TypeKind.BOOLEAN:
-            base = 2
-        else:
+        dom = _key_domain(k)
+        if dom is None:
             return None
+        base, lo = dom
         has_null = k.valid is not None
         size = base + (1 if has_null else 0)
-        infos.append((k, base, has_null, size))
+        infos.append((k, base, has_null, size, lo))
         total *= size
         if total > num_groups:
             return None
     gid = jnp.zeros((live.shape[0],), jnp.int32)
-    for k, base, has_null, size in infos:
-        code = jnp.clip(jnp.asarray(k.data, jnp.int32), 0, base - 1)
+    for k, base, has_null, size, lo in infos:
+        code = jnp.clip(jnp.asarray(k.data, jnp.int64) - lo, 0, base - 1)
+        code = jnp.asarray(code, jnp.int32)
         if has_null:
             code = jnp.where(k.valid, code, base)
         gid = gid * size + code
@@ -151,17 +192,17 @@ def _lowcard_key_columns(infos, total: int, num_groups: int):
     cols = []
     strides = []
     s = 1
-    for k, base, has_null, size in reversed(infos):
+    for k, base, has_null, size, lo in reversed(infos):
         strides.append(s)
         s *= size
     strides = list(reversed(strides))
-    for (k, base, has_null, size), stride in zip(infos, strides):
+    for (k, base, has_null, size, lo), stride in zip(infos, strides):
         code = (slots // stride) % size
         valid = None
         if has_null:
             valid = code != base
             code = jnp.where(valid, code, 0)
-        cols.append((k, jnp.asarray(code, k.type.dtype), valid))
+        cols.append((k, jnp.asarray(code + lo, k.type.dtype), valid))
     return cols
 
 
@@ -442,7 +483,8 @@ def hash_aggregate(
     for (kname, _), k in zip(group_by, keys):
         ks = k.data[order][safe_first]
         kv = None if k.valid is None else k.valid[order][safe_first]
-        out_fields.append(Field(kname, k.type, k.valid is not None, k.dict))
+        out_fields.append(Field(kname, k.type, k.valid is not None, k.dict,
+                                bounds=k.bounds))
         out_data.append(ks)
         out_valid.append(kv)
 
@@ -502,7 +544,8 @@ def _aggregate_with_gid(chunk, cc, group_by, aggs, num_groups, mode,
     for (name, _), (k, code, kvalid) in zip(
         group_by, _lowcard_key_columns(infos, total, num_groups)
     ):
-        out_fields.append(Field(name, k.type, kvalid is not None, k.dict))
+        out_fields.append(Field(name, k.type, kvalid is not None, k.dict,
+                                bounds=k.bounds))
         out_data.append(code)
         out_valid.append(kvalid)
 

@@ -31,7 +31,8 @@ def project(chunk: Chunk, exprs, names) -> Chunk:
     for name, e in zip(names, exprs):
         v = cc.eval(e)
         d = jnp.broadcast_to(jnp.asarray(v.data), (chunk.capacity,))
-        fields.append(Field(name, v.type, v.valid is not None, v.dict))
+        fields.append(Field(name, v.type, v.valid is not None, v.dict,
+                            bounds=v.bounds))
         data.append(d)
         valid.append(
             None if v.valid is None else jnp.broadcast_to(v.valid, (chunk.capacity,))

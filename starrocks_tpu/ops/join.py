@@ -139,8 +139,14 @@ def hash_join_unique(
     pos_c = jnp.clip(pos, 0, bcap - 1)
     match = (bk_sorted[pos_c] == pk) & p_ok & (pk != _I64MAX)
     build_row = order[pos_c]
+    return _unique_join_epilogue(
+        probe, build, payload, match, build_row, join_type)
 
-    out_fields = _merge_schemas(probe, build, payload)
+
+def _unique_join_epilogue(probe, build, payload, match, build_row, join_type):
+    """Shared tail of the 1:N join kernels (sorted + LUT): gather the build
+    payload by matched row, NULL-mask non-matches for LEFT OUTER, and apply
+    the join-type selection semantics at probe capacity."""
     data = list(probe.data)
     valid = list(probe.valid)
     for n in payload:
@@ -164,7 +170,46 @@ def hash_join_unique(
         return probe.and_sel(~match)
     elif join_type != LEFT_OUTER:
         raise NotImplementedError(join_type)
+    out_fields = _merge_schemas(probe, build, payload)
     return Chunk(Schema(out_fields), tuple(data), tuple(valid), sel)
+
+
+def hash_join_lut(
+    probe: Chunk,
+    build: Chunk,
+    probe_keys,
+    build_keys,
+    lo: int,
+    size: int,
+    join_type: str = INNER,
+    payload=None,
+):
+    """Direct-addressing join for a unique build side whose (single) key
+    range is bounded by catalog stats: build rows scatter into a dense
+    row-lookup table indexed by key-lo, probes gather their match in O(1).
+
+    Replaces sort+searchsorted (O(B log B) build + O(log B) per probe) with
+    one unique-index scatter + one gather — the TPU-safe scatter shape
+    (serialization only bites on DUPLICATE indices) and the CPU-fallback
+    fast path. The reference's analog is the dense-key array join
+    (be/src/exec/join_hash_map.h DirectMappingJoinHashMap).
+    """
+    payload = list(payload if payload is not None else build.schema.names)
+    pk, p_ok = pack_keys(probe, probe_keys, None)
+    bk, b_ok = pack_keys(build, build_keys, None)
+
+    # dead/NULL build rows land in the spill slot (dropped)
+    idxb = jnp.where(b_ok, bk - lo, size)
+    lut = jnp.full((size,), -1, jnp.int32).at[idxb].set(
+        jnp.arange(build.capacity, dtype=jnp.int32), mode="drop"
+    )
+    idxp = pk - lo
+    in_range = p_ok & (idxp >= 0) & (idxp < size)
+    row = lut[jnp.clip(idxp, 0, size - 1)]
+    match = in_range & (row >= 0)
+    build_row = jnp.clip(row, 0, build.capacity - 1)
+    return _unique_join_epilogue(
+        probe, build, payload, match, build_row, join_type)
 
 
 def hash_join_expand(

@@ -175,10 +175,22 @@ def _seg_minmax_bcast(vals, gid, num_groups: int, is_min: bool, identity):
     return (jnp.min if is_min else jnp.max)(masked, axis=0)
 
 
-def _enabled() -> bool:
+def _use_mxu() -> bool:
+    """True when the scatter-free (matmul / broadcast / scan) strategies
+    should be used.  They exist because TPU scatters serialize on duplicate
+    indices; on the CPU fallback backend a plain scatter is 100-1000x FASTER
+    than the one-hot matmul (measured: 1.2M rows x 1024 groups = 1.1ms
+    scatter vs >1s matmul), so `auto` picks by compile-time backend.
+    `segment_strategy` config: auto | mxu | scatter (tests pin `mxu` to keep
+    the strategy branches covered on CPU)."""
     from ..runtime.config import config
 
-    return config.get("enable_scatter_free_segments")
+    if not config.get("enable_scatter_free_segments"):
+        return False
+    s = config.get("segment_strategy")
+    if s == "auto":
+        return jax.default_backend() not in ("cpu",)
+    return s == "mxu"
 
 
 def seg_sum(vals, gid, num_groups: int, *, sorted_gid: bool = False,
@@ -193,7 +205,7 @@ def seg_sum(vals, gid, num_groups: int, *, sorted_gid: bool = False,
     vals = jnp.asarray(vals)
     if vals.dtype == jnp.bool_:
         vals = jnp.asarray(vals, jnp.int64)
-    if _enabled():
+    if _use_mxu():
         if jnp.issubdtype(vals.dtype, jnp.integer):
             v64 = jnp.asarray(vals, jnp.int64)
             if (num_groups <= _matmul_groups_max()
@@ -219,7 +231,7 @@ def seg_count(live, gid, num_groups: int, *, sorted_gid: bool = False):
 def _seg_minmax(vals, gid, num_groups: int, is_min: bool, identity,
                 sorted_gid: bool):
     vals = jnp.asarray(vals)
-    if _enabled():
+    if _use_mxu():
         if num_groups <= _bcast_groups_max():
             return _seg_minmax_bcast(vals, gid, num_groups, is_min, identity)
         if sorted_gid:

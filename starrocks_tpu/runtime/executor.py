@@ -54,6 +54,11 @@ class DeviceCache:
         from collections import OrderedDict
 
         self.programs: OrderedDict = OrderedDict()
+        # optimized-plan cache: logical plan -> optimize() output. The DP
+        # join ordering is O(3^n) subset enumeration in host Python — real
+        # milliseconds on repeated multi-join queries. Evicted with programs
+        # on DML (stats drive join order / runtime-filter decisions).
+        self.opt_plans: OrderedDict = OrderedDict()
 
     def program_bucket(self, key):
         b = self.programs.get(key)
@@ -83,6 +88,8 @@ class DeviceCache:
 
         for key in [k for k in self.programs if scans_table(k)]:
             del self.programs[key]
+        for key in [k for k in self.opt_plans if scans_table((k,))]:
+            del self.opt_plans[key]
 
     def chunk_for(self, handle, alias: str, columns, placement=None) -> Chunk:
         """Device chunk of the requested columns, renamed to alias-qualified."""
@@ -161,7 +168,13 @@ class DeviceCache:
                 self._cols[key] = (put(a), None if v is None else put(v))
             d, v = self._cols[key]
             f = ht.schema.field(c)
-            fields.append(dataclasses.replace(f, name=f"{alias}.{c}"))
+            st = handle.column_stats(c)
+            bounds = (
+                (int(st.min), int(st.max))
+                if st.min is not None and st.max is not None else None
+            )
+            fields.append(
+                dataclasses.replace(f, name=f"{alias}.{c}", bounds=bounds))
             data.append(d)
             valid.append(v)
         if reorder is None:
@@ -206,8 +219,17 @@ class Executor:
         QUERIES_TOTAL.inc()
         try:
             with profile.timer("optimize"):
-                plan = optimize(plan, self.catalog)
-                plan = self._resolve_scalar_subqueries(plan)
+                opt = self.cache.opt_plans.get(plan)
+                if opt is None:
+                    opt = optimize(plan, self.catalog)
+                    self.cache.opt_plans[plan] = opt
+                    while len(self.cache.opt_plans) > DeviceCache.MAX_CACHED_PLANS:
+                        self.cache.opt_plans.popitem(last=False)
+                else:
+                    self.cache.opt_plans.move_to_end(plan)
+                # subquery resolution executes data-dependent sub-plans —
+                # never cached
+                plan = self._resolve_scalar_subqueries(opt)
             out_chunk = self._run(plan, profile)
             with profile.timer("fetch_results"):
                 ht = HostTable.from_chunk(out_chunk)
@@ -320,6 +342,21 @@ class Executor:
                     overflow = True
             if not overflow:
                 profile.add_counter("recompiles", attempt)
+                # tighten grossly over-seeded capacities for the NEXT run
+                # (estimate-seeded shrink/join caps can be 100x the true
+                # count): the next execution compiles once at the tight
+                # capacity and then reuses that program. Overflow checks
+                # keep correctness if the data grows back.
+                for key, v in keyed_checks:
+                    if key.startswith("agg_"):
+                        # agg capacities may be dense-domain seeds (capacity
+                        # = key domain so the sort-free path applies);
+                        # tightening to the true group count would knock the
+                        # plan back onto the lexsort path
+                        continue
+                    tight = pad_capacity(int(v * headroom) + 1)
+                    if tight * 2 <= caps.values.get(key, 0):
+                        caps.values[key] = tight
                 return out
             RECOMPILES.inc()
             fail_point("executor::before_recompile")
@@ -397,7 +434,9 @@ class Executor:
         jax.block_until_ready(out.data)
         # caps defaults fill during the first trace; record entries after it
         bucket["progs"].setdefault(tuple(sorted(caps.values.items())), (fn, scans))
-        bucket["last"] = dict(caps.values)
+        # store by REFERENCE: the adaptive loop tightens over-seeded caps
+        # after a successful run, and the next execution should adopt them
+        bucket["last"] = caps.values
         return out, checks
 
 

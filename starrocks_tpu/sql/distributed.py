@@ -321,9 +321,10 @@ def compile_distributed(
         def emit_agg(p: LAggregate):
             c, m = emit(p.child)
             key = f"agg_{ordinal(p)}"
+            agg_default = 1024 if p.group_by else 1
             if m == REPLICATED:
                 out, ng = hash_aggregate(c, p.group_by, p.aggs,
-                                         caps.get(key, 1024))
+                                         caps.get(key, agg_default))
                 checks[key] = ng[None]
                 return out, REPLICATED
             final_group_by = tuple((n, Col(n)) for n, _ in p.group_by)
@@ -353,7 +354,7 @@ def compile_distributed(
                 # group keys: gather rows, aggregate COMPLETE.
                 gathered = all_gather_chunk(c, axis)
                 out, ng = hash_aggregate(gathered, p.group_by, p.aggs,
-                                         caps.get(key, 1024))
+                                         caps.get(key, agg_default))
                 checks[key] = ng[None]
                 return out, REPLICATED
             if est is not None and est > SHUFFLE_AGG_MIN_GROUPS:
@@ -387,7 +388,7 @@ def compile_distributed(
                 )
                 return out, out_mode
             # two-phase: local partial -> all_gather -> final
-            cap = caps.get(key, 1024)
+            cap = caps.get(key, agg_default)
             part, png = hash_aggregate(c, p.group_by, p.aggs, cap, mode=PARTIAL)
             merged = all_gather_chunk(part, axis)
             out, ng = hash_aggregate(

@@ -34,11 +34,23 @@ from .logical import (
     LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion, LWindow,
     LogicalPlan,
 )
-from .optimizer import and_all, expr_cols
+from .optimizer import and_all, col_origin, estimate_rows, expr_cols
 
 
 class PlanError(ValueError):
     pass
+
+
+def _dense_agg_domain_max(cfg) -> int:
+    """Largest group-key domain the planner will cover with a dense packed-gid
+    capacity. 0 (default) = auto: generous on CPU (scatters are cheap), tight
+    on TPU (wide segment reduces cost HBM bandwidth; the lexsort path wins)."""
+    import jax
+
+    v = cfg.get("dense_agg_domain_max")
+    if v:
+        return v
+    return (1 << 22) if jax.default_backend() == "cpu" else 4096
 
 
 # --- plan properties ---------------------------------------------------------
@@ -80,38 +92,12 @@ def unique_sets(plan: LogicalPlan, catalog) -> set:
     return set()
 
 
-def col_origin(plan: LogicalPlan, name: str):
-    """Trace a column to its base (table, column) if it's a pure passthrough."""
-    if isinstance(plan, LScan):
-        alias, base = name.split(".", 1)
-        if alias == plan.alias and base in plan.columns:
-            return plan.table, base
-        return None
-    if isinstance(plan, (LFilter, LSort, LLimit, LWindow)):
-        return col_origin(plan.child, name)
-    if isinstance(plan, LProject):
-        for n, e in plan.exprs:
-            if n == name and isinstance(e, Col):
-                return col_origin(plan.child, e.name)
-        return None
-    if isinstance(plan, LAggregate):
-        for n, e in plan.group_by:
-            if n == name and isinstance(e, Col):
-                return col_origin(plan.child, e.name)
-        return None
-    if isinstance(plan, LJoin):
-        if name in plan.left.output_names():
-            return col_origin(plan.left, name)
-        if plan.kind not in ("semi", "anti") and name in plan.right.output_names():
-            return col_origin(plan.right, name)
-        return None
-    return None
-
-
 DENSE_RF_MAX_RANGE = 1 << 22  # dense presence bitmaps up to 4M slots
+LUT_JOIN_MAX_RANGE = 1 << 24  # dense row-lookup tables up to 16M slots
 
 
-def dense_rf_range(plan_l, plan_r, probe_keys, build_keys, catalog):
+def dense_rf_range(plan_l, plan_r, probe_keys, build_keys, catalog,
+                   max_range: int = DENSE_RF_MAX_RANGE):
     """(lo, hi) for an exact IN-set runtime filter: the BUILD side's key
     range only (probe keys outside it fail in_range and are correctly
     dropped — they can't match anything); None when unbounded/too wide."""
@@ -129,7 +115,7 @@ def dense_rf_range(plan_l, plan_r, probe_keys, build_keys, catalog):
     st = t.column_stats(origin[1])
     if st.min is None or st.max is None:
         return None
-    if st.max - st.min + 1 > DENSE_RF_MAX_RANGE:
+    if st.max - st.min + 1 > max_range:
         return None
     return (st.min, st.max)
 
@@ -208,6 +194,28 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
             emit_memo[p] = out
             return out
 
+        def maybe_compact(child_plan, c, tag: str):
+            """Shrink a sparse chunk before a sort-heavy op: selective
+            filters/joins leave most capacity dead, and sort/agg/window cost
+            scales with CAPACITY, not live rows. Seeded from the cardinality
+            estimate; the overflow check recompiles on underestimates (same
+            contract as every other capacity)."""
+            if c.capacity < 8192:
+                return c
+            from ..ops.common import compact
+
+            est = estimate_rows(child_plan, catalog)
+            default = pad_capacity(int(est * 1.5) + 1024)
+            if default >= c.capacity:
+                return c
+            key = f"shrink_{tag}"
+            cap = caps.get(key, default)
+            if cap >= c.capacity:
+                return c
+            out, n = compact(c, cap)
+            checks[key] = n
+            return out
+
         def _emit(p: LogicalPlan):
             if isinstance(p, LScan):
                 return inputs[scan_index[id(p)]]
@@ -217,11 +225,13 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
                 c = emit(p.child)
                 return project(c, [e for _, e in p.exprs], [n for n, _ in p.exprs])
             if isinstance(p, LSort):
-                return sort_chunk(emit(p.child), p.keys, p.limit)
+                c = maybe_compact(p.child, emit(p.child), str(ordinal(p)))
+                return sort_chunk(c, p.keys, p.limit)
             if isinstance(p, LLimit):
                 return limit_chunk(emit(p.child), p.limit, p.offset)
             if isinstance(p, LWindow):
-                return window_op(emit(p.child), p.partition_by, p.order_by, p.funcs)
+                c = maybe_compact(p.child, emit(p.child), str(ordinal(p)))
+                return window_op(c, p.partition_by, p.order_by, p.funcs)
             if isinstance(p, LUnion):
                 from ..ops.setops import union_all
 
@@ -230,9 +240,20 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
                     out = union_all(out, emit(child))
                 return out
             if isinstance(p, LAggregate):
-                c = emit(p.child)
+                c = maybe_compact(p.child, emit(p.child), str(ordinal(p)))
                 key = f"agg_{ordinal(p)}"
-                cap = caps.get(key, 1024)
+                # a global (no-group-key) aggregation always yields one row;
+                # a 1024-slot capacity would pay a 1024-wide segment reduce
+                default = 1024 if p.group_by else 1
+                from ..ops.aggregate import bounded_domain
+                from ..runtime.config import config as _acfg
+
+                dom = bounded_domain(c, p.group_by)
+                if dom is not None and dom <= _dense_agg_domain_max(_acfg):
+                    # dense bounded domain: capacity covers it outright, the
+                    # sort-free packed-gid path applies at any cardinality
+                    default = max(default, dom)
+                cap = caps.get(key, default)
                 out, ng = hash_aggregate(c, p.group_by, p.aggs, cap)
                 checks[key] = ng
                 return out
@@ -293,6 +314,29 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
                 [] if p.kind in ("semi", "anti") else list(p.right.output_names())
             )
 
+            # direct-addressing LUT join: unique single-key build with a
+            # stats-bounded key range skips sort+searchsorted AND the
+            # runtime filter (the LUT is already an exact membership test)
+            from ..ops.join import hash_join_lut
+
+            lut_range = None
+            if (unique and len(probe_keys) == 1
+                    and p.kind in ("inner", "left", "semi", "anti")
+                    and not (residual and p.kind != "inner")):
+                lut_range = dense_rf_range(
+                    p.left, p.right, probe_keys, build_keys, catalog,
+                    max_range=LUT_JOIN_MAX_RANGE,
+                )
+            if lut_range is not None:
+                lo, hi = lut_range
+                out = hash_join_lut(
+                    lc, rc, tuple(probe_keys), tuple(build_keys),
+                    lo, int(hi - lo + 1), kind, payload=payload,
+                )
+                if residual:
+                    out = filter_chunk(out, and_all(residual))
+                return out
+
             # build-side min/max runtime filter on the probe (INNER/SEMI only —
             # LEFT OUTER/ANTI must keep non-matching probe rows)
             from ..runtime.config import config as _cfg
@@ -308,10 +352,16 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
                                         dense_range=dr)
                 )
 
+            lc = maybe_compact(p.left, lc, f"{ordinal(p)}l")
+            # the sorted join paths argsort the BUILD side at full capacity —
+            # compact it first when it is sparse (filtered dimension chains)
+            rc = maybe_compact(p.right, rc, f"{ordinal(p)}r")
+
             if residual and p.kind in ("semi", "anti"):
                 # Residual-capable (anti)semi join: tag probe rows with a rowid,
-                # inner-expand on the equi keys, filter by the residual, derive
-                # the set of matched rowids, then (anti)semi-join on rowid.
+                # inner-expand on the equi keys, filter by the residual, and
+                # reduce matched rowids (duplicates: one per surviving match)
+                # to a per-probe-row presence mask.
                 # (TPC-H Q21's correlated <> predicates take this path.)
                 import jax.numpy as jnp
 
@@ -331,15 +381,28 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
                 )
                 checks[key] = total
                 matched = filter_chunk(expanded, and_all(residual))
-                ids, _ = hash_aggregate(
-                    matched, ((rid, Col(rid)),), (), lc.capacity
+                mdata, _ = matched.col(rid)
+                midx = jnp.where(
+                    matched.sel_mask(), jnp.asarray(mdata, jnp.int64),
+                    lc.capacity,
                 )
-                out = hash_join_unique(
-                    lc2, ids, (Col(rid),), (Col(rid),),
-                    LEFT_SEMI if p.kind == "semi" else LEFT_ANTI,
-                    payload=[],
-                )
-                return out
+                from ..ops.segment import _use_mxu
+
+                if _use_mxu():
+                    # scatter-free membership: midx holds DUPLICATE rowids
+                    # (many matches per probe row), the scatter shape TPU
+                    # serializes on — sort once, membership by searchsorted
+                    srt = jnp.sort(midx)
+                    rowid_q = jnp.arange(lc.capacity, dtype=jnp.int64)
+                    pos = jnp.clip(jnp.searchsorted(srt, rowid_q), 0,
+                                   srt.shape[0] - 1)
+                    present = srt[pos] == rowid_q
+                else:
+                    # CPU: the duplicate-index bitmap scatter is cheapest
+                    present = jnp.zeros((lc.capacity,), jnp.bool_).at[
+                        midx
+                    ].max(jnp.ones_like(midx, jnp.bool_), mode="drop")
+                return lc.and_sel(present if p.kind == "semi" else ~present)
 
             if unique and p.kind in ("inner", "left", "semi", "anti"):
                 if residual and p.kind != "inner":

@@ -57,6 +57,25 @@ class TableHandle:
             self._stats[col] = st
         return self._stats[col]
 
+    def column_ndv(self, col: str) -> Optional[int]:
+        """Exact distinct count, computed once per column on the host (the
+        ANALYZE analog; reference statistic/StatisticsCollectJob). Drives
+        join-cardinality estimates in the cost-based join ordering."""
+        st = self.column_stats(col)
+        if st.n_distinct is None:
+            try:
+                a = self.table.arrays[col]
+            except Exception:  # noqa: BLE001 — stats must never fail a query
+                return None
+            if len(a) == 0:
+                st.n_distinct = 0
+            else:
+                v = self.table.valids.get(col)
+                if v is not None:
+                    a = a[np.asarray(v)]
+                st.n_distinct = int(len(np.unique(a)))
+        return st.n_distinct
+
 
 class StoredTableHandle(TableHandle):
     """Lazy handle over a TabletStore table (loads + caches on first read).

@@ -17,6 +17,17 @@ from starrocks_tpu.ops.segment import (
 from starrocks_tpu.runtime.config import config
 
 
+@pytest.fixture(autouse=True)
+def _force_mxu_strategies():
+    """On CPU `auto` routes everything to plain scatters; pin the MXU
+    strategies so the differential tests keep covering those branches."""
+    config.set("segment_strategy", "mxu")
+    try:
+        yield
+    finally:
+        config.set("segment_strategy", "auto")
+
+
 def _rand_case(n, g, rng, big=False):
     gid = rng.integers(0, g + 1, size=n)  # g == dead marker
     if big:
