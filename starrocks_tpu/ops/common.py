@@ -103,3 +103,13 @@ def compact(chunk: Chunk, capacity: int | None = None):
     )
     sel = jnp.arange(out_cap) < n
     return Chunk(chunk.schema, data, valid, sel), n
+
+
+def mix64(x):
+    """splitmix64 finalizer over uint64 lanes (good avalanche, no scatter).
+    THE hash of the engine: exchange routing and join fingerprints both use
+    it — they must never diverge (equal keys must route AND match alike)."""
+    z = jnp.asarray(x, jnp.uint64)
+    z = (z ^ (z >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return z ^ (z >> 31)

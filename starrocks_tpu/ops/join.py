@@ -19,13 +19,16 @@ NULL join keys never match (SQL equality semantics).
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
 from .. import types as T
 from ..column.column import Chunk, Field, Schema
 from ..exprs.compile import ExprCompiler
-from .common import eval_keys
+from ..exprs.ir import Col
+from .common import eval_keys, mix64
 
 INNER = "inner"
 LEFT_OUTER = "left_outer"
@@ -35,31 +38,127 @@ LEFT_ANTI = "left_anti"
 _I64MAX = jnp.iinfo(jnp.int64).max
 
 
-def pack_keys(chunk: Chunk, key_exprs, bit_widths=None):
-    """Evaluate key exprs and pack them into one int64 per row.
-
-    bit_widths[i] = bits reserved for key i (from planner stats); when None a
-    single key is used as-is. NULL any-key or dead row -> sentinel INT64 MAX
-    (sorts last, never matches a probe because probe NULLs are also masked).
-    Returns (packed[cap] int64, ok[cap] bool) where ok = live & all keys valid.
-    """
-    keys = eval_keys(chunk, key_exprs)
-    live = chunk.sel_mask()
+def _pack_evals(keys, live, capacity: int, bit_widths):
+    """Pack evaluated key EVals into one int64 per row (see pack_keys)."""
     ok = live
     for k in keys:
         if k.valid is not None:
             ok = ok & k.valid
     if len(keys) == 1 and bit_widths is None:
         packed = jnp.asarray(keys[0].data, jnp.int64)
+    elif bit_widths == "hash":
+        h = jnp.zeros((capacity,), jnp.uint64)
+        for k in keys:
+            kd = jnp.asarray(k.data)
+            if not jnp.issubdtype(kd.dtype, jnp.integer):
+                kd = jnp.asarray(kd, jnp.float64)
+                kd = jnp.where(kd == 0, 0.0, kd)  # -0.0 == +0.0 in SQL
+                kd = kd.view(jnp.int64)
+            kh = mix64(jnp.asarray(kd, jnp.int64).view(jnp.uint64))
+            # boost hash_combine: order-sensitive, avalanched
+            h = mix64(h ^ (kh + jnp.uint64(0x9E3779B97F4A7C15)
+                            + (h << 6) + (h >> 2)))
+        packed = h.view(jnp.int64)
+        # keep the NULL/dead sentinel unambiguous
+        packed = jnp.where(packed == _I64MAX, _I64MAX - 1, packed)
     else:
         assert bit_widths is not None and len(bit_widths) == len(keys), (
             "multi-key join requires planner-provided bit widths"
         )
-        packed = jnp.zeros((chunk.capacity,), jnp.int64)
+        packed = jnp.zeros((capacity,), jnp.int64)
         for k, w in zip(keys, bit_widths):
             kd = jnp.asarray(k.data, jnp.int64)
             packed = (packed << w) | (kd & ((1 << w) - 1))
     return jnp.where(ok, packed, _I64MAX), ok
+
+
+def pack_keys(chunk: Chunk, key_exprs, bit_widths=None):
+    """Evaluate key exprs and pack them into one int64 per row.
+
+    bit_widths[i] = bits reserved for key i (from planner stats); when None a
+    single key is used as-is. bit_widths="hash": combined keys don't fit 63
+    bits — mix each key through splitmix64 into one 64-bit fingerprint
+    (collisions possible: the PLANNER must re-verify equality with residual
+    predicates; it forces the expansion join + eq residuals in that mode).
+    NULL any-key or dead row -> sentinel INT64 MAX (sorts last, never
+    matches a probe because probe NULLs are also masked).
+    Returns (packed[cap] int64, ok[cap] bool) where ok = live & all keys valid.
+
+    SINGLE-side callers only (exchange routing): dict-encoded string keys
+    pack RAW codes. Anything comparing two chunks' keys must go through
+    pack_key_pair, which aligns dictionaries first.
+    """
+    keys = eval_keys(chunk, key_exprs)
+    return _pack_evals(keys, chunk.sel_mask(), chunk.capacity, bit_widths)
+
+
+def _align_dict_keys(pks, bks):
+    """Remap dict-encoded key pairs onto a shared merged dictionary.
+
+    Per-column StringDicts assign codes independently, so raw-code equality
+    across two tables is meaningless (t1.'b'==code 1 vs t2.'b'==code 0).
+    Dictionaries are trace-time constants: merge once per key pair, remap
+    both sides' codes through constant LUTs (reference analog: the global
+    dict normalization in be/src/compute_env/global_dict/)."""
+    out_p, out_b = [], []
+    for p, b in zip(pks, bks):
+        if p.dict is not None and b.dict is not None and p.dict is not b.dict:
+            m, rp, rb = p.dict.merge(b.dict)
+            lp = jnp.asarray(rp, jnp.int64)
+            lb = jnp.asarray(rb, jnp.int64)
+            pd = lp[jnp.clip(p.data, 0, max(len(p.dict) - 1, 0))] if len(
+                p.dict) else jnp.asarray(p.data, jnp.int64)
+            bd = lb[jnp.clip(b.data, 0, max(len(b.dict) - 1, 0))] if len(
+                b.dict) else jnp.asarray(b.data, jnp.int64)
+            p = dataclasses.replace(p, data=pd, dict=m)
+            b = dataclasses.replace(b, data=bd, dict=m)
+        out_p.append(p)
+        out_b.append(b)
+    return out_p, out_b
+
+
+def align_chunk_dicts(lc: Chunk, rc: Chunk, probe_keys, build_keys):
+    """Rewrite dict-encoded join-key COLUMNS of both chunks onto merged
+    dictionaries (Col keys only). Needed when the two sides are routed
+    independently — e.g. the distributed hash shuffle packs each side's
+    codes separately, so equal strings must carry equal codes BEFORE the
+    exchange, not just inside the join kernel."""
+    for pk, bk in zip(probe_keys, build_keys):
+        if not (isinstance(pk, Col) and isinstance(bk, Col)):
+            continue
+        fi = lc.schema.index(pk.name)
+        gi = rc.schema.index(bk.name)
+        fp, fb = lc.schema.fields[fi], rc.schema.fields[gi]
+        if fp.dict is None or fb.dict is None or fp.dict is fb.dict:
+            continue
+        m, rp, rb = fp.dict.merge(fb.dict)
+
+        def remap(chunk, i, f, lut, old_len, merged):
+            codes = chunk.data[i]
+            if old_len:
+                codes = jnp.asarray(lut, jnp.int64)[
+                    jnp.clip(codes, 0, old_len - 1)]
+            data = chunk.data[:i] + (codes,) + chunk.data[i + 1:]
+            fields = list(chunk.schema.fields)
+            fields[i] = dataclasses.replace(f, dict=merged)
+            return Chunk(Schema(tuple(fields)), data, chunk.valid, chunk.sel)
+
+        lc = remap(lc, fi, fp, rp, len(fp.dict), m)
+        rc = remap(rc, gi, fb, rb, len(fb.dict), m)
+    return lc, rc
+
+
+def pack_key_pair(probe: Chunk, build: Chunk, probe_keys, build_keys,
+                  bit_widths=None):
+    """pack_keys for a probe/build pair: aligns string dictionaries between
+    the sides before packing so code equality means string equality.
+    Returns (pk, p_ok, bk, b_ok)."""
+    pks = eval_keys(probe, probe_keys)
+    bks = eval_keys(build, build_keys)
+    pks, bks = _align_dict_keys(pks, bks)
+    pk, p_ok = _pack_evals(pks, probe.sel_mask(), probe.capacity, bit_widths)
+    bk, b_ok = _pack_evals(bks, build.sel_mask(), build.capacity, bit_widths)
+    return pk, p_ok, bk, b_ok
 
 
 def runtime_filter_mask(
@@ -81,8 +180,8 @@ def runtime_filter_mask(
       dimension build passes only its surviving keys.
 
     Only valid for INNER/LEFT SEMI joins (probe rows may be dropped)."""
-    bk, b_ok = pack_keys(build, build_keys, bit_widths)
-    pk, p_ok = pack_keys(probe, probe_keys, bit_widths)
+    pk, p_ok, bk, b_ok = pack_key_pair(
+        probe, build, probe_keys, build_keys, bit_widths)
     if dense_range is not None:
         lo, hi = dense_range
         size = int(hi - lo + 1)
@@ -128,8 +227,9 @@ def hash_join_unique(
     Output chunk has probe's capacity: probe columns + gathered build payload.
     """
     payload = list(payload if payload is not None else build.schema.names)
-    pk, p_ok = pack_keys(probe, probe_keys, bit_widths)
-    bk, _ = pack_keys(build, build_keys, bit_widths)  # build NULL/dead rows pack to the sentinel
+    pk, p_ok, bk, _b_ok = pack_key_pair(
+        probe, build, probe_keys, build_keys, bit_widths
+    )  # build NULL/dead rows pack to the sentinel
 
     order = jnp.argsort(bk, stable=True)  # sentinels (dead/null) go last
     bk_sorted = bk[order]
@@ -195,8 +295,7 @@ def hash_join_lut(
     (be/src/exec/join_hash_map.h DirectMappingJoinHashMap).
     """
     payload = list(payload if payload is not None else build.schema.names)
-    pk, p_ok = pack_keys(probe, probe_keys, None)
-    bk, b_ok = pack_keys(build, build_keys, None)
+    pk, p_ok, bk, b_ok = pack_key_pair(probe, build, probe_keys, build_keys)
 
     # dead/NULL build rows land in the spill slot (dropped)
     idxb = jnp.where(b_ok, bk - lo, size)
@@ -230,8 +329,9 @@ def hash_join_expand(
     Returns (chunk, true_rows).
     """
     payload = list(payload if payload is not None else build.schema.names)
-    pk, p_ok = pack_keys(probe, probe_keys, bit_widths)
-    bk, _ = pack_keys(build, build_keys, bit_widths)  # build NULL/dead rows pack to the sentinel
+    pk, p_ok, bk, _b_ok = pack_key_pair(
+        probe, build, probe_keys, build_keys, bit_widths
+    )  # build NULL/dead rows pack to the sentinel
 
     order = jnp.argsort(bk, stable=True)
     bk_sorted = bk[order]

@@ -49,12 +49,10 @@ def all_gather_chunk(chunk: Chunk, axis: str) -> Chunk:
 
 
 def hash_hash64(x: jnp.ndarray) -> jnp.ndarray:
-    """Cheap 64-bit integer mix (splitmix64 finalizer)."""
-    z = jnp.asarray(x, jnp.uint64)
-    z = (z ^ (z >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
-    z = (z ^ (z >> 27)) * jnp.uint64(0x94D049BB133111EB)
-    z = z ^ (z >> 31)
-    return z
+    """Cheap 64-bit integer mix (shared splitmix64; see ops.common.mix64)."""
+    from ..ops.common import mix64
+
+    return mix64(x)
 
 
 def shuffle_chunk(

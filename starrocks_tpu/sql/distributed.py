@@ -79,12 +79,17 @@ def _default_bucket_cap(capacity: int, n_shards: int) -> int:
 
 
 def estimated_group_ndv(p: LAggregate, catalog):
-    """Upper bound on GROUP BY cardinality from ingest column stats: the
-    product over group keys of (max-min+1). None when any key is a non-Col
-    expression or lacks integer stats (then the planner stays BROADCAST)."""
+    """Upper bound on GROUP BY cardinality: product over group keys of the
+    exact per-column distinct counts (collected once per column in the
+    catalog — the ANALYZE analog), capped by the child's estimated row
+    count (the tuple NDV can't exceed the rows feeding the agg; the old
+    (max-min+1) range product over-estimated sparse/multi-key groups by
+    orders of magnitude and pushed plans into shuffle-final aggregation
+    with huge seeded capacities). None when any key is a non-Col expression
+    or unresolvable (then the planner stays BROADCAST)."""
     if not p.group_by:
         return 0
-    from .physical import col_origin
+    from .optimizer import col_origin, estimate_rows
 
     total = 1
     for _, e in p.group_by:
@@ -96,13 +101,13 @@ def estimated_group_ndv(p: LAggregate, catalog):
         t = catalog.get_table(origin[0])
         if t is None:
             return None
-        st = t.column_stats(origin[1])
-        if st.min is None or st.max is None:
+        ndv = t.column_ndv(origin[1])
+        if ndv is None:
             return None
-        total *= int(st.max) - int(st.min) + 1
+        total *= max(int(ndv), 1)
         if total > (1 << 40):
-            return total
-    return total
+            break
+    return min(total, int(max(estimate_rows(p.child, catalog), 1.0)))
 
 
 def _single_sort_rank(chunk, sort_keys):
@@ -432,25 +437,40 @@ def compile_distributed(
                     rc = all_gather_chunk(rc, axis)
                     rm = REPLICATED
             else:
-                bit_widths = None
-                if len(probe_keys) > 1:
-                    widths = []
-                    for pk, bk in zip(probe_keys, build_keys):
-                        w1 = _key_bit_width(p.left, pk, catalog)
-                        w2 = _key_bit_width(p.right, bk, catalog)
-                        if w1 is None or w2 is None:
-                            widths = None
+                from .physical import choose_key_packing
+
+                bit_widths, residual, unique = choose_key_packing(
+                    p, probe_keys, build_keys, residual, catalog
+                )
+                # equal strings must carry equal codes before any
+                # per-side routing (shuffle/colocate placement)
+                from ..ops.join import align_chunk_dicts
+
+                lc2, rc2 = align_chunk_dicts(lc, rc, probe_keys, build_keys)
+                if lc2 is not lc or rc2 is not rc:
+                    # remapped codes no longer match the host hash placement
+                    # of a colocate scan: drop placement claims, force the
+                    # generic shuffle on the merged codes
+                    lc, rc = lc2, rc2
+                    lm = SHARDED if _is_dist(lm) else lm
+                    rm = SHARDED if _is_dist(rm) else rm
+                if _is_dist(lm) and _is_dist(rm):
+                    # dict-typed EXPRESSION keys (upper(k) etc.) build fresh
+                    # per-side dicts whose codes can't be aligned at the
+                    # column level above — per-side shuffle routing would
+                    # send equal strings to different shards. Gather the
+                    # build side instead: the local join kernel aligns
+                    # evaluated keys itself (pack_key_pair).
+                    pks_e = eval_keys(lc, tuple(probe_keys))
+                    bks_e = eval_keys(rc, tuple(build_keys))
+                    for pe, be, pk_x, bk_x in zip(
+                            pks_e, bks_e, probe_keys, build_keys):
+                        if ((pe.dict is not None or be.dict is not None)
+                                and not (isinstance(pk_x, Col)
+                                         and isinstance(bk_x, Col))):
+                            rc = all_gather_chunk(rc, axis)
+                            rm = REPLICATED
                             break
-                        widths.append(max(w1, w2))
-                    if widths is None or sum(widths) > 63:
-                        raise PlanError("multi-key join without packable stats")
-                    bit_widths = tuple(widths)
-                build_key_names = frozenset(
-                    k.name for k in build_keys if isinstance(k, Col)
-                )
-                unique = len(build_key_names) == len(build_keys) and any(
-                    s <= build_key_names for s in unique_sets(p.right, catalog)
-                )
 
             # build-side min/max runtime filter; with a sharded build the local
             # bounds merge across shards via pmin/pmax (global-RF collective)

@@ -135,6 +135,50 @@ def _key_bit_width(plan, key: Expr, catalog) -> Optional[int]:
     return max(int(st.max).bit_length() + 1, 2)
 
 
+def choose_key_packing(p, probe_keys, build_keys, residual, catalog):
+    """Decide how a join's key tuple packs into one int64 — shared by the
+    single-chip and distributed compilers so their plans can never diverge.
+
+    Returns (bit_widths, residual, unique):
+    - bit_widths: None (single key as-is) | tuple of per-key bit widths from
+      catalog stats | "hash" when the tuple doesn't fit 63 bits (wide ranges,
+      strings, missing stats) — then the join runs on a 64-bit splitmix64
+      fingerprint and equality is RE-VERIFIED by eq residuals appended here
+      (collisions force the expansion join; the reference joins arbitrary
+      key tuples via its hash table, this is the compiled-world equivalent);
+    - unique: build side provably unique on the keys (never trusted in hash
+      mode — fingerprint collisions would break the 1:1 gather join).
+    """
+    bit_widths = None
+    if len(probe_keys) > 1:
+        widths = []
+        for pk, bk in zip(probe_keys, build_keys):
+            w1 = _key_bit_width(p.left, pk, catalog)
+            w2 = _key_bit_width(p.right, bk, catalog)
+            if w1 is None or w2 is None:
+                widths = None
+                break
+            widths.append(max(w1, w2))
+        if widths is None or sum(widths) > 63:
+            bit_widths = "hash"
+            residual = residual + [
+                Call("eq", pk, bk)
+                for pk, bk in zip(probe_keys, build_keys)
+            ]
+        else:
+            bit_widths = tuple(widths)
+    if bit_widths == "hash":
+        unique = False
+    else:
+        build_key_names = frozenset(
+            k.name for k in build_keys if isinstance(k, Col)
+        )
+        unique = len(build_key_names) == len(build_keys) and any(
+            s <= build_key_names for s in unique_sets(p.right, catalog)
+        )
+    return bit_widths, residual, unique
+
+
 # --- compilation -------------------------------------------------------------
 
 
@@ -288,26 +332,8 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
                 bit_widths = (2,)
                 unique = False
             else:
-                bit_widths = None
-                if len(probe_keys) > 1:
-                    widths = []
-                    for pk, bk in zip(probe_keys, build_keys):
-                        w1 = _key_bit_width(p.left, pk, catalog)
-                        w2 = _key_bit_width(p.right, bk, catalog)
-                        if w1 is None or w2 is None:
-                            widths = None
-                            break
-                        widths.append(max(w1, w2))
-                    if widths is None or sum(widths) > 63:
-                        raise PlanError(
-                            "multi-key join without packable stats unsupported"
-                        )
-                    bit_widths = tuple(widths)
-                build_key_names = frozenset(
-                    k.name for k in build_keys if isinstance(k, Col)
-                )
-                unique = len(build_key_names) == len(build_keys) and any(
-                    s <= build_key_names for s in unique_sets(p.right, catalog)
+                bit_widths, residual, unique = choose_key_packing(
+                    p, probe_keys, build_keys, residual, catalog
                 )
 
             payload = (

@@ -253,3 +253,122 @@ def test_distributed_fuzz(eight_devices):
             assert _norm(s1.sql(sql).rows()) == _norm(s8.sql(sql).rows()), sql
     finally:
         D.SHARD_THRESHOLD_ROWS = old
+
+
+def test_string_key_join_dict_alignment(eight_devices):
+    """Join keys that are dict-encoded strings from DIFFERENT tables must
+    compare by VALUE, not by per-column code (regression: raw-code equality
+    silently matched t1.'a' with t2.'b'). Distributed shuffles must route
+    both sides' equal strings to the same shard."""
+    import numpy as np
+
+    from starrocks_tpu.column import HostTable
+    from starrocks_tpu.storage.catalog import Catalog
+
+    rng = np.random.default_rng(7)
+    words1 = [f"w{i:03d}" for i in range(40)]
+    words2 = [f"w{i:03d}" for i in range(20, 60)]  # overlapping, shifted codes
+    n = 30_000  # above the lowered shard threshold so both sides shard
+    old = D.SHARD_THRESHOLD_ROWS
+    D.SHARD_THRESHOLD_ROWS = 10_000
+    try:
+        cat = Catalog()
+        cat.register("s1", HostTable.from_pydict({
+            "k": [words1[i] for i in rng.integers(0, 40, n)],
+            "x": list(range(n)),
+        }))
+        cat.register("s2", HostTable.from_pydict({
+            "k": [words2[i] for i in rng.integers(0, 40, n)],
+            "y": list(rng.integers(0, 1000, n)),
+        }))
+        q = ("SELECT s1.k AS k, count(*) AS c, sum(y) AS sy FROM s1 "
+             "JOIN s2 ON s1.k = s2.k GROUP BY s1.k ORDER BY k")
+        single = Session(cat).sql(q).rows()
+        dist = Session(cat, dist_shards=8).sql(q).rows()
+        # pandas oracle
+        import pandas as pd
+
+        d1 = cat.get_table("s1").table.to_pandas()
+        d2 = cat.get_table("s2").table.to_pandas()
+        m = d1.merge(d2, on="k")
+        exp = (m.groupby("k").agg(c=("y", "size"), sy=("y", "sum"))
+               .reset_index().sort_values("k"))
+        expected = [(r.k, int(r.c), int(r.sy)) for r in exp.itertuples()]
+        assert [(k, int(c), int(sy)) for k, c, sy in single] == expected
+        _same(single, dist, "string_join")
+    finally:
+        D.SHARD_THRESHOLD_ROWS = old
+
+
+def test_unpackable_multikey_join_hash_fallback(eight_devices):
+    """Key tuples that exceed 63 packed bits (floats/strings/no stats) join
+    via a splitmix64 fingerprint + equality residuals — single-chip and
+    mesh agree and match a pandas oracle."""
+    import numpy as np
+    import pandas as pd
+
+    from starrocks_tpu.column import HostTable
+    from starrocks_tpu.storage.catalog import Catalog
+
+    rng = np.random.default_rng(11)
+    n = 30_000
+    old = D.SHARD_THRESHOLD_ROWS
+    D.SHARD_THRESHOLD_ROWS = 10_000
+    try:
+        cat = Catalog()
+        a = rng.integers(0, 500, n)
+        b = rng.choice([0.5, 1.5, 2.5, -3.0, 1e12], n)
+        cat.register("f1", HostTable.from_pydict(
+            {"a": list(a), "b": list(b), "x": list(range(n))}))
+        a2 = rng.integers(0, 500, n)
+        b2 = rng.choice([0.5, 1.5, 2.5, -3.0, 7.0], n)
+        cat.register("f2", HostTable.from_pydict(
+            {"a": list(a2), "b": list(b2), "y": list(range(n))}))
+        q = ("SELECT a, count(*) AS c, sum(y) AS sy FROM ("
+             "SELECT f1.a AS a, y FROM f1 JOIN f2 "
+             "ON f1.a = f2.a AND f1.b = f2.b) t GROUP BY a ORDER BY a")
+        single = Session(cat).sql(q).rows()
+        dist = Session(cat, dist_shards=8).sql(q).rows()
+        d1 = pd.DataFrame({"a": a, "b": b})
+        d2 = pd.DataFrame({"a": a2, "b": b2, "y": range(n)})
+        m = d1.merge(d2, on=["a", "b"])
+        exp = (m.groupby("a").agg(c=("y", "size"), sy=("y", "sum"))
+               .reset_index().sort_values("a"))
+        expected = [(int(r.a), int(r.c), int(r.sy)) for r in exp.itertuples()]
+        assert [(int(aa), int(c), int(sy)) for aa, c, sy in single] == expected
+        _same(single, dist, "hash_multikey")
+    finally:
+        D.SHARD_THRESHOLD_ROWS = old
+
+
+def test_string_expression_key_join_distributed(eight_devices):
+    """Join keys that are string EXPRESSIONS (fresh per-side dicts) can't be
+    aligned at the column level — the planner must gather the build side
+    rather than shuffle both sides by incomparable codes."""
+    import numpy as np
+
+    from starrocks_tpu.column import HostTable
+    from starrocks_tpu.storage.catalog import Catalog
+
+    rng = np.random.default_rng(13)
+    n = 30_000
+    w1 = [f"K{i:03d}" for i in range(40)]
+    w2 = [f"k{i:03d}" for i in range(20, 60)]
+    old = D.SHARD_THRESHOLD_ROWS
+    D.SHARD_THRESHOLD_ROWS = 10_000
+    try:
+        cat = Catalog()
+        cat.register("e1", HostTable.from_pydict({
+            "k": [w1[i] for i in rng.integers(0, 40, n)],
+            "x": list(range(n))}))
+        cat.register("e2", HostTable.from_pydict({
+            "k": [w2[i] for i in rng.integers(0, 40, n)],
+            "y": list(rng.integers(0, 100, n))}))
+        q = ("SELECT count(*) AS c, sum(y) AS sy FROM e1 JOIN e2 "
+             "ON lower(e1.k) = lower(e2.k)")
+        single = Session(cat).sql(q).rows()
+        dist = Session(cat, dist_shards=8).sql(q).rows()
+        assert single[0][0] > 0
+        _same(single, dist, "string_expr_join")
+    finally:
+        D.SHARD_THRESHOLD_ROWS = old
