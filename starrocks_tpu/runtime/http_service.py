@@ -104,10 +104,26 @@ class SqlHttpServer:
             self._thread.join(timeout=5)
 
 
-def serve(data_dir: str | None = None, port: int = 8030):
-    """CLI entry: python -m starrocks_tpu.runtime.http_service"""
+def serve(data_dir: str | None = None, port: int = 8030,
+          mysql_port: int = 9030):
+    """CLI entry: python -m starrocks_tpu.runtime.http_service
+
+    Serves BOTH front doors over one session (the reference FE listens on
+    http_port 8030 and query_port 9030 the same way): HTTP JSON on `port`,
+    MySQL protocol on `mysql_port` (0 disables)."""
     s = Session(data_dir=data_dir)
     srv = SqlHttpServer(s, port=port)
+    if mysql_port:
+        from .mysql_service import MySQLServer
+
+        try:
+            my = MySQLServer(s, port=mysql_port, lock=srv._lock).start()
+            print(f"starrocks_tpu MySQL protocol on 127.0.0.1:{my.port}")
+        except OSError as e:
+            # HTTP service must survive a busy query port (9030 may host a
+            # real FE on shared boxes); pass mysql_port=0 to silence
+            print(f"mysql port {mysql_port} unavailable ({e}); "
+                  "continuing HTTP-only")
     print(f"starrocks_tpu SQL service on http://127.0.0.1:{srv.port}")
     srv.httpd.serve_forever()
 
@@ -118,4 +134,5 @@ if __name__ == "__main__":
     serve(
         data_dir=sys.argv[1] if len(sys.argv) > 1 else None,
         port=int(sys.argv[2]) if len(sys.argv) > 2 else 8030,
+        mysql_port=int(sys.argv[3]) if len(sys.argv) > 3 else 9030,
     )

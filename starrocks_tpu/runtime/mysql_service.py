@@ -1,0 +1,322 @@
+"""MySQL wire protocol front door.
+
+Reference behavior: the FE's MySQL protocol server — the entry point for
+every standard client, driver, and BI tool
+(fe/fe-core/src/main/java/com/starrocks/mysql/MysqlServer.java:55,
+mysql/nio/AcceptListener.java:57 accept loop, mysql/MysqlProto.java
+handshake/auth negotiation, qe/ConnectProcessor.java:679 COM_* dispatch)
+with result-set encoding per be/src/data_sink/result/mysql_result_writer.h:48.
+
+Implemented subset (enough for the `mysql` CLI, Connector-family drivers and
+pymysql to connect and query):
+- protocol 10 initial handshake + HandshakeResponse41 (auth is accepted for
+  any user — AUTH/RBAC is a separate subsystem);
+- command phase: COM_QUERY (text resultset), COM_PING, COM_INIT_DB,
+  COM_QUIT, COM_FIELD_LIST (deprecated no-op), everything else -> ERR;
+- Protocol::ColumnDefinition41 column metadata with engine->MySQL type
+  mapping, lenenc text rows, EOF framing (CLIENT_DEPRECATE_EOF not
+  advertised, so old and new clients both parse us);
+- multi-statement off, prepared statements not implemented (COM_STMT_* ->
+  ERR 1295).
+
+One Session per server; queries serialize on a lock (single-controller
+engine), same as the HTTP service.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+from .. import types as T
+from .session import Session
+
+# --- capability flags (mysql_com.h) ------------------------------------------
+CLIENT_LONG_PASSWORD = 0x0001
+CLIENT_FOUND_ROWS = 0x0002
+CLIENT_LONG_FLAG = 0x0004
+CLIENT_CONNECT_WITH_DB = 0x0008
+CLIENT_PROTOCOL_41 = 0x0200
+CLIENT_TRANSACTIONS = 0x2000
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x0008_0000
+
+SERVER_CAPS = (
+    CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS | CLIENT_LONG_FLAG
+    | CLIENT_CONNECT_WITH_DB | CLIENT_PROTOCOL_41 | CLIENT_TRANSACTIONS
+    | CLIENT_SECURE_CONNECTION | CLIENT_PLUGIN_AUTH
+)
+
+CHARSET_UTF8MB4 = 45  # utf8mb4_general_ci
+SERVER_STATUS_AUTOCOMMIT = 0x0002
+
+# --- MySQL column types (binary protocol type codes) --------------------------
+MYSQL_TYPE_TINY = 1
+MYSQL_TYPE_LONG = 3
+MYSQL_TYPE_DOUBLE = 5
+MYSQL_TYPE_LONGLONG = 8
+MYSQL_TYPE_DATE = 10
+MYSQL_TYPE_DATETIME = 12
+MYSQL_TYPE_VAR_STRING = 253
+MYSQL_TYPE_NEWDECIMAL = 246
+
+
+def _mysql_type(lt) -> int:
+    k = lt.kind
+    if k is T.TypeKind.BOOLEAN:
+        return MYSQL_TYPE_TINY
+    if k in (T.TypeKind.TINYINT, T.TypeKind.SMALLINT, T.TypeKind.INT):
+        return MYSQL_TYPE_LONG
+    if k is T.TypeKind.BIGINT:
+        return MYSQL_TYPE_LONGLONG
+    if k in (T.TypeKind.FLOAT, T.TypeKind.DOUBLE):
+        return MYSQL_TYPE_DOUBLE
+    if k is T.TypeKind.DECIMAL:
+        return MYSQL_TYPE_NEWDECIMAL
+    if k is T.TypeKind.DATE:
+        return MYSQL_TYPE_DATE
+    if k is T.TypeKind.DATETIME:
+        return MYSQL_TYPE_DATETIME
+    return MYSQL_TYPE_VAR_STRING
+
+
+# --- wire primitives ----------------------------------------------------------
+
+
+def lenenc_int(n: int) -> bytes:
+    if n < 0xFB:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def lenenc_str(s: bytes) -> bytes:
+    return lenenc_int(len(s)) + s
+
+
+class _Conn:
+    """One client connection: packet framing + protocol state."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.seq = 0
+
+    # packet = 3-byte little-endian length, 1-byte sequence id, payload
+    def read_packet(self) -> bytes:
+        head = self._read_n(4)
+        if head is None:
+            return None
+        (ln,) = struct.unpack("<I", head[:3] + b"\x00")
+        self.seq = (head[3] + 1) & 0xFF
+        return self._read_n(ln)
+
+    def _read_n(self, n: int):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def send_packet(self, payload: bytes):
+        # 16MB+ payloads would need continuation packets; result rows are
+        # emitted one packet per row so only a single enormous cell hits this
+        assert len(payload) < 0xFFFFFF, "oversized packet"
+        self.sock.sendall(
+            struct.pack("<I", len(payload))[:3] + bytes([self.seq]) + payload
+        )
+        self.seq = (self.seq + 1) & 0xFF
+
+    # --- composite packets ---
+    def send_handshake(self, thread_id: int):
+        self.seq = 0
+        salt = b"01234567890123456789"  # auth unused; fixed salt is fine
+        p = (
+            b"\x0a"  # protocol version 10
+            + b"8.0.33-starrocks-tpu\x00"
+            + struct.pack("<I", thread_id)
+            + salt[:8] + b"\x00"
+            + struct.pack("<H", SERVER_CAPS & 0xFFFF)
+            + bytes([CHARSET_UTF8MB4])
+            + struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+            + struct.pack("<H", SERVER_CAPS >> 16)
+            + bytes([21])  # auth plugin data length
+            + b"\x00" * 10
+            + salt[8:] + b"\x00"
+            + b"mysql_native_password\x00"
+        )
+        self.send_packet(p)
+
+    def send_ok(self, affected: int = 0, info: bytes = b""):
+        self.send_packet(
+            b"\x00" + lenenc_int(affected) + lenenc_int(0)
+            + struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+            + struct.pack("<H", 0) + info
+        )
+
+    def send_eof(self):
+        self.send_packet(
+            b"\xfe" + struct.pack("<H", 0)
+            + struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+        )
+
+    def send_err(self, code: int, msg: str, sqlstate: bytes = b"HY000"):
+        self.send_packet(
+            b"\xff" + struct.pack("<H", code) + b"#" + sqlstate
+            + msg.encode("utf-8", "replace")[:1000]
+        )
+
+    def send_column_def(self, name: str, lt):
+        p = (
+            lenenc_str(b"def")                    # catalog
+            + lenenc_str(b"")                     # schema
+            + lenenc_str(b"")                     # table
+            + lenenc_str(b"")                     # org_table
+            + lenenc_str(name.encode())           # name
+            + lenenc_str(name.encode())           # org_name
+            + lenenc_int(0x0C)                    # fixed-length fields
+            + struct.pack("<H", CHARSET_UTF8MB4)
+            + struct.pack("<I", 255)              # column_length
+            + bytes([_mysql_type(lt)])
+            + struct.pack("<H", 0)                # flags
+            + bytes([31])                         # decimals
+            + b"\x00\x00"
+        )
+        self.send_packet(p)
+
+
+def _cell(v) -> bytes:
+    if v is None:
+        return b"\xfb"
+    if isinstance(v, bool):
+        v = int(v)
+    if isinstance(v, float):
+        s = repr(v)
+    else:
+        s = str(v)
+    return lenenc_str(s.encode("utf-8", "replace"))
+
+
+class MySQLServer:
+    """Threaded MySQL-protocol server over a shared Session."""
+
+    def __init__(self, session: Session, host="127.0.0.1", port=9030,
+                 lock: threading.Lock | None = None):
+        self.session = session
+        self.lock = lock or threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                outer._serve(self.request)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self.server = Server((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread_ids = iter(range(1, 1 << 30))
+
+    def start(self):
+        t = threading.Thread(target=self.server.serve_forever, daemon=True)
+        t.start()
+        return self
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    # --- connection lifecycle -------------------------------------------------
+    def _serve(self, sock: socket.socket):
+        conn = _Conn(sock)
+        conn.send_handshake(next(self._thread_ids))
+        resp = conn.read_packet()
+        if resp is None:
+            return
+        # HandshakeResponse41: accept anyone (no AUTH subsystem yet); a
+        # COM_INIT_DB-style default database in the response is ignored —
+        # there is a single catalog.
+        conn.send_ok()
+        while True:
+            conn.seq = 0
+            pkt = conn.read_packet()
+            if pkt is None or not pkt:
+                return
+            conn.seq = 1
+            cmd, arg = pkt[0], pkt[1:]
+            if cmd == 0x01:  # COM_QUIT
+                return
+            if cmd == 0x0E:  # COM_PING
+                conn.send_ok()
+                continue
+            if cmd == 0x02:  # COM_INIT_DB
+                conn.send_ok()
+                continue
+            if cmd == 0x04:  # COM_FIELD_LIST (deprecated): empty list
+                conn.send_eof()
+                continue
+            if cmd == 0x03:  # COM_QUERY
+                self._query(conn, arg.decode("utf-8", "replace"))
+                continue
+            conn.send_err(1295, f"command {cmd:#x} not supported")
+
+    def _query(self, conn: _Conn, sql: str):
+        sql = sql.strip().rstrip(";")
+        # connector session boilerplate: accept silently
+        low = sql.lower()
+        if low.startswith(("set ", "commit", "rollback", "start transaction",
+                           "use ")) and not low.startswith("set global"):
+            try:
+                with self.lock:
+                    self.session.sql(sql)
+            except Exception:
+                pass  # unknown session vars from connectors are non-fatal
+            conn.send_ok()
+            return
+        try:
+            with self.lock:
+                res = self.session.sql(sql)
+        except Exception as e:  # noqa: BLE001 — every engine error -> ERR
+            conn.send_err(1064, f"{type(e).__name__}: {e}", b"42000")
+            return
+        if res is None:
+            conn.send_ok()
+            return
+        if isinstance(res, (str, int, list)):
+            if not low.startswith(("explain", "show", "desc")):
+                # DML/DDL status strings -> OK packet (MySQL semantics),
+                # status text rides in the info field
+                conn.send_ok(info=str(res).encode("utf-8", "replace"))
+                return
+            # EXPLAIN/SHOW text -> one-column resultset
+            rows = [(str(res),)] if not isinstance(res, list) else [
+                (str(r),) for r in res
+            ]
+            conn.send_packet(lenenc_int(1))
+            conn.send_column_def("result", T.VARCHAR)
+            conn.send_eof()
+            for r in rows:
+                conn.send_packet(b"".join(_cell(v) for v in r))
+            conn.send_eof()
+            return
+        table = res.table
+        fields = list(table.schema)
+        conn.send_packet(lenenc_int(len(fields)))
+        for f in fields:
+            conn.send_column_def(f.name, f.type)
+        conn.send_eof()
+        for row in table.to_pylist():
+            conn.send_packet(b"".join(_cell(v) for v in row))
+        conn.send_eof()
+
+
+def serve_mysql(catalog, host="127.0.0.1", port=9030) -> MySQLServer:
+    """Start a MySQL-protocol server over a fresh session on `catalog`."""
+    return MySQLServer(Session(catalog), host, port).start()

@@ -23,6 +23,14 @@ from ..storage.catalog import Catalog
 from .executor import DeviceCache, Executor, QueryResult
 
 
+
+def _writable(name: str):
+    """Reserve the hidden-table namespace from DML/DDL (e.g. __dual__, the
+    constant table behind FROM-less SELECT)."""
+    if name.lower().startswith("__"):
+        raise ValueError(f"table name {name!r} is reserved")
+
+
 class Session:
     """data_dir=None -> in-memory tables only; with a data_dir, DDL and loads
     persist through the TabletStore (bucketed parquet rowsets + edit log) and
@@ -259,6 +267,7 @@ class Session:
         read/compaction; here: immediate rewrite — object-store-first)."""
         from ..exprs.ir import Call, Lit
 
+        _writable(stmt.table)
         handle = self.catalog.get_table(stmt.table)
         if handle is None:
             raise ValueError(f"unknown table {stmt.table}")
@@ -282,6 +291,7 @@ class Session:
         from ..exprs.ir import Call, Case, Lit
         from ..sql import ast as A
 
+        _writable(stmt.table)
         handle = self.catalog.get_table(stmt.table)
         if handle is None:
             raise ValueError(f"unknown table {stmt.table}")
@@ -362,6 +372,7 @@ class Session:
 
     # --- DDL / DML -------------------------------------------------------------
     def _create(self, stmt: ast.CreateTable):
+        _writable(stmt.name)
         if stmt.select is not None:
             # CREATE TABLE .. AS SELECT: schema inferred from the result
             res = self._query(stmt.select)
@@ -419,6 +430,7 @@ class Session:
         return None
 
     def _insert(self, stmt: ast.Insert):
+        _writable(stmt.table)
         handle = self.catalog.get_table(stmt.table)
         if handle is None:
             raise ValueError(f"unknown table {stmt.table}")

@@ -196,8 +196,17 @@ class Analyzer:
             ctes[name.lower()] = sub
 
         if sel.from_ is None:
-            raise AnalyzerError("SELECT without FROM not supported yet")
-        plan, scope = self._analyze_relation(sel.from_, outer, ctes)
+            # FROM-less SELECT (constants, connector probes like SELECT 1):
+            # scan the hidden one-row dual table (catalog.get_table resolves
+            # "__dual__" outside the user namespace — unlistable, read-only;
+            # reference: the FE's constant-expression path in
+            # qe/StmtExecutor)
+            if any(isinstance(it.expr, ast.Star) for it in sel.items):
+                raise AnalyzerError("SELECT * requires a FROM clause")
+            plan = LScan("__dual__", "__dual__", ("__one__",))
+            scope = Scope([("__dual__", ())], outer)
+        else:
+            plan, scope = self._analyze_relation(sel.from_, outer, ctes)
 
         if sel.where is not None:
             pred = self._lower(sel.where, scope, ctes, allow_agg=False)

@@ -139,6 +139,17 @@ class Catalog:
         name = name.lower()
         if name.startswith("information_schema."):
             return self._info_schema(name.split(".", 1)[1])
+        if name == "__dual__":
+            # hidden one-row constant table backing FROM-less SELECT: never
+            # registered in `tables`, so it can't be listed, dropped, or
+            # written (DML resolves through `tables` visibility checks)
+            if not hasattr(self, "_dual"):
+                from ..column import HostTable
+
+                self._dual = TableHandle(
+                    "__dual__", HostTable.from_pydict({"__one__": [1]})
+                )
+            return self._dual
         return self.tables.get(name)
 
     def _info_schema(self, view: str) -> Optional[TableHandle]:
