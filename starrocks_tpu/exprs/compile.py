@@ -122,6 +122,54 @@ def _lit_as_date_if_str(v: EVal) -> EVal:
                 microseconds=1
             )
             return EVal(jnp.asarray(us, dtype=jnp.int64), v.valid, T.DATETIME)
+    if v.type.is_string and v.dict is not None:
+        # dict-encoded VARCHAR column in temporal context: parse every
+        # dictionary value once at trace time into a days/us LUT; rows whose
+        # string doesn't parse become NULL (reference CAST semantics)
+        days, us = [], []
+        for s in v.dict.values:
+            s = str(s).strip()
+            d = None
+            try:
+                d = datetime.date.fromisoformat(s[:10])
+            except ValueError:
+                pass
+            days.append(None if d is None else
+                        (d - datetime.date(1970, 1, 1)).days)
+            u = None
+            if d is not None and len(s) > 10:
+                try:
+                    dt = datetime.datetime.fromisoformat(s.replace(" ", "T"))
+                    u = ((dt - datetime.datetime(1970, 1, 1))
+                         // datetime.timedelta(microseconds=1))
+                except ValueError:
+                    pass
+            us.append(u)
+        if not days:
+            return v
+        n = max(len(v.dict), 1)
+        idx = jnp.clip(v.data, 0, n - 1)
+        good = [d for d in days if d is not None]
+        # parsed LUT values are trace-time constants: bounds come for free
+        # (drives date_format and the dense-domain aggregation path)
+        if any(u is not None for u in us):
+            vals = [u if u is not None else
+                    (d * 86_400_000_000 if d is not None else 0)
+                    for u, d in zip(us, days)]
+            okl = jnp.asarray(np.asarray(
+                [d is not None for d in days], np.bool_))
+            lut = jnp.asarray(np.asarray(vals, np.int64))
+            gv = [x for x, d in zip(vals, days) if d is not None]
+            b = (min(gv), max(gv)) if gv else None
+            return EVal(lut[idx], _and_valid(v.valid, okl[idx]), T.DATETIME,
+                        bounds=b)
+        lut = jnp.asarray(np.asarray(
+            [d if d is not None else 0 for d in days], np.int32))
+        okl = jnp.asarray(np.asarray(
+            [d is not None for d in days], np.bool_))
+        b = (min(good), max(good)) if good else None
+        return EVal(lut[idx], _and_valid(v.valid, okl[idx]), T.DATE,
+                    bounds=b)
     return v
 
 
@@ -212,9 +260,19 @@ class ExprCompiler:
             if fn is None:
                 raise KeyError(f"unknown function {e.fn!r}")
             return fn(self, *[self.eval(a) for a in e.args])
+        if isinstance(e, EVal):
+            return e  # pre-evaluated argument (cc.call composition)
         if isinstance(e, AggExpr):
             raise TypeError("aggregate expression in scalar context")
         raise TypeError(f"cannot evaluate {e!r}")
+
+    def call(self, name: str, *vals):
+        """Invoke a registered builtin on already-evaluated EVals (function
+        composition: alias and derived builtins delegate through this)."""
+        f = _FUNCTIONS.get(name)
+        if f is None:
+            raise KeyError(f"unknown function {name!r}")
+        return f(self, *[v for v in vals if v is not None])
 
     def eval_predicate(self, e: Expr) -> jnp.ndarray:
         """Boolean mask for filters: NULL -> False (SQL WHERE semantics)."""
@@ -461,6 +519,12 @@ def _compare_strings(cc, a: EVal, b: EVal, op):
         da = ra_t[jnp.clip(a.data, 0, len(ra) - 1)]
         db = rb_t[jnp.clip(b.data, 0, len(rb) - 1)]
         return EVal(op(da, db), _and_valid(a.valid, b.valid), T.BOOLEAN)
+    if isinstance(a.data, str) and isinstance(b.data, str):
+        # literal vs literal: rank both in a shared 2-entry dict
+        m, _ = StringDict.from_strings([a.data, b.data])
+        ra, rb = m.encode([a.data])[0], m.encode([b.data])[0]
+        return EVal(op(jnp.asarray(ra), jnp.asarray(rb)),
+                    _and_valid(a.valid, b.valid), T.BOOLEAN)
     raise NotImplementedError("string comparison without dictionaries")
 
 
@@ -704,6 +768,8 @@ def _f_date_add_months(cc, a, n):
 
 
 def _string_bool_fn(cc, a: EVal, pred) -> EVal:
+    if a.dict is None and isinstance(a.data, str):
+        return EVal(jnp.asarray(bool(pred(a.data))), a.valid, T.BOOLEAN)
     assert a.dict is not None, "string function needs a dict column"
     lut = jnp.asarray(a.dict.lut(pred))
     n = max(len(a.dict), 1)
@@ -752,6 +818,8 @@ def _f_starts_with(cc, a, pre):
 
 def _string_map_fn(cc, a: EVal, f) -> EVal:
     """string->string function via constant remap into a fresh dict."""
+    if a.dict is None and isinstance(a.data, str):
+        return EVal(str(f(a.data)), a.valid, T.VARCHAR)
     assert a.dict is not None
     mapped = [str(f(str(s))) for s in a.dict.values]
     new_dict, codes = StringDict.from_strings(mapped) if mapped else (

@@ -272,7 +272,10 @@ def _f_second(cc, a):
 @function("to_date")
 def _f_to_date(cc, a):
     a = _lit_as_date_if_str(a)
-    return EVal(_as_days(a), a.valid, T.DATE)
+    b = a.bounds
+    if b is not None and a.type.kind is T.TypeKind.DATETIME:
+        b = (int(b[0]) // 86_400_000_000, int(b[1]) // 86_400_000_000)
+    return EVal(_as_days(a), a.valid, T.DATE, bounds=b)
 
 
 function("date")(_f_to_date)
@@ -564,6 +567,8 @@ function("strright")(_f_right)
 
 
 def _string_int_fn(cc, a, f, out_t=T.INT):
+    if a.dict is None and isinstance(a.data, str):
+        return EVal(jnp.asarray(int(f(a.data)), out_t.dtype), a.valid, out_t)
     assert a.dict is not None, "string function needs a dict column"
     n = max(len(a.dict), 1)
     vals = np.fromiter((f(str(v)) for v in a.dict.values),

@@ -30,6 +30,13 @@ def project(chunk: Chunk, exprs, names) -> Chunk:
     fields, data, valid = [], [], []
     for name, e in zip(names, exprs):
         v = cc.eval(e)
+        if v.type.is_string and isinstance(v.data, str):
+            # string literal output: one-entry dictionary column
+            from ..column.dict_encoding import StringDict
+            import dataclasses as _dc
+
+            d, codes = StringDict.from_strings([v.data])
+            v = _dc.replace(v, data=jnp.asarray(codes[0]), dict=d)
         d = jnp.broadcast_to(jnp.asarray(v.data), (chunk.capacity,))
         fields.append(Field(name, v.type, v.valid is not None, v.dict,
                             bounds=v.bounds))

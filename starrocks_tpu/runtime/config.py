@@ -97,6 +97,8 @@ config.define("enable_scatter_free_segments", True, True,
               "lower segment reductions to one-hot matmuls / sorted prefix "
               "tricks instead of XLA scatters (TPU scatter serializes on "
               "duplicate indices)")
+config.define("rand_seed", 42, True,
+              "seed for rand()/random() (deterministic per trace)")
 config.define("dense_agg_domain_max", 0, True,
               "max bounded group-key domain covered by a dense packed-gid "
               "aggregation capacity (0 = auto by backend)")

@@ -17,6 +17,7 @@ import dataclasses
 import jax
 import numpy as np
 
+from .. import types as T
 from ..column import Chunk, HostTable
 from ..column.column import pad_capacity
 from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit
@@ -215,6 +216,14 @@ class Executor:
     def execute_logical(
         self, plan: LogicalPlan, profile: RuntimeProfile | None = None
     ) -> QueryResult:
+        gc = _extract_group_concat(plan)
+        if gc is not None:
+            return self._execute_group_concat(plan, gc, profile)
+        return self._execute_plain(plan, profile)
+
+    def _execute_plain(
+        self, plan: LogicalPlan, profile: RuntimeProfile | None = None
+    ) -> QueryResult:
         profile = profile or RuntimeProfile("query")
         QUERIES_TOTAL.inc()
         try:
@@ -240,6 +249,150 @@ class Executor:
         except Exception:
             QUERY_ERRORS.inc()
             raise
+
+    # --- group_concat orchestration -------------------------------------------
+    def _execute_group_concat(self, plan, gc, profile):
+        """Two-plan execution for group_concat (see _extract_group_concat):
+        main plan with min() placeholders + a (keys, args) side plan, joined
+        on the host by group-key values."""
+        agg, gcs = gc
+        new_aggs = tuple(
+            (n, AggExpr("min", a.arg) if a.fn == "group_concat" else a)
+            for n, a in agg.aggs
+        )
+        agg_a = LAggregate(agg.child, agg.group_by, new_aggs)
+
+        # rebuild the chain root->agg with hidden key passthroughs on every
+        # projection so the final output always carries the group keys
+        key_names = [n for n, _ in agg.group_by]
+
+        def rebuild(node):
+            """Returns (new_node, key_map, gc_map): key_map tracks each
+            group key's visible column name at this level (hidden
+            passthroughs are appended to every projection); gc_map tracks
+            each group_concat output's visible name through renames."""
+            if node is agg:
+                return agg_a, {k: k for k in key_names}, {n: n for n, _ in gcs}
+            child, key_map, gc_map = rebuild(node.child)
+            if isinstance(node, LProject):
+                items = list(node.exprs)
+                new_gc = {}
+                for n, e in node.exprs:
+                    if isinstance(e, Col):
+                        for g, vis in gc_map.items():
+                            if e.name == vis:
+                                new_gc[g] = n
+                new_key = {}
+                for i, k in enumerate(key_names):
+                    hid = f"__gck_{i}"
+                    items.append((hid, Col(key_map[k])))
+                    new_key[k] = hid
+                return LProject(child, tuple(items)), new_key, new_gc
+            return dataclasses.replace(node, child=child), key_map, gc_map
+
+        plan_a, _key_map, gc_vis = rebuild(plan)
+        res = self._execute_plain(plan_a, profile)
+        ht = res.table
+
+        # side plan: (keys..., arg per gc) straight off the agg input
+        items = tuple(
+            (f"__k{i}", e) for i, (_, e) in enumerate(agg.group_by)
+        ) + tuple(
+            (f"__a{j}", a.arg) for j, (_, a) in enumerate(gcs)
+        )
+        side = self._execute_plain(LProject(agg.child, items))
+        srows = side.table.to_pylist()
+        nk = len(agg.group_by)
+        per_gc = [dict() for _ in gcs]
+        for row in srows:
+            key = tuple(row[:nk])
+            for j in range(len(gcs)):
+                v = row[nk + j]
+                if v is None:
+                    continue
+                per_gc[j].setdefault(key, []).append(v)
+
+        def fmt(v):
+            if isinstance(v, bool):
+                return str(int(v))
+            if isinstance(v, float):
+                return repr(v)
+            return str(v)
+
+        concat = []
+        for j, (_, a) in enumerate(gcs):
+            sep = ","
+            if a.extra and isinstance(a.extra[0], Lit):
+                sep = str(a.extra[0].value)
+            m = {}
+            for key, vals in per_gc[j].items():
+                if a.distinct:
+                    vals = list(dict.fromkeys(vals))
+                m[key] = sep.join(fmt(v) for v in sorted(
+                    vals, key=lambda x: (isinstance(x, str), x)))
+            concat.append(m)
+
+        # patch the result: replace gc columns, drop hidden key columns
+        cols = ht.to_pylist()
+        names = [f.name for f in ht.schema]
+        # positions: hidden keys are the LAST len(key_names) columns IF the
+        # root had a projection; otherwise key columns are the agg keys
+        if any(n.startswith("__gck_") for n in names):
+            key_pos = [names.index(f"__gck_{i}") for i in range(nk)]
+        else:
+            key_pos = list(range(nk))  # agg output: keys first
+        from ..column import HostTable as HT
+
+        out_data = {}
+        out_types = {}
+        keep = [i for i, n in enumerate(names)
+                if not n.startswith("__gck_")]
+        gc_by_final = {}
+        for j, (n, _) in enumerate(gcs):
+            vis = gc_vis.get(n)
+            if vis is None:
+                continue  # concat column dropped by a projection
+            for i, on in enumerate(names):
+                if on == vis or on.split(".")[-1] == vis.split(".")[-1]:
+                    gc_by_final[i] = j
+                    break
+        for i in keep:
+            name = names[i]
+            if i in gc_by_final:
+                m = concat[gc_by_final[i]]
+                vals = [
+                    m.get(tuple(r[p] for p in key_pos)) for r in cols
+                ]
+                out_data[name] = vals
+                out_types[name] = None  # VARCHAR inferred
+            else:
+                out_data[name] = [r[i] for r in cols]
+                out_types[name] = ht.schema.fields[i]
+        new_fields, arrays, valids = [], {}, {}
+        for name in out_data:
+            f = out_types[name]
+            if f is None:
+                vals = out_data[name]
+                from ..column.dict_encoding import StringDict
+
+                nulls = np.array([v is None for v in vals])
+                d, codes = StringDict.from_strings(
+                    ["" if v is None else str(v) for v in vals])
+                from ..column.column import Field as _Field
+
+                new_fields.append(_Field(name, T.VARCHAR, True, d))
+                arrays[name] = codes
+                if nulls.any():
+                    valids[name] = ~nulls
+            else:
+                new_fields.append(f)
+                arrays[name] = ht.arrays[f.name]
+                if f.name in ht.valids:
+                    valids[name] = ht.valids[f.name]
+        from ..column.column import Schema as _Schema
+
+        table = HT(_Schema(tuple(new_fields)), arrays, valids)
+        return QueryResult(table, plan, res.profile)
 
     # --- subqueries ----------------------------------------------------------
     def _resolve_scalar_subqueries(self, plan: LogicalPlan) -> LogicalPlan:
@@ -438,6 +591,88 @@ class Executor:
         # after a successful run, and the next execution should adopt them
         bucket["last"] = caps.values
         return out, checks
+
+
+def _extract_group_concat(plan: LogicalPlan):
+    """Find a root-reachable LAggregate carrying group_concat aggregates.
+
+    group_concat builds data-dependent strings, which the trace-time dict
+    design cannot express on device (output dictionaries would depend on
+    values). The executor therefore runs it as a TWO-PLAN orchestration
+    (same pattern as uncorrelated scalar subqueries): the main plan computes
+    every other aggregate with a placeholder in the group_concat slot, a
+    side plan fetches (group keys, arg) rows, and the host joins the
+    per-group concatenations into the final result. Reference behavior:
+    be/src/exprs/agg/group_concat.h (engine-side state strings).
+
+    Returns (agg_node, [(name, AggExpr)]) or None. Only aggregates reachable
+    through Project/Sort/Limit/Filter chains are eligible; group_concat
+    anywhere else (subquery under a join, HAVING on the concat itself)
+    raises ExecError."""
+    from ..sql.logical import LWindow, walk_plan
+
+    hits = []
+    for node in walk_plan(plan):
+        if isinstance(node, LAggregate):
+            gcs = [(n, a) for n, a in node.aggs if a.fn == "group_concat"]
+            if gcs:
+                hits.append((node, gcs))
+    if not hits:
+        return None
+    if len(hits) > 1:
+        raise ExecError("multiple group_concat aggregations in one query")
+    agg, gcs = hits[0]
+    # eligibility: the agg must sit under a pure chain from the root, and no
+    # expression above it may CONSUME the concat column beyond Col
+    # passthrough. Renames ARE passthroughs, so track the concat column's
+    # visible names level by level (bottom-up) — a reference through a
+    # subquery alias (x.gc) or rename (gc AS g) must hit the same guard.
+    chain = []
+    node = plan
+    while node is not agg:
+        if not isinstance(node, (LSort, LFilter, LProject, LLimit, LWindow)):
+            raise ExecError(
+                "group_concat is only supported in the query's top "
+                "aggregation block")
+        chain.append(node)
+        node = node.child
+    visible = {n for n, _ in gcs}
+    for node in reversed(chain):  # agg side first
+        if isinstance(node, (LSort, LFilter, LWindow)):
+            if isinstance(node, LSort):
+                exprs = [k for k, _, _ in node.keys]
+            elif isinstance(node, LFilter):
+                exprs = [node.predicate]
+            else:
+                exprs = list(node.partition_by) + [
+                    k for k, _, _ in node.order_by
+                ] + [a for _, _, a, *_ in node.funcs if a is not None]
+            for e in exprs:
+                if _expr_cols_safe(e) & visible:
+                    raise ExecError(
+                        "group_concat result cannot be referenced by "
+                        "ORDER BY/HAVING/window expressions "
+                        "(host-finalized aggregate)")
+        elif isinstance(node, LProject):
+            nxt = set()
+            for n, e in node.exprs:
+                if isinstance(e, Col) and e.name in visible:
+                    nxt.add(n)
+                elif _expr_cols_safe(e) & visible:
+                    raise ExecError(
+                        "group_concat result cannot be used inside "
+                        "expressions (host-finalized aggregate)")
+            visible = nxt
+    return agg, gcs
+
+
+def _expr_cols_safe(e):
+    from ..sql.optimizer import expr_cols
+
+    try:
+        return expr_cols(e)
+    except Exception:  # noqa: BLE001
+        return set()
 
 
 def _prettify_names(ht: HostTable) -> HostTable:

@@ -83,6 +83,12 @@ class Scope:
             f"unknown column {(table + '.') if table else ''}{name}"
         )
 
+    def resolve_or_none(self, table: Optional[str], name: str):
+        try:
+            return self.resolve(table, name)
+        except AnalyzerError:
+            return None
+
     def all_names(self):
         return [f"{a}.{c}" for a, cols in self.entries for c in cols]
 
@@ -239,8 +245,21 @@ class Analyzer:
                 if not (0 <= idx < len(lowered_items)):
                     raise AnalyzerError(f"GROUP BY ordinal {g.value} out of range")
                 group_exprs.append(lowered_items[idx][1])
-            else:
-                group_exprs.append(self._lower(g, scope, ctes, allow_agg=False))
+                continue
+            if isinstance(g, ast.RawCol) and g.table is None:
+                # MySQL extension: GROUP BY may reference a SELECT alias
+                # when it doesn't shadow an input column
+                hit = next((e for n, e in lowered_items
+                            if n.lower() == g.name.lower()), None)
+                if hit is not None and scope.resolve_or_none(
+                        None, g.name) is None:
+                    if any(isinstance(x, AggExpr) for x in _walk_expr(hit)):
+                        raise AnalyzerError(
+                            f"GROUP BY alias {g.name!r} references an "
+                            "aggregate")
+                    group_exprs.append(hit)
+                    continue
+            group_exprs.append(self._lower(g, scope, ctes, allow_agg=False))
 
         having = (
             self._lower(sel.having, scope, ctes, allow_agg=True)

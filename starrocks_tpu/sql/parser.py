@@ -22,7 +22,7 @@ class ParseError(ValueError):
 AGG_FUNCS = {"sum", "count", "avg", "min", "max",
              "stddev_pop", "stddev_samp", "var_pop", "var_samp",
              "covar_pop", "covar_samp", "corr",
-             "percentile_cont", "percentile_disc"}
+             "percentile_cont", "percentile_disc", "group_concat"}
 # aliases resolving to a canonical aggregate (MySQL/reference naming:
 # std/stddev/variance are population forms; any_value picks an arbitrary
 # row — min is a valid choice; ndv/approx_count_distinct answer exactly here)
@@ -698,9 +698,11 @@ class Parser:
 
     # functions taking a leading bare unit keyword (MySQL style):
     # timestampdiff(DAY, a, b), date_trunc(month, x), extract-like forms
-    _UNIT_ARG_FNS = {"timestampdiff", "timestampadd", "date_trunc"}
+    _UNIT_ARG_FNS = {"timestampdiff", "timestampadd", "date_trunc",
+                     "date_diff", "date_floor", "time_slice",
+                     "date_slice"}
     _UNITS = {"year", "quarter", "month", "week", "day", "hour", "minute",
-              "second"}
+              "second", "millisecond"}
 
     def parse_func_call(self, name: str) -> Expr:
         name = name.lower()
@@ -731,6 +733,22 @@ class Parser:
             # exact distinct count (a zero-error "approximation"; the
             # reference uses HLL, be/src/types/hll.h)
             return AggExpr("count", args[0], True)
+        if name == "percentile_approx":
+            # exact holistic percentile serves the approximate contract
+            # (reference: be/src/exprs/agg/percentile_approx.h); optional
+            # third compression argument is accepted and ignored
+            if len(args) < 2:
+                raise ParseError("percentile_approx takes (expr, fraction)")
+            return AggExpr("percentile_cont", args[0], distinct,
+                           extra=(args[1],))
+        if name == "group_concat":
+            # host-finalized aggregate (executor runs a side plan; see
+            # runtime/executor.py _execute_group_concat); optional second
+            # argument is the separator
+            if not args:
+                raise ParseError("group_concat takes at least one argument")
+            return AggExpr("group_concat", args[0], distinct,
+                           extra=tuple(args[1:2]))
         if name in AGG_FUNCS:
             if name == "count" and args and isinstance(args[0], ast.Star):
                 return AggExpr("count", None, distinct)

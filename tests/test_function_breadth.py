@@ -244,9 +244,28 @@ def test_registry_coverage():
         "regexp_extract", "regexp_replace", "md5", "sha2", "crc32",
         "initcap", "null_or_empty", "space",
     ]
+    must_have += [
+        # wave 3
+        "asinh", "acosh", "atanh", "bit_count", "rand", "pow", "fmod",
+        "isnull", "isnotnull", "nvl2", "zeroifnull", "nullifzero",
+        "curdate", "now", "current_timestamp", "utc_timestamp", "weekday",
+        "dayofweek_iso", "yearweek", "microsecond", "time_to_sec",
+        "quarters_add", "milliseconds_add", "microseconds_add",
+        "days_diff", "hours_diff", "minutes_diff", "seconds_diff",
+        "months_diff", "years_diff", "quarters_diff", "weeks_diff",
+        "date_diff", "next_day", "previous_day", "date_floor", "time_slice",
+        "add_months", "date_format", "mid", "position", "bit_length",
+        "octet_length", "to_base64", "from_base64", "unhex", "sha1",
+        "murmur_hash3_32", "fnv_hash", "translate", "url_encode",
+        "url_decode", "parse_url", "substring_index", "field", "elt",
+        "find_in_set", "soundex", "append_trailing_char_if_absent", "quote",
+        "strcmp", "ngram_search", "levenshtein", "get_json_string",
+        "get_json_int", "get_json_double", "json_valid", "version",
+        "connection_id", "database", "user", "current_user", "typeof",
+    ]
     missing = [f for f in must_have if f not in _FUNCTIONS]
     assert not missing, f"registry missing: {missing}"
-    assert len(_FUNCTIONS) >= 150
+    assert len(_FUNCTIONS) >= 250
 
 
 def test_distinct_mixed_with_moment_aggs():
@@ -263,3 +282,117 @@ def test_distinct_mixed_with_moment_aggs():
         assert cd == sub.v.nunique()
         assert sd == pytest.approx(sub.v.std(ddof=1))
         assert vp == pytest.approx(sub.v.var(ddof=0))
+
+
+def test_wave3_math_and_null(sess):
+    assert rows1(sess, "select bit_count(i) from t order by i") == [
+        1, 1, 2, 1, 2]
+    r = rows1(sess, "select asinh(x) from t order by i")
+    exp = [math.asinh(v) for v in [0.5, -1.25, 9.0, 100.0, 2.0]]
+    assert all(abs(a - b) < 1e-12 for a, b in zip(r, exp))
+    assert rows1(sess, "select nvl2(y, 1, 0) from t order by i") == [
+        1, 1, 1, 1, 0]
+    assert rows1(sess, "select zeroifnull(y) from t order by i") == [
+        2.0, 4.0, -3.0, 7.5, 0.0]
+    assert rows1(sess, "select nullifzero(i - 1) from t order by i") == [
+        None, 1, 2, 3, 4]
+
+
+def test_wave3_dates(sess):
+    # pandas oracle for the diff family
+    df = pd.DataFrame({
+        "d": pd.to_datetime(["2023-01-15", "2024-02-29", "2021-12-31",
+                             "2020-06-01", "2023-11-05"]),
+    })
+    ref = pd.Timestamp("2024-03-15")
+    exp_days = [(ref - d).days for d in df.d]
+    assert rows1(
+        sess,
+        "select days_diff(to_date('2024-03-15'), d) from t order by i",
+    ) == exp_days
+    assert rows1(sess, "select weekday(d) from t order by i") == [
+        int(d.weekday()) for d in df.d]
+    assert rows1(sess, "select date_format(d, '%Y-%m') from t order by i"
+                 ) == [d.strftime("%Y-%m") for d in df.d]
+    assert rows1(sess, "select date_diff(month, to_date('2024-03-15'), d) "
+                 "from t order by i") == [14, 0, 26, 45, 4]
+
+
+def test_wave3_strings(sess):
+    assert rows1(sess, "select to_base64(s) from t where i = 2") == ["QWJj"]
+    assert rows1(sess, "select from_base64(to_base64(s)) from t order by i"
+                 ) == ["hello world", "Abc", "", "x,y,z", "Hello"]
+    assert rows1(sess, "select substring_index(s, ',', 2) from t where i = 4"
+                 ) == ["x,y"]
+    assert rows1(sess, "select soundex('Robert')") == ["R163"]
+    assert rows1(sess, "select levenshtein(s, 'hello') from t order by i"
+                 ) == [6, 5, 5, 5, 1]
+    assert rows1(sess, "select field(s, 'Abc', 'Hello') from t order by i"
+                 ) == [0, 1, 0, 0, 2]
+    assert rows1(sess, "select strcmp(s, 'Hello') from t order by i") == [
+        1, -1, -1, 1, 0]
+
+
+def test_wave3_json(sess):
+    s2 = Session()
+    s2.sql("create table j (js varchar)")
+    s2.sql("""insert into j values ('{"a": 1, "b": {"c": [10, 20]}}'),
+           ('not json'), ('{"a": 2.5}')""")
+    assert [r[0] for r in s2.sql(
+        "select get_json_int(js, '$.a') from j").rows()] == [1, 0, 2]
+    assert [r[0] for r in s2.sql(
+        "select get_json_string(js, '$.b.c[1]') from j").rows()] == [
+        "20", "", ""]
+    assert [r[0] for r in s2.sql(
+        "select json_valid(js) from j").rows()] == [True, False, True]
+
+
+def test_group_concat_and_friends(sess):
+    s2 = Session()
+    s2.sql("create table g (k varchar, v varchar, n bigint)")
+    s2.sql("insert into g values ('a','x',1),('a','y',2),('b','z',3),"
+           "('b','z',4),('a',null,5)")
+    r = s2.sql("select k, group_concat(v) gc, count(*) c from g "
+               "group by k order by k").rows()
+    assert r == [("a", "x,y", 3), ("b", "z,z", 2)]
+    r = s2.sql("select k, group_concat(distinct v, '-') from g "
+               "group by k order by k").rows()
+    assert r == [("a", "x-y"), ("b", "z")]
+    r = s2.sql("select any_value(n), approx_count_distinct(v) from g").rows()
+    assert r == [(1, 3)]
+    assert s2.sql("select ndv(k) from g").rows() == [(2,)]
+    r = s2.sql("select percentile_approx(n, 0.5) from g").rows()
+    assert r == [(3.0,)]
+
+
+def test_group_concat_guard_through_renames():
+    """References to the concat column through renames/subquery aliases must
+    raise (not silently read the placeholder)."""
+    s2 = Session()
+    s2.sql("create table gg (k varchar, v varchar)")
+    s2.sql("insert into gg values ('a','x'),('a','y')")
+    with pytest.raises(Exception, match="group_concat"):
+        s2.sql("select gc from (select k, group_concat(v) gc from gg "
+               "group by k) x where gc = 'x,y'")
+    # plain rename passthrough is fine
+    r = s2.sql("select gc as g from (select k, group_concat(v) gc from gg "
+               "group by k) x").rows()
+    assert r == [("x,y",)]
+
+
+def test_wave3_fix_regressions(sess):
+    # time_slice/date_slice unit-first arg order
+    r = rows1(sess, "select time_slice(month, d) from t where i = 1")
+    assert str(r[0]) == "2023-01-01"
+    # yearweek at an ISO year boundary: 2021-01-01 is ISO week 53 of 2020
+    assert rows1(sess, "select yearweek(to_date('2021-01-01'))") == [202053]
+    # two rand() occurrences must not correlate
+    r = sess.sql("select rand() r1, rand() r2 from t").rows()
+    assert any(abs(a - b) > 1e-12 for a, b in r)
+    # GROUP BY alias is case-insensitive
+    r = sess.sql("select i + 0 as Total from t group by Total "
+                 "order by Total").rows()
+    assert [x[0] for x in r] == [1, 2, 3, 4, 5]
+    # date_format with time tokens on DATETIME refuses loudly
+    with pytest.raises(Exception, match="time tokens"):
+        sess.sql("select date_format(dt, '%H:%i') from t")
