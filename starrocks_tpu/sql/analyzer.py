@@ -377,7 +377,7 @@ class Analyzer:
                 sub_plan = self._analyze_setop(rel.select, outer, ctes)
             else:
                 sub_plan = self._analyze_select(rel.select, outer, ctes)
-            return self._aliased_subplan(sub_plan, rel.alias)
+            return self._aliased_subplan(sub_plan, rel.alias, outer)
         if isinstance(rel, ast.JoinRef):
             lplan, lscope = self._analyze_relation(rel.left, outer, ctes)
             rplan, rscope = self._analyze_relation(rel.right, outer, ctes)
@@ -400,10 +400,14 @@ class Analyzer:
             sub_plan = self._analyze_setop(def_ast, outer, ctes)
         else:
             sub_plan = self._analyze_select(def_ast, outer, ctes)
-        return self._aliased_subplan(sub_plan, alias)
+        return self._aliased_subplan(sub_plan, alias, outer)
 
-    def _aliased_subplan(self, sub_plan: LogicalPlan, alias: str):
-        """Wrap a subquery plan so its outputs become alias.col."""
+    def _aliased_subplan(self, sub_plan: LogicalPlan, alias: str, outer=None):
+        """Wrap a subquery plan so its outputs become alias.col. `outer`
+        becomes the scope's parent so correlated references THROUGH a
+        derived table / CTE alias resolve (e.g. TPC-DS q1's ctr1 inside the
+        per-store average subquery); views pass None — their bodies must not
+        see the caller's scope."""
         out = sub_plan.output_names()
         base = tuple(n.split(".", 1)[-1] for n in out)
         if len(set(base)) != len(base):
@@ -411,7 +415,7 @@ class Analyzer:
         proj = LProject(
             sub_plan, tuple((f"{alias}.{b}", Col(q)) for b, q in zip(base, out))
         )
-        return proj, Scope([(alias, base)], None)
+        return proj, Scope([(alias, base)], outer)
 
     def _star_names(self, scope: Scope, table: Optional[str]):
         names = []

@@ -352,6 +352,9 @@ def gen_tpcds(sf: float = 0.01, seed: int = 11) -> dict:
             hdemo_sk=rng.integers(1, nhd + 1, n).astype(np.int64),
             addr_sk=rng.integers(1, nca + 1, n).astype(np.int64),
             promo_sk=rng.integers(1, nprom + 1, n).astype(np.int64),
+            # ~5% of sales carry no promotion: NULL FK (the reference data
+            # has nullable fact FKs; q76-class queries count them)
+            promo_valid=rng.random(n) >= 0.05,
             qty=qty, wholesale=wholesale, list_p=list_p, sales_p=sales_p,
             ext_list=ext_list, ext_sales=ext_sales, ext_wh=ext_wh,
             ext_disc=ext_disc, coupon=coupon, net_paid=net_paid, tax=tax,
@@ -403,6 +406,7 @@ def gen_tpcds(sf: float = 0.01, seed: int = 11) -> dict:
                "ss_ext_tax": DEC, "ss_coupon_amt": DEC, "ss_net_paid": DEC,
                "ss_net_profit": DEC},
     )
+    out["store_sales"].valids["ss_promo_sk"] = f["promo_valid"]
     nsr = max(nss // 10, 200)
     ridx = rng.choice(nss, nsr, replace=False)
     ret_qty = np.minimum(f["qty"][ridx],
@@ -474,6 +478,7 @@ def gen_tpcds(sf: float = 0.01, seed: int = 11) -> dict:
                "cs_ext_list_price": DEC, "cs_coupon_amt": DEC,
                "cs_net_profit": DEC},
     )
+    out["catalog_sales"].valids["cs_promo_sk"] = f["promo_valid"]
     ncr = max(ncs // 10, 120)
     ridx = rng.choice(ncs, ncr, replace=False)
     ret_qty = np.minimum(f["qty"][ridx],
@@ -542,6 +547,7 @@ def gen_tpcds(sf: float = 0.01, seed: int = 11) -> dict:
                "ws_ext_wholesale_cost": DEC, "ws_ext_list_price": DEC,
                "ws_net_paid": DEC, "ws_net_profit": DEC},
     )
+    out["web_sales"].valids["ws_promo_sk"] = f["promo_valid"]
     nwr = max(nws // 10, 80)
     ridx = rng.choice(nws, nwr, replace=False)
     ret_qty = np.minimum(f["qty"][ridx],

@@ -896,3 +896,932 @@ def test_q99(env):
         return x.groupby(["wname", "sm_type", "cc_name"], as_index=False)[
             ["d30", "d60", "d90", "d120"]].sum()
     run(env, "q99", oracle)
+
+
+# --- round-3 expansion batch 1 ----------------------------------------------
+
+
+def test_q1(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        ctr = (F["store_returns"]
+               .merge(dd[dd.d_year == 2000], left_on="sr_returned_date_sk",
+                      right_on="d_date_sk")
+               .groupby(["sr_customer_sk", "sr_store_sk"], as_index=False)
+               ["sr_return_amt"].sum()
+               .rename(columns={"sr_return_amt": "ctr_total_return"}))
+        avg_by_store = ctr.groupby("sr_store_sk")["ctr_total_return"].mean()
+        ctr["thresh"] = ctr.sr_store_sk.map(avg_by_store) * 1.2
+        tn = F["store"][F["store"].s_state == "TN"].s_store_sk
+        x = ctr[(ctr.ctr_total_return > ctr.thresh)
+                & ctr.sr_store_sk.isin(tn)]
+        out = x.merge(F["customer"], left_on="sr_customer_sk",
+                      right_on="c_customer_sk")[["c_customer_id"]]
+        return out.sort_values("c_customer_id").head(100)
+    run(env, "q1", oracle, limit=None)
+
+
+def test_q6(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        mseq = dd[(dd.d_year == 2001) & (dd.d_moy == 1)].d_month_seq.iloc[0]
+        it = F["item"].copy()
+        cat_avg = it.groupby("i_category")["i_current_price"].mean()
+        it = it[it.i_current_price > 1.2 * it.i_category.map(cat_avg)]
+        x = (F["customer_address"]
+             .merge(F["customer"], left_on="ca_address_sk",
+                    right_on="c_current_addr_sk")
+             .merge(F["store_sales"], left_on="c_customer_sk",
+                    right_on="ss_customer_sk")
+             .merge(dd[dd.d_month_seq == mseq], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(it, left_on="ss_item_sk", right_on="i_item_sk"))
+        g = x.groupby("ca_state", dropna=False).size().reset_index(name="cnt")
+        g = g[g.cnt >= 2].rename(columns={"ca_state": "state"})
+        return g[["state", "cnt"]]
+    run(env, "q6", oracle)
+
+
+def test_q9(env):
+    def oracle(F):
+        ss = F["store_sales"]
+        out = {}
+        for i, (lo, hi) in enumerate([(1, 20), (21, 40), (41, 60)], 1):
+            b = ss[(ss.ss_quantity >= lo) & (ss.ss_quantity <= hi)]
+            out[f"bucket{i}"] = (b.ss_ext_discount_amt.mean()
+                                 if len(b) > 5000 else b.ss_net_paid.mean())
+        return pd.DataFrame([out])
+    run(env, "q9", oracle)
+
+
+def test_q10(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        dsel = dd[(dd.d_year == 2002) & dd.d_moy.between(1, 4)].d_date_sk
+        ss_c = set(F["store_sales"][
+            F["store_sales"].ss_sold_date_sk.isin(dsel)].ss_customer_sk)
+        ws_c = set(F["web_sales"][
+            F["web_sales"].ws_sold_date_sk.isin(dsel)].ws_bill_customer_sk)
+        cs_c = set(F["catalog_sales"][
+            F["catalog_sales"].cs_sold_date_sk.isin(dsel)].cs_bill_customer_sk)
+        c = F["customer"]
+        c = c[c.c_customer_sk.isin(ss_c)
+              & (c.c_customer_sk.isin(ws_c) | c.c_customer_sk.isin(cs_c))]
+        x = (c.merge(F["customer_address"], left_on="c_current_addr_sk",
+                     right_on="ca_address_sk")
+             .merge(F["customer_demographics"], left_on="c_current_cdemo_sk",
+                    right_on="cd_demo_sk"))
+        x = x[x.ca_county.isin(["Bronx County", "Barrow County",
+                                "Daviess County"])]
+        g = x.groupby(["cd_gender", "cd_marital_status",
+                       "cd_education_status", "cd_purchase_estimate"],
+                      as_index=False).size()
+        g["cnt1"] = g["size"]
+        g["cnt2"] = g["size"]
+        return g[["cd_gender", "cd_marital_status", "cd_education_status",
+                  "cnt1", "cd_purchase_estimate", "cnt2"]]
+    run(env, "q10", oracle)
+
+
+def test_q13(env):
+    def oracle(F):
+        x = (F["store_sales"]
+             .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(F["date_dim"][F["date_dim"].d_year == 2001],
+                    left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(F["household_demographics"], left_on="ss_hdemo_sk",
+                    right_on="hd_demo_sk")
+             .merge(F["customer_demographics"], left_on="ss_cdemo_sk",
+                    right_on="cd_demo_sk")
+             .merge(F["customer_address"], left_on="ss_addr_sk",
+                    right_on="ca_address_sk"))
+        x = x[x.ca_country == "United States"]
+        m1 = (((x.cd_marital_status == "M")
+               & (x.cd_education_status == "Advanced Degree")
+               & x.ss_sales_price.between(50, 100) & (x.hd_dep_count == 3))
+              | ((x.cd_marital_status == "S")
+                 & (x.cd_education_status == "College")
+                 & x.ss_sales_price.between(10, 60) & (x.hd_dep_count == 1))
+              | ((x.cd_marital_status == "W")
+                 & (x.cd_education_status == "2 yr Degree")
+                 & x.ss_sales_price.between(30, 80) & (x.hd_dep_count == 1)))
+        m2 = ((x.ca_state.isin(["TX", "OH", "TN"])
+               & x.ss_net_profit.between(0, 2000))
+              | (x.ca_state.isin(["AL", "KS", "MI"])
+                 & x.ss_net_profit.between(50, 3000))
+              | (x.ca_state.isin(["CA", "GA", "NY"])
+                 & x.ss_net_profit.between(0, 25000)))
+        x = x[m1 & m2]
+        assert len(x) > 0
+        return pd.DataFrame([{
+            "a1": x.ss_quantity.mean(), "a2": x.ss_ext_sales_price.mean(),
+            "a3": x.ss_ext_wholesale_cost.mean(),
+            "s1": x.ss_ext_wholesale_cost.sum()}])
+    run(env, "q13", oracle)
+
+
+def test_q28(env):
+    def oracle(F):
+        ss = F["store_sales"]
+        out = {}
+        bands = [(0, 5, 10, 50, 0, 200, 10, 30),
+                 (6, 10, 20, 60, 0, 300, 20, 40),
+                 (11, 15, 30, 70, 0, 400, 30, 50)]
+        for i, (qlo, qhi, llo, lhi, clo, chi, wlo, whi) in enumerate(bands, 1):
+            b = ss[ss.ss_quantity.between(qlo, qhi)
+                   & (ss.ss_list_price.between(llo, lhi)
+                      | ss.ss_coupon_amt.between(clo, chi)
+                      | ss.ss_wholesale_cost.between(wlo, whi))]
+            assert len(b) > 0
+            out[f"b{i}_lp"] = b.ss_list_price.mean()
+            out[f"b{i}_cnt"] = len(b)
+            out[f"b{i}_cntd"] = b.ss_list_price.nunique()
+        return pd.DataFrame([out])
+    run(env, "q28", oracle)
+
+
+def test_q29(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        d1 = dd[dd.d_year == 1999]
+        d2 = dd[dd.d_year == 1999]
+        d3 = dd[dd.d_year.isin([1999, 2000, 2001])]
+        x = (F["store_sales"]
+             .merge(d1[["d_date_sk"]], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(F["item"], left_on="ss_item_sk", right_on="i_item_sk")
+             .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(F["store_returns"],
+                    left_on=["ss_customer_sk", "ss_item_sk",
+                             "ss_ticket_number"],
+                    right_on=["sr_customer_sk", "sr_item_sk",
+                              "sr_ticket_number"])
+             .merge(d2[["d_date_sk"]], left_on="sr_returned_date_sk",
+                    right_on="d_date_sk")
+             .merge(F["catalog_sales"],
+                    left_on=["sr_customer_sk", "sr_item_sk"],
+                    right_on=["cs_bill_customer_sk", "cs_item_sk"])
+             .merge(d3[["d_date_sk"]], left_on="cs_sold_date_sk",
+                    right_on="d_date_sk"))
+        assert len(x) > 0
+        return x.groupby(["i_item_id", "i_item_desc", "s_store_id",
+                          "s_store_name"], as_index=False).agg(
+            store_sales_quantity=("ss_quantity", "sum"),
+            store_returns_quantity=("sr_return_quantity", "sum"),
+            catalog_sales_quantity=("cs_quantity", "sum"))
+    run(env, "q29", oracle)
+
+
+def test_q34(env):
+    def oracle(F):
+        hd = F["household_demographics"]
+        x = (F["store_sales"]
+             .merge(F["store"][F["store"].s_county.isin(
+                 ["Richland County", "Daviess County", "Maverick County"])],
+                 left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(hd[hd.hd_buy_potential.isin([">10000", "Unknown"])
+                       & (hd.hd_vehicle_count > 0)],
+                    left_on="ss_hdemo_sk", right_on="hd_demo_sk"))
+        g = (x.groupby("ss_customer_sk", as_index=False).size()
+             .rename(columns={"size": "cnt"}))
+        g = g[g.cnt.between(5, 10)]
+        out = g.merge(F["customer"], left_on="ss_customer_sk",
+                      right_on="c_customer_sk")
+        assert len(out) > 0
+        return out[["c_last_name", "c_first_name", "c_customer_id", "cnt"]]
+    run(env, "q34", oracle, limit=1000)
+
+
+def test_q41(env):
+    def oracle(F):
+        it = F["item"]
+        m = ((it.i_category == "Women") & it.i_color.isin(["plum", "pink"])) | \
+            ((it.i_category == "Men") & it.i_color.isin(["black", "blue"])) | \
+            ((it.i_category == "Shoes") & it.i_color.isin(["green", "ivory"]))
+        manufs = set(it[m].i_manufact)
+        x = it[it.i_manufact_id.between(5, 15)
+               & it.i_manufact.isin(manufs)]
+        assert len(x) > 0
+        return (x[["i_product_name"]].drop_duplicates()
+                .sort_values("i_product_name").head(100))
+    run(env, "q41", oracle, limit=None)
+
+
+def test_q48(env):
+    def oracle(F):
+        x = (F["store_sales"]
+             .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(F["date_dim"][F["date_dim"].d_year == 2000],
+                    left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(F["customer_demographics"], left_on="ss_cdemo_sk",
+                    right_on="cd_demo_sk")
+             .merge(F["customer_address"], left_on="ss_addr_sk",
+                    right_on="ca_address_sk"))
+        x = x[x.ca_country == "United States"]
+        m1 = (((x.cd_marital_status == "M")
+               & (x.cd_education_status == "4 yr Degree")
+               & x.ss_sales_price.between(100, 150))
+              | ((x.cd_marital_status == "D")
+                 & (x.cd_education_status == "2 yr Degree")
+                 & x.ss_sales_price.between(50, 100))
+              | ((x.cd_marital_status == "S")
+                 & (x.cd_education_status == "College")
+                 & x.ss_sales_price.between(150, 200)))
+        m2 = ((x.ca_state.isin(["CO", "OH", "TX"])
+               & x.ss_net_profit.between(0, 2000))
+              | (x.ca_state.isin(["OR", "MN", "KS"])
+                 & x.ss_net_profit.between(150, 3000))
+              | (x.ca_state.isin(["TX", "MO", "MI"])
+                 & x.ss_net_profit.between(50, 25000)))
+        x = x[m1 & m2]
+        assert len(x) > 0
+        return pd.DataFrame([{"total": x.ss_quantity.sum()}])
+    run(env, "q48", oracle)
+
+
+# --- round-3 expansion batch 2 ----------------------------------------------
+
+
+def test_q17(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        x = (F["store_sales"]
+             .merge(dd[(dd.d_qoy == 1) & (dd.d_year == 1999)][["d_date_sk"]],
+                    left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(F["item"], left_on="ss_item_sk", right_on="i_item_sk")
+             .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(F["store_returns"],
+                    left_on=["ss_customer_sk", "ss_item_sk",
+                             "ss_ticket_number"],
+                    right_on=["sr_customer_sk", "sr_item_sk",
+                              "sr_ticket_number"])
+             .merge(dd[dd.d_year == 1999][["d_date_sk"]],
+                    left_on="sr_returned_date_sk", right_on="d_date_sk")
+             .merge(F["catalog_sales"],
+                    left_on=["sr_customer_sk", "sr_item_sk"],
+                    right_on=["cs_bill_customer_sk", "cs_item_sk"]))
+        assert len(x) > 0
+        return x.groupby(["i_item_id", "i_item_desc", "s_state"],
+                         as_index=False).agg(
+            store_sales_quantitycount=("ss_quantity", "count"),
+            store_sales_quantityave=("ss_quantity", "mean"),
+            store_sales_quantitystdev=("ss_quantity",
+                                       lambda v: v.std(ddof=1)),
+            store_returns_quantitycount=("sr_return_quantity", "count"),
+            store_returns_quantityave=("sr_return_quantity", "mean"),
+            catalog_sales_quantitycount=("cs_quantity", "count"),
+            catalog_sales_quantityave=("cs_quantity", "mean"))
+    run(env, "q17", oracle)
+
+
+def test_q18(env):
+    def oracle(F):
+        cd = F["customer_demographics"]
+        cd = cd[(cd.cd_gender == "F") & (cd.cd_education_status == "Unknown")]
+        c = F["customer"][F["customer"].c_birth_month.isin(
+            [1, 6, 8, 9, 12, 2])]
+        x = (F["catalog_sales"]
+             .merge(F["date_dim"][F["date_dim"].d_year == 1998],
+                    left_on="cs_sold_date_sk", right_on="d_date_sk")
+             .merge(F["item"], left_on="cs_item_sk", right_on="i_item_sk")
+             .merge(cd, left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+             .merge(c, left_on="cs_bill_customer_sk",
+                    right_on="c_customer_sk")
+             .merge(F["customer_address"], left_on="c_current_addr_sk",
+                    right_on="ca_address_sk"))
+        assert len(x) > 0
+
+        def agg(sub):
+            return {"agg1": sub.cs_quantity.mean(),
+                    "agg2": sub.cs_list_price.mean(),
+                    "agg3": sub.cs_coupon_amt.mean(),
+                    "agg4": sub.cs_sales_price.mean()}
+
+        return rollup_levels(x, ["i_item_id", "ca_state"], agg)[
+            ["i_item_id", "ca_state", "agg1", "agg2", "agg3", "agg4"]]
+    run(env, "q18", oracle, limit=1000)
+
+
+def test_q30(env):
+    def oracle(F):
+        ctr = (F["web_returns"]
+               .merge(F["date_dim"][F["date_dim"].d_year == 2000],
+                      left_on="wr_returned_date_sk", right_on="d_date_sk")
+               .merge(F["customer_address"], left_on="wr_refunded_addr_sk",
+                      right_on="ca_address_sk")
+               .groupby(["wr_returning_cdemo_sk", "ca_state"],
+                        as_index=False)["wr_return_amt"].sum()
+               .rename(columns={"wr_return_amt": "ctr_total_return"}))
+        avg_by_state = ctr.groupby("ca_state")["ctr_total_return"].mean()
+        x = ctr[ctr.ctr_total_return
+                > 1.2 * ctr.ca_state.map(avg_by_state)]
+        assert len(x) > 0
+        return x.rename(columns={"wr_returning_cdemo_sk": "ctr_cdemo_sk",
+                                 "ca_state": "ctr_state"})
+    run(env, "q30", oracle)
+
+
+def test_q31(env):
+    def oracle(F):
+        dd = F["date_dim"]
+
+        def chan(fact, date_col, addr_col, val_col):
+            x = (F[fact]
+                 .merge(dd, left_on=date_col, right_on="d_date_sk")
+                 .merge(F["customer_address"], left_on=addr_col,
+                        right_on="ca_address_sk"))
+            return x.groupby(["ca_county", "d_qoy", "d_year"],
+                             as_index=False)[val_col].sum()
+
+        ss = chan("store_sales", "ss_sold_date_sk", "ss_addr_sk",
+                  "ss_ext_sales_price")
+        ws = chan("web_sales", "ws_sold_date_sk", "ws_bill_addr_sk",
+                  "ws_ext_sales_price")
+
+        def q(df, qoy, col):
+            d = df[(df.d_qoy == qoy) & (df.d_year == 2000)]
+            return d.set_index("ca_county")[col]
+
+        s1, s2 = q(ss, 1, "ss_ext_sales_price"), q(ss, 2, "ss_ext_sales_price")
+        w1, w2 = q(ws, 1, "ws_ext_sales_price"), q(ws, 2, "ws_ext_sales_price")
+        counties = (set(s1.index) & set(s2.index) & set(w1.index)
+                    & set(w2.index))
+        rows = []
+        for c in counties:
+            wr = w2[c] / w1[c]
+            sr = s2[c] / s1[c]
+            if wr > sr:
+                rows.append({"ca_county": c, "d_year": 2000,
+                             "web_q1_q2_increase": wr,
+                             "store_q1_q2_increase": sr})
+        assert rows
+        return pd.DataFrame(rows)
+    run(env, "q31", oracle)
+
+
+def test_q33(env):
+    def oracle(F):
+        dd, ca, it = F["date_dim"], F["customer_address"], F["item"]
+        mids = set(it[it.i_category == "Electronics"].i_manufact_id)
+
+        def chan(fact, date_col, item_col, addr_col, val_col):
+            x = (F[fact]
+                 .merge(dd[(dd.d_year == 1998) & (dd.d_moy == 5)],
+                        left_on=date_col, right_on="d_date_sk")
+                 .merge(it[it.i_manufact_id.isin(mids)], left_on=item_col,
+                        right_on="i_item_sk")
+                 .merge(ca[ca.ca_gmt_offset == -5], left_on=addr_col,
+                        right_on="ca_address_sk"))
+            return x.groupby("i_manufact_id", as_index=False)[val_col].sum()\
+                .rename(columns={val_col: "total_sales"})
+
+        u = pd.concat([
+            chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                 "ss_addr_sk", "ss_ext_sales_price"),
+            chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                 "cs_bill_addr_sk", "cs_ext_sales_price"),
+            chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                 "ws_bill_addr_sk", "ws_ext_sales_price")])
+        g = u.groupby("i_manufact_id", as_index=False)["total_sales"].sum()
+        assert len(g) > 0
+        return g
+    run(env, "q33", oracle)
+
+
+def test_q40(env):
+    def oracle(F):
+        dd = F["date_dim"].copy()
+        dd["d_date"] = pd.to_datetime(dd.d_date)
+        x = (F["catalog_sales"]
+             .merge(F["catalog_returns"],
+                    left_on=["cs_order_number", "cs_item_sk"],
+                    right_on=["cr_order_number", "cr_item_sk"], how="left")
+             .merge(F["warehouse"], left_on="cs_warehouse_sk",
+                    right_on="w_warehouse_sk")
+             .merge(F["item"][F["item"].i_current_price.between(10, 90)],
+                    left_on="cs_item_sk", right_on="i_item_sk")
+             .merge(dd[dd.d_date.between("2000-02-10", "2000-04-10")],
+                    left_on="cs_sold_date_sk", right_on="d_date_sk"))
+        assert len(x) > 0
+        cut = pd.Timestamp("2000-03-11")
+        x["sales_before"] = x.cs_sales_price.where(x.d_date < cut, 0.0)
+        x["sales_after"] = x.cs_sales_price.where(x.d_date >= cut, 0.0)
+        g = x.groupby(["w_state", "i_item_id"], as_index=False).agg(
+            sales_before=("sales_before", "sum"),
+            sales_after=("sales_after", "sum"))
+        # ordered by the full (unique) group key: truncation deterministic
+        return g.sort_values(["w_state", "i_item_id"]).head(100)
+    run(env, "q40", oracle, limit=None)
+
+
+def test_q44(env):
+    def oracle(F):
+        ss = F["store_sales"]
+        v = (ss[ss.ss_store_sk == 2]
+             .groupby("ss_item_sk", as_index=False)["ss_net_profit"].mean()
+             .rename(columns={"ss_net_profit": "rank_col"}))
+        # rank(): ties share ranks — datagen profits are effectively unique
+        v = v.copy()
+        v["rnk_a"] = v.rank_col.rank(method="min", ascending=True)
+        v["rnk_d"] = v.rank_col.rank(method="min", ascending=False)
+        a = v[v.rnk_a < 11][["rnk_a", "ss_item_sk"]]
+        d = v[v.rnk_d < 11][["rnk_d", "ss_item_sk"]]
+        it = F["item"][["i_item_sk", "i_product_name"]]
+        x = (a.merge(d, left_on="rnk_a", right_on="rnk_d")
+             .merge(it, left_on="ss_item_sk_x", right_on="i_item_sk")
+             .merge(it, left_on="ss_item_sk_y", right_on="i_item_sk"))
+        out = x[["rnk_a", "i_product_name_x", "i_product_name_y"]].rename(
+            columns={"rnk_a": "rnk", "i_product_name_x": "best_performing",
+                     "i_product_name_y": "worst_performing"})
+        out["rnk"] = out.rnk.astype(int)
+        assert len(out) > 0
+        return out
+    run(env, "q44", oracle)
+
+
+def test_q46(env):
+    def oracle(F):
+        dd, hd = F["date_dim"], F["household_demographics"]
+        dn = (F["store_sales"]
+              .merge(dd[dd.d_dow.isin([6, 0])
+                        & dd.d_year.isin([1999, 2000, 2001])],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+              .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk")
+              .merge(hd[(hd.hd_dep_count == 4) | (hd.hd_vehicle_count == 3)],
+                     left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+              .merge(F["customer_address"], left_on="ss_addr_sk",
+                     right_on="ca_address_sk"))
+        g = dn.groupby(["ss_customer_sk", "ss_addr_sk", "ca_city"],
+                       as_index=False).agg(amt=("ss_coupon_amt", "sum"),
+                                           profit=("ss_net_profit", "sum"))
+        x = (g.merge(F["customer"], left_on="ss_customer_sk",
+                     right_on="c_customer_sk")
+             .merge(F["customer_address"], left_on="c_current_addr_sk",
+                    right_on="ca_address_sk", suffixes=("", "_cur")))
+        x = x[x.ca_city_cur != x.ca_city]
+        assert len(x) > 0
+        out = x.rename(columns={"ca_city": "bought_city",
+                                "ca_city_cur": "ca_city"})
+        return out[["c_last_name", "c_first_name", "ca_city", "bought_city",
+                    "amt", "profit"]]
+    run(env, "q46", oracle, limit=1000)
+
+
+def test_q47(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        sel = dd[(dd.d_year == 1999)
+                 | ((dd.d_year == 1998) & (dd.d_moy == 12))
+                 | ((dd.d_year == 2000) & (dd.d_moy == 1))]
+        x = (F["store_sales"]
+             .merge(sel, left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(F["item"], left_on="ss_item_sk", right_on="i_item_sk")
+             .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+        v1 = x.groupby(["i_category", "i_brand", "s_store_name", "d_year",
+                        "d_moy"], as_index=False)["ss_sales_price"].sum()\
+            .rename(columns={"ss_sales_price": "sum_sales"})
+        v1["avg_monthly_sales"] = v1.groupby(
+            ["i_category", "i_brand", "s_store_name", "d_year"]
+        )["sum_sales"].transform("mean")
+        v1 = v1.sort_values(["d_year", "d_moy"])
+        v1["psum"] = v1.groupby(["i_category", "i_brand", "s_store_name"])[
+            "sum_sales"].shift(1)
+        v1["nsum"] = v1.groupby(["i_category", "i_brand", "s_store_name"])[
+            "sum_sales"].shift(-1)
+        out = v1[(v1.d_year == 1999) & (v1.avg_monthly_sales > 0)
+                 & v1.psum.notna() & v1.nsum.notna()
+                 & ((v1.sum_sales - v1.avg_monthly_sales).abs()
+                    / v1.avg_monthly_sales > 0.1)]
+        assert len(out) > 0
+        out = out.sort_values(
+            ["i_category", "i_brand", "s_store_name", "d_moy"]).head(100)
+        return out[["i_category", "i_brand", "s_store_name", "d_year",
+                    "d_moy", "avg_monthly_sales", "sum_sales", "psum",
+                    "nsum"]]
+    run(env, "q47", oracle, limit=None)
+
+
+def test_q51(env):
+    def oracle(F):
+        dd = F["date_dim"][F["date_dim"].d_month_seq.between(24, 35)]
+
+        def cume(fact, date_col, item_col, val_col):
+            x = F[fact].merge(dd, left_on=date_col, right_on="d_date_sk")
+            g = x.groupby([item_col, "d_date"], as_index=False)[
+                val_col].sum()
+            g = g.sort_values([item_col, "d_date"])
+            g["cume_sales"] = g.groupby(item_col)[val_col].cumsum()
+            return g.rename(columns={item_col: "item_sk"})[
+                ["item_sk", "d_date", "cume_sales"]]
+
+        web = cume("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                   "ws_sales_price")
+        store = cume("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                     "ss_sales_price")
+        m = web.merge(store, on=["item_sk", "d_date"], how="outer",
+                      suffixes=("_w", "_s"))
+        m = m[m.cume_sales_w > m.cume_sales_s]
+        assert len(m) > 0
+        out = m.rename(columns={"cume_sales_w": "web_sales",
+                                "cume_sales_s": "store_sales"})
+        out["d_date"] = out.d_date.astype(str)
+        return out[["item_sk", "d_date", "web_sales", "store_sales"]]
+    run(env, "q51", oracle)
+
+
+# --- round-3 expansion batch 3 ----------------------------------------------
+
+
+def test_q35(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        dsel = dd[(dd.d_year == 2002) & (dd.d_qoy < 4)].d_date_sk
+        ss_c = set(F["store_sales"][
+            F["store_sales"].ss_sold_date_sk.isin(dsel)].ss_customer_sk)
+        ws_c = set(F["web_sales"][
+            F["web_sales"].ws_sold_date_sk.isin(dsel)].ws_bill_customer_sk)
+        cs_c = set(F["catalog_sales"][
+            F["catalog_sales"].cs_sold_date_sk.isin(dsel)].cs_bill_customer_sk)
+        c = F["customer"]
+        c = c[c.c_customer_sk.isin(ss_c)
+              & (c.c_customer_sk.isin(ws_c) | c.c_customer_sk.isin(cs_c))]
+        x = (c.merge(F["customer_address"], left_on="c_current_addr_sk",
+                     right_on="ca_address_sk")
+             .merge(F["customer_demographics"], left_on="c_current_cdemo_sk",
+                    right_on="cd_demo_sk"))
+        assert len(x) > 0
+        g = x.groupby(["ca_state", "cd_gender", "cd_marital_status",
+                       "cd_dep_count"], as_index=False).agg(
+            cnt1=("cd_dep_count", "size"), a1=("cd_dep_count", "mean"),
+            m1=("cd_dep_count", "max"), s1=("cd_dep_count", "sum"))
+        # ORDER BY covers the full (unique) group key: deterministic cut
+        return g.sort_values(["ca_state", "cd_gender", "cd_marital_status",
+                              "cd_dep_count"]).head(100)
+    run(env, "q35", oracle, limit=None)
+
+
+def test_q39(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        x = (F["inventory"]
+             .merge(dd[dd.d_year == 1999], left_on="inv_date_sk",
+                    right_on="d_date_sk")
+             .merge(F["item"], left_on="inv_item_sk", right_on="i_item_sk")
+             .merge(F["warehouse"], left_on="inv_warehouse_sk",
+                    right_on="w_warehouse_sk"))
+        inv = x.groupby(["w_warehouse_sk", "i_item_sk", "d_moy"],
+                        as_index=False).agg(
+            stdev=("inv_quantity_on_hand", lambda v: v.std(ddof=1)),
+            mean=("inv_quantity_on_hand", "mean"))
+        i1 = inv[(inv.d_moy == 1) & (inv["mean"] > 0)
+                 & (inv.stdev / inv["mean"] > 0.5)]
+        i2 = inv[(inv.d_moy == 2) & (inv["mean"] > 0)]
+        m = i1.merge(i2, on=["w_warehouse_sk", "i_item_sk"],
+                     suffixes=("", "_2"))
+        assert len(m) > 0
+        out = pd.DataFrame({
+            "w_warehouse_sk": m.w_warehouse_sk, "i_item_sk": m.i_item_sk,
+            "d_moy": m.d_moy, "mean": m["mean"],
+            "cov1": m.stdev / m["mean"], "d_moy_2": m.d_moy_2,
+            "mean2": m.mean_2, "cov2": m.stdev_2 / m.mean_2})
+        return out
+    run(env, "q39", oracle, limit=200)
+
+
+def test_q58(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        wk = dd[dd.d_date == "2000-03-11"].d_month_seq.iloc[0]
+        dsel = dd[dd.d_month_seq == wk][["d_date_sk"]]
+
+        def rev(fact, date_col, item_col, val_col, name):
+            x = (F[fact].merge(dsel, left_on=date_col, right_on="d_date_sk")
+                 .merge(F["item"], left_on=item_col, right_on="i_item_sk"))
+            return x.groupby("i_item_id", as_index=False)[val_col].sum()\
+                .rename(columns={val_col: name, "i_item_id": "item_id"})
+
+        s = rev("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                "ss_ext_sales_price", "ss_item_rev")
+        c = rev("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                "cs_ext_sales_price", "cs_item_rev")
+        w = rev("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                "ws_ext_sales_price", "ws_item_rev")
+        m = s.merge(c, on="item_id").merge(w, on="item_id")
+        m = m[m.ss_item_rev.between(0.5 * m.cs_item_rev, 2.0 * m.cs_item_rev)
+              & m.ss_item_rev.between(0.5 * m.ws_item_rev,
+                                      2.0 * m.ws_item_rev)]
+        assert len(m) > 0
+        return m[["item_id", "ss_item_rev", "cs_item_rev", "ws_item_rev"]]
+    run(env, "q58", oracle)
+
+
+def test_q59(env):
+    def oracle(F):
+        x = F["store_sales"].merge(F["date_dim"], left_on="ss_sold_date_sk",
+                                   right_on="d_date_sk")
+        for day, col in [("Sunday", "sun"), ("Monday", "mon"),
+                         ("Friday", "fri")]:
+            x[col] = x.ss_sales_price.where(x.d_day_name == day, 0.0)
+        wss = x.groupby(["d_week_seq", "ss_store_sk"], as_index=False).agg(
+            sun_sales=("sun", "sum"), mon_sales=("mon", "sum"),
+            fri_sales=("fri", "sum"))
+        y = wss[wss.d_week_seq.between(52, 103)]
+        xx = wss.copy()
+        xx["d_week_seq"] = xx.d_week_seq - 52
+        m = y.merge(xx, on=["d_week_seq", "ss_store_sk"],
+                    suffixes=("_y", "_x"))
+        m = m[(m.sun_sales_x > 0) & (m.mon_sales_x > 0)
+              & (m.fri_sales_x > 0)]
+        m = m.merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk")
+        assert len(m) > 0
+        return pd.DataFrame({
+            "s_store_name": m.s_store_name, "week1": m.d_week_seq,
+            "r_sun": m.sun_sales_y / m.sun_sales_x,
+            "r_mon": m.mon_sales_y / m.mon_sales_x,
+            "r_fri": m.fri_sales_y / m.fri_sales_x})
+    run(env, "q59", oracle, limit=200)
+
+
+def test_q60(env):
+    def oracle(F):
+        dd, ca, it = F["date_dim"], F["customer_address"], F["item"]
+        iids = set(it[it.i_category == "Children"].i_item_id)
+
+        def chan(fact, date_col, item_col, addr_col, val_col):
+            x = (F[fact]
+                 .merge(dd[(dd.d_year == 1999) & (dd.d_moy == 9)],
+                        left_on=date_col, right_on="d_date_sk")
+                 .merge(it[it.i_item_id.isin(iids)], left_on=item_col,
+                        right_on="i_item_sk")
+                 .merge(ca[ca.ca_gmt_offset == -5], left_on=addr_col,
+                        right_on="ca_address_sk"))
+            return x.groupby("i_item_id", as_index=False)[val_col].sum()\
+                .rename(columns={val_col: "total_sales"})
+
+        u = pd.concat([
+            chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                 "ss_addr_sk", "ss_ext_sales_price"),
+            chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                 "cs_bill_addr_sk", "cs_ext_sales_price"),
+            chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                 "ws_bill_addr_sk", "ws_ext_sales_price")])
+        g = u.groupby("i_item_id", as_index=False)["total_sales"].sum()
+        assert len(g) > 0
+        return g
+    run(env, "q60", oracle)
+
+
+def test_q63(env):
+    def oracle(F):
+        it = F["item"]
+        m = ((it.i_category.isin(["Books", "Children", "Electronics"])
+              & it.i_class.isin(["class01", "class02", "class03", "class04"]))
+             | (it.i_category.isin(["Women", "Music", "Men"])
+                & it.i_class.isin(["class05", "class06", "class07",
+                                   "class08"])))
+        x = (F["store_sales"]
+             .merge(F["date_dim"][F["date_dim"].d_year == 1999],
+                    left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(it[m], left_on="ss_item_sk", right_on="i_item_sk")
+             .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+        g = x.groupby(["i_manager_id", "d_moy"], as_index=False)[
+            "ss_sales_price"].sum().rename(
+            columns={"ss_sales_price": "sum_sales"})
+        g["avg_monthly"] = g.groupby("i_manager_id")[
+            "sum_sales"].transform("mean")
+        out = g[(g.avg_monthly > 0)
+                & ((g.sum_sales - g.avg_monthly).abs() / g.avg_monthly
+                   > 0.0001)]
+        assert len(out) > 0
+        out = out.rename(columns={"i_manager_id": "mgr"})[
+            ["mgr", "sum_sales", "avg_monthly"]]
+        # (mgr, sum_sales) is effectively unique (distinct float sums)
+        return out.sort_values(["mgr", "sum_sales"]).head(100)
+    run(env, "q63", oracle, limit=None)
+
+
+def test_q66(env):
+    def oracle(F):
+        dd, w, sm = F["date_dim"], F["warehouse"], F["ship_mode"]
+        carriers = ["DHL", "BARIAN", "UPS", "FEDEX", "AIRBORNE", "USPS",
+                    "TBS", "ZOUROS", "MSC", "LATVIAN"]
+        td = F["time_dim"]
+
+        def chan(fact, date_col, wh_col, sm_col, price, qty,
+                 time_col=None):
+            x = (F[fact]
+                 .merge(dd[dd.d_year == 1999], left_on=date_col,
+                        right_on="d_date_sk")
+                 .merge(w, left_on=wh_col, right_on="w_warehouse_sk")
+                 .merge(sm[sm.sm_carrier.isin(carriers)], left_on=sm_col,
+                        right_on="sm_ship_mode_sk"))
+            if time_col is not None:
+                x = x.merge(td[td.t_hour.between(8, 17)], left_on=time_col,
+                            right_on="t_time_sk")
+            for moy, col in [(1, "jan"), (2, "feb"), (3, "mar")]:
+                x[col] = (x[price] * x[qty]).where(x.d_moy == moy, 0.0)
+            return x.groupby(["w_warehouse_name", "w_warehouse_sq_ft",
+                              "d_year"], as_index=False).agg(
+                jan_sales=("jan", "sum"), feb_sales=("feb", "sum"),
+                mar_sales=("mar", "sum"))
+
+        u = pd.concat([
+            chan("web_sales", "ws_sold_date_sk", "ws_warehouse_sk",
+                 "ws_ship_mode_sk", "ws_ext_sales_price", "ws_quantity",
+                 "ws_sold_time_sk"),
+            chan("catalog_sales", "cs_sold_date_sk", "cs_warehouse_sk",
+                 "cs_ship_mode_sk", "cs_ext_sales_price", "cs_quantity")])
+        u["ship_carriers"] = "DHL,BARIAN"
+        g = u.groupby(["w_warehouse_name", "w_warehouse_sq_ft",
+                       "ship_carriers", "d_year"], as_index=False).agg(
+            jan_sales=("jan_sales", "sum"), feb_sales=("feb_sales", "sum"),
+            mar_sales=("mar_sales", "sum"))
+        assert len(g) > 0
+        return g
+    run(env, "q66", oracle)
+
+
+def test_q71(env):
+    def oracle(F):
+        dd, it, td = F["date_dim"], F["item"], F["time_dim"]
+        dsel = dd[(dd.d_moy == 11) & (dd.d_year == 1999)][["d_date_sk"]]
+        w = F["web_sales"].merge(dsel, left_on="ws_sold_date_sk",
+                                 right_on="d_date_sk")
+        w = w[["ws_ext_sales_price", "ws_item_sk", "ws_sold_time_sk"]]
+        w.columns = ["ext_price", "sold_item_sk", "time_sk"]
+        s = F["store_sales"].merge(dsel, left_on="ss_sold_date_sk",
+                                   right_on="d_date_sk")
+        s = s[["ss_ext_sales_price", "ss_item_sk", "ss_sold_time_sk"]]
+        s.columns = ["ext_price", "sold_item_sk", "time_sk"]
+        u = pd.concat([w, s])
+        x = (u.merge(it[it.i_manager_id == 1], left_on="sold_item_sk",
+                     right_on="i_item_sk")
+             .merge(td[td.t_hour.between(7, 9) | td.t_hour.between(19, 21)],
+                    left_on="time_sk", right_on="t_time_sk"))
+        g = x.groupby(["i_brand", "i_brand_id", "t_hour", "t_minute"],
+                      as_index=False)["ext_price"].sum()
+        assert len(g) > 0
+        return g.rename(columns={"i_brand_id": "brand_id",
+                                 "i_brand": "brand"})[
+            ["brand_id", "brand", "t_hour", "t_minute", "ext_price"]]
+    run(env, "q71", oracle, limit=200)
+
+
+def test_q73(env):
+    def oracle(F):
+        hd = F["household_demographics"]
+        hsel = hd[hd.hd_buy_potential.isin(["501-1000", "5001-10000"])
+                  & (hd.hd_vehicle_count > 0)]
+        hsel = hsel[hsel.hd_dep_count / hsel.hd_vehicle_count > 0]
+        x = (F["store_sales"]
+             .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(hsel, left_on="ss_hdemo_sk", right_on="hd_demo_sk"))
+        g = (x.groupby("ss_customer_sk", as_index=False).size()
+             .rename(columns={"size": "cnt"}))
+        g = g[g.cnt.between(3, 8)]
+        out = g.merge(F["customer"], left_on="ss_customer_sk",
+                      right_on="c_customer_sk")
+        assert len(out) > 0
+        return out[["c_last_name", "c_first_name", "c_customer_id", "cnt"]]
+    run(env, "q73", oracle, limit=1000)
+
+
+def test_q76(env):
+    def oracle(F):
+        dd, it = F["date_dim"], F["item"]
+
+        def chan(fact, channel, col_name, promo, date_col, item_col, val):
+            f = F[fact]
+            x = (f[f[promo].isna()]
+                 .merge(dd, left_on=date_col, right_on="d_date_sk")
+                 .merge(it, left_on=item_col, right_on="i_item_sk"))
+            x = x.assign(channel=channel, col_name=col_name,
+                         ext_sales_price=x[val])
+            return x[["channel", "col_name", "d_year", "d_qoy", "i_category",
+                      "ext_sales_price"]]
+
+        u = pd.concat([
+            chan("store_sales", "store", "ss_promo_sk", "ss_promo_sk",
+                 "ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"),
+            chan("web_sales", "web", "ws_promo_sk", "ws_promo_sk",
+                 "ws_sold_date_sk", "ws_item_sk", "ws_ext_sales_price"),
+            chan("catalog_sales", "catalog", "cs_promo_sk", "cs_promo_sk",
+                 "cs_sold_date_sk", "cs_item_sk", "cs_ext_sales_price")])
+        assert len(u) > 0
+        g = u.groupby(["channel", "col_name", "d_year", "d_qoy",
+                       "i_category"], as_index=False).agg(
+            sales_cnt=("ext_sales_price", "size"),
+            sales_amt=("ext_sales_price", "sum"))
+        # ORDER BY covers the full (unique) group key: deterministic cut
+        return g.sort_values(["channel", "col_name", "d_year", "d_qoy",
+                              "i_category"]).head(500)
+    run(env, "q76", oracle, limit=None)
+
+
+def test_q84(env):
+    def oracle(F):
+        ib = F["income_band"]
+        ib = ib[(ib.ib_lower_bound >= 10000) & (ib.ib_upper_bound <= 200000)]
+        x = (F["customer"]
+             .merge(F["customer_address"][
+                 F["customer_address"].ca_city == "Riverside"],
+                 left_on="c_current_addr_sk", right_on="ca_address_sk")
+             .merge(F["household_demographics"], left_on="c_current_hdemo_sk",
+                    right_on="hd_demo_sk")
+             .merge(ib, left_on="hd_income_band_sk",
+                    right_on="ib_income_band_sk")
+             .merge(F["customer_demographics"], left_on="c_current_cdemo_sk",
+                    right_on="cd_demo_sk"))
+        assert len(x) > 0
+        out = x.rename(columns={"c_customer_id": "customer_id",
+                                "c_last_name": "customername"})
+        return out[["customer_id", "customername"]].sort_values(
+            "customer_id").head(100)
+    run(env, "q84", oracle, limit=None)
+
+
+def test_q85(env):
+    def oracle(F):
+        cd = F["customer_demographics"]
+        x = (F["web_sales"]
+             .merge(F["web_page"], left_on="ws_web_page_sk",
+                    right_on="wp_web_page_sk")
+             .merge(F["web_returns"],
+                    left_on=["ws_item_sk", "ws_order_number"],
+                    right_on=["wr_item_sk", "wr_order_number"])
+             .merge(cd, left_on="wr_refunded_cdemo_sk", right_on="cd_demo_sk")
+             .merge(F["reason"], left_on="wr_reason_sk",
+                    right_on="r_reason_sk"))
+        m = (((x.cd_marital_status == "M")
+              & (x.cd_education_status == "Advanced Degree")
+              & x.ws_sales_price.between(50, 150))
+             | ((x.cd_marital_status == "S")
+                & (x.cd_education_status == "College")
+                & x.ws_sales_price.between(10, 100))
+             | ((x.cd_marital_status == "W")
+                & (x.cd_education_status == "2 yr Degree")
+                & x.ws_sales_price.between(50, 200)))
+        x = x[m]
+        assert len(x) > 0
+        return x.groupby("r_reason_desc", as_index=False).agg(
+            a1=("ws_quantity", "mean"), a2=("wr_return_amt", "mean"),
+            a3=("wr_fee", "mean"))
+    run(env, "q85", oracle)
+
+
+def test_q90(env):
+    def oracle(F):
+        x = (F["web_sales"]
+             .merge(F["time_dim"], left_on="ws_sold_time_sk",
+                    right_on="t_time_sk")
+             .merge(F["web_page"][
+                 F["web_page"].wp_char_count.between(2500, 5200)],
+                 left_on="ws_web_page_sk", right_on="wp_web_page_sk"))
+        amc = len(x[x.t_hour.between(8, 9)])
+        pmc = len(x[x.t_hour.between(19, 20)])
+        assert pmc > 0
+        return pd.DataFrame([{"am_pm_ratio": amc / pmc}])
+    run(env, "q90", oracle)
+
+
+def test_q91(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        cd, hd = F["customer_demographics"], F["household_demographics"]
+        x = (F["catalog_returns"]
+             .merge(F["call_center"], left_on="cr_call_center_sk",
+                    right_on="cc_call_center_sk")
+             .merge(dd[dd.d_year == 1999],
+                    left_on="cr_returned_date_sk", right_on="d_date_sk")
+             .merge(F["customer"], left_on="cr_returning_customer_sk",
+                    right_on="c_customer_sk")
+             .merge(cd, left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
+             .merge(hd, left_on="c_current_hdemo_sk", right_on="hd_demo_sk"))
+        m = (((x.cd_marital_status == "M")
+              & (x.cd_education_status == "Unknown"))
+             | ((x.cd_marital_status == "W")
+                & (x.cd_education_status == "Advanced Degree")))
+        x = x[m & x.hd_buy_potential.str.startswith("Unknown")]
+        assert len(x) > 0
+        out = x.groupby(["cc_call_center_id", "cc_name"],
+                        as_index=False)["cr_net_loss"].sum()
+        return out.rename(columns={"cc_call_center_id": "call_center",
+                                   "cr_net_loss": "returns_loss"})
+    run(env, "q91", oracle)
+
+
+def test_q93(env):
+    def oracle(F):
+        x = F["store_sales"].merge(
+            F["store_returns"], left_on=["ss_item_sk", "ss_ticket_number"],
+            right_on=["sr_item_sk", "sr_ticket_number"], how="left")
+        x = x[x.sr_reason_sk == 5]
+        x["act_sales"] = np.where(
+            x.sr_return_quantity.notna(),
+            (x.ss_quantity - x.sr_return_quantity) * x.ss_sales_price,
+            x.ss_quantity * x.ss_sales_price)
+        g = x.groupby("ss_customer_sk", as_index=False)["act_sales"].sum()
+        g = g.rename(columns={"act_sales": "sumsales"})
+        assert len(g) > 0
+        return g.sort_values(["sumsales", "ss_customer_sk"]).head(100)
+    run(env, "q93", oracle, limit=None)

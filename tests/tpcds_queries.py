@@ -775,3 +775,806 @@ group by substr(w_warehouse_name, 1, 20), sm_type, cc_name
 order by wname, sm_type, cc_name
 limit 100
 """
+
+# --- round-3 expansion: correlated subqueries, EXISTS combos, band ORs ------
+
+QUERIES["q1"] = """
+WITH customer_total_return AS (
+  SELECT sr_customer_sk AS ctr_customer_sk, sr_store_sk AS ctr_store_sk,
+         sum(sr_return_amt) AS ctr_total_return
+  FROM store_returns, date_dim
+  WHERE sr_returned_date_sk = d_date_sk AND d_year = 2000
+  GROUP BY sr_customer_sk, sr_store_sk)
+SELECT c_customer_id
+FROM customer_total_return ctr1, store, customer
+WHERE ctr1.ctr_total_return > (SELECT avg(ctr_total_return) * 1.2
+                               FROM customer_total_return ctr2
+                               WHERE ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  AND s_store_sk = ctr1.ctr_store_sk
+  AND s_state = 'TN'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id
+LIMIT 100
+"""
+
+QUERIES["q6"] = """
+SELECT a.ca_state AS state, count(*) AS cnt
+FROM customer_address a, customer c, store_sales s, date_dim d, item i
+WHERE a.ca_address_sk = c.c_current_addr_sk
+  AND c.c_customer_sk = s.ss_customer_sk
+  AND s.ss_sold_date_sk = d.d_date_sk
+  AND s.ss_item_sk = i.i_item_sk
+  AND d.d_month_seq = (SELECT DISTINCT d_month_seq FROM date_dim
+                       WHERE d_year = 2001 AND d_moy = 1)
+  AND i.i_current_price > 1.2 * (SELECT avg(j.i_current_price) FROM item j
+                                 WHERE j.i_category = i.i_category)
+GROUP BY a.ca_state
+HAVING count(*) >= 2
+ORDER BY cnt, state
+LIMIT 100
+"""
+
+QUERIES["q9"] = """
+SELECT CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) > 5000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) END AS bucket1,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) > 5000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) END AS bucket2,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) > 5000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) END AS bucket3
+FROM reason
+WHERE r_reason_sk = 1
+"""
+
+QUERIES["q10"] = """
+SELECT cd_gender, cd_marital_status, cd_education_status, count(*) AS cnt1,
+       cd_purchase_estimate, count(*) AS cnt2
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_county IN ('Bronx County', 'Barrow County', 'Daviess County')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk AND d_year = 2002
+                AND d_moy BETWEEN 1 AND 4)
+  AND (EXISTS (SELECT * FROM web_sales, date_dim
+               WHERE c.c_customer_sk = ws_bill_customer_sk
+                 AND ws_sold_date_sk = d_date_sk AND d_year = 2002
+                 AND d_moy BETWEEN 1 AND 4)
+       OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_bill_customer_sk
+                    AND cs_sold_date_sk = d_date_sk AND d_year = 2002
+                    AND d_moy BETWEEN 1 AND 4))
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate
+LIMIT 100
+"""
+
+QUERIES["q13"] = """
+SELECT avg(ss_quantity) AS a1, avg(ss_ext_sales_price) AS a2,
+       avg(ss_ext_wholesale_cost) AS a3, sum(ss_ext_wholesale_cost) AS s1
+FROM store_sales, store, customer_demographics, household_demographics,
+     customer_address, date_dim
+WHERE s_store_sk = ss_store_sk
+  AND ss_sold_date_sk = d_date_sk AND d_year = 2001
+  AND ss_hdemo_sk = hd_demo_sk AND cd_demo_sk = ss_cdemo_sk
+  AND ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+  AND ((cd_marital_status = 'M' AND cd_education_status = 'Advanced Degree'
+        AND ss_sales_price BETWEEN 50.00 AND 100.00 AND hd_dep_count = 3)
+       OR (cd_marital_status = 'S' AND cd_education_status = 'College'
+           AND ss_sales_price BETWEEN 10.00 AND 60.00 AND hd_dep_count = 1)
+       OR (cd_marital_status = 'W' AND cd_education_status = '2 yr Degree'
+           AND ss_sales_price BETWEEN 30.00 AND 80.00 AND hd_dep_count = 1))
+  AND ((ca_state IN ('TX', 'OH', 'TN') AND ss_net_profit BETWEEN 0 AND 2000)
+       OR (ca_state IN ('AL', 'KS', 'MI') AND ss_net_profit BETWEEN 50 AND 3000)
+       OR (ca_state IN ('CA', 'GA', 'NY') AND ss_net_profit BETWEEN 0 AND 25000))
+"""
+
+QUERIES["q28"] = """
+SELECT b1.lp AS b1_lp, b1.cnt AS b1_cnt, b1.cntd AS b1_cntd,
+       b2.lp AS b2_lp, b2.cnt AS b2_cnt, b2.cntd AS b2_cntd,
+       b3.lp AS b3_lp, b3.cnt AS b3_cnt, b3.cntd AS b3_cntd
+FROM (SELECT avg(ss_list_price) lp, count(ss_list_price) cnt,
+             count(DISTINCT ss_list_price) cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 0 AND 5
+        AND (ss_list_price BETWEEN 10 AND 50
+             OR ss_coupon_amt BETWEEN 0 AND 200
+             OR ss_wholesale_cost BETWEEN 10 AND 30)) b1,
+     (SELECT avg(ss_list_price) lp, count(ss_list_price) cnt,
+             count(DISTINCT ss_list_price) cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 6 AND 10
+        AND (ss_list_price BETWEEN 20 AND 60
+             OR ss_coupon_amt BETWEEN 0 AND 300
+             OR ss_wholesale_cost BETWEEN 20 AND 40)) b2,
+     (SELECT avg(ss_list_price) lp, count(ss_list_price) cnt,
+             count(DISTINCT ss_list_price) cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 11 AND 15
+        AND (ss_list_price BETWEEN 30 AND 70
+             OR ss_coupon_amt BETWEEN 0 AND 400
+             OR ss_wholesale_cost BETWEEN 30 AND 50)) b3
+"""
+
+QUERIES["q29"] = """
+SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) AS store_sales_quantity,
+       sum(sr_return_quantity) AS store_returns_quantity,
+       sum(cs_quantity) AS catalog_sales_quantity
+FROM store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,
+     date_dim d3, store, item
+WHERE d1.d_year = 1999
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_year = 1999
+  AND sr_customer_sk = cs_bill_customer_sk AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+  AND d3.d_year IN (1999, 2000, 2001)
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id, i_item_desc, s_store_id
+LIMIT 100
+"""
+
+QUERIES["q34"] = """
+SELECT c_last_name, c_first_name, c_customer_id, cnt
+FROM (SELECT ss_customer_sk, count(*) AS cnt
+      FROM store_sales, store, household_demographics
+      WHERE ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND (hd_buy_potential = '>10000' OR hd_buy_potential = 'Unknown')
+        AND hd_vehicle_count > 0
+        AND s_county IN ('Richland County', 'Daviess County',
+                         'Maverick County')
+      GROUP BY ss_customer_sk) dn, customer
+WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 5 AND 10
+ORDER BY c_last_name, c_first_name, c_customer_id
+LIMIT 1000
+"""
+
+QUERIES["q41"] = """
+SELECT DISTINCT i_product_name
+FROM item i1
+WHERE i_manufact_id BETWEEN 5 AND 15
+  AND (SELECT count(*) FROM item
+       WHERE i_manufact = i1.i_manufact
+         AND ((i_category = 'Women' AND i_color IN ('plum', 'pink'))
+              OR (i_category = 'Men' AND i_color IN ('black', 'blue'))
+              OR (i_category = 'Shoes'
+                  AND i_color IN ('green', 'ivory')))) > 0
+ORDER BY i_product_name
+LIMIT 100
+"""
+
+QUERIES["q48"] = """
+SELECT sum(ss_quantity) AS total
+FROM store_sales, store, customer_demographics, customer_address, date_dim
+WHERE s_store_sk = ss_store_sk
+  AND ss_sold_date_sk = d_date_sk AND d_year = 2000
+  AND cd_demo_sk = ss_cdemo_sk
+  AND ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+  AND ((cd_marital_status = 'M' AND cd_education_status = '4 yr Degree'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00)
+       OR (cd_marital_status = 'D' AND cd_education_status = '2 yr Degree'
+           AND ss_sales_price BETWEEN 50.00 AND 100.00)
+       OR (cd_marital_status = 'S' AND cd_education_status = 'College'
+           AND ss_sales_price BETWEEN 150.00 AND 200.00))
+  AND ((ca_state IN ('CO', 'OH', 'TX') AND ss_net_profit BETWEEN 0 AND 2000)
+       OR (ca_state IN ('OR', 'MN', 'KS') AND ss_net_profit BETWEEN 150 AND 3000)
+       OR (ca_state IN ('TX', 'MO', 'MI') AND ss_net_profit BETWEEN 50 AND 25000))
+"""
+
+QUERIES["q17"] = """
+SELECT i_item_id, i_item_desc, s_state,
+       count(ss_quantity) AS store_sales_quantitycount,
+       avg(ss_quantity) AS store_sales_quantityave,
+       stddev_samp(ss_quantity) AS store_sales_quantitystdev,
+       count(sr_return_quantity) AS store_returns_quantitycount,
+       avg(sr_return_quantity) AS store_returns_quantityave,
+       count(cs_quantity) AS catalog_sales_quantitycount,
+       avg(cs_quantity) AS catalog_sales_quantityave
+FROM store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,
+     date_dim d3, store, item
+WHERE d1.d_qoy = 1 AND d1.d_year = 1999 AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_year = 1999
+  AND sr_customer_sk = cs_bill_customer_sk AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+GROUP BY i_item_id, i_item_desc, s_state
+ORDER BY i_item_id, i_item_desc, s_state
+LIMIT 100
+"""
+
+QUERIES["q18"] = """
+SELECT i_item_id, ca_state,
+       avg(cs_quantity) AS agg1, avg(cs_list_price) AS agg2,
+       avg(cs_coupon_amt) AS agg3, avg(cs_sales_price) AS agg4
+FROM catalog_sales, customer_demographics cd1, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1.cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd1.cd_gender = 'F' AND cd1.cd_education_status = 'Unknown'
+  AND c_current_addr_sk = ca_address_sk
+  AND d_year = 1998
+  AND c_birth_month IN (1, 6, 8, 9, 12, 2)
+GROUP BY ROLLUP (i_item_id, ca_state)
+ORDER BY ca_state, i_item_id
+LIMIT 1000
+"""
+
+QUERIES["q30"] = """
+WITH customer_total_return AS (
+  SELECT wr_returning_cdemo_sk AS ctr_cdemo_sk,
+         ca_state AS ctr_state,
+         sum(wr_return_amt) AS ctr_total_return
+  FROM web_returns, date_dim, customer_address
+  WHERE wr_returned_date_sk = d_date_sk AND d_year = 2000
+    AND wr_refunded_addr_sk = ca_address_sk
+  GROUP BY wr_returning_cdemo_sk, ca_state)
+SELECT ctr_cdemo_sk, ctr_state, ctr_total_return
+FROM customer_total_return ctr1
+WHERE ctr1.ctr_total_return > (SELECT avg(ctr_total_return) * 1.2
+                               FROM customer_total_return ctr2
+                               WHERE ctr1.ctr_state = ctr2.ctr_state)
+ORDER BY ctr_cdemo_sk, ctr_state, ctr_total_return
+LIMIT 100
+"""
+
+QUERIES["q31"] = """
+WITH ss AS (
+  SELECT ca_county, d_qoy, d_year, sum(ss_ext_sales_price) AS store_sales
+  FROM store_sales, date_dim, customer_address
+  WHERE ss_sold_date_sk = d_date_sk AND ss_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy, d_year),
+ws AS (
+  SELECT ca_county, d_qoy, d_year, sum(ws_ext_sales_price) AS web_sales
+  FROM web_sales, date_dim, customer_address
+  WHERE ws_sold_date_sk = d_date_sk AND ws_bill_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy, d_year)
+SELECT ss1.ca_county, ss1.d_year,
+       ws2.web_sales / ws1.web_sales AS web_q1_q2_increase,
+       ss2.store_sales / ss1.store_sales AS store_q1_q2_increase
+FROM ss ss1, ss ss2, ws ws1, ws ws2
+WHERE ss1.d_qoy = 1 AND ss1.d_year = 2000
+  AND ss2.d_qoy = 2 AND ss2.d_year = 2000
+  AND ss1.ca_county = ss2.ca_county
+  AND ws1.d_qoy = 1 AND ws1.d_year = 2000
+  AND ws2.d_qoy = 2 AND ws2.d_year = 2000
+  AND ws1.ca_county = ws2.ca_county
+  AND ss1.ca_county = ws1.ca_county
+  AND ws2.web_sales / ws1.web_sales > ss2.store_sales / ss1.store_sales
+ORDER BY ss1.ca_county
+LIMIT 100
+"""
+
+QUERIES["q33"] = """
+WITH ss AS (
+  SELECT i_manufact_id, sum(ss_ext_sales_price) AS total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category = 'Electronics')
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND ss_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_manufact_id),
+cs AS (
+  SELECT i_manufact_id, sum(cs_ext_sales_price) AS total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category = 'Electronics')
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND cs_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_manufact_id),
+ws AS (
+  SELECT i_manufact_id, sum(ws_ext_sales_price) AS total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category = 'Electronics')
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND ws_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_manufact_id)
+SELECT i_manufact_id, sum(total_sales) AS total_sales
+FROM (SELECT * FROM ss UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_manufact_id
+ORDER BY total_sales, i_manufact_id
+LIMIT 100
+"""
+
+QUERIES["q40"] = """
+SELECT w_state, i_item_id,
+       sum(CASE WHEN d_date < DATE '2000-03-11'
+                THEN cs_sales_price ELSE 0 END) AS sales_before,
+       sum(CASE WHEN d_date >= DATE '2000-03-11'
+                THEN cs_sales_price ELSE 0 END) AS sales_after
+FROM catalog_sales
+LEFT OUTER JOIN catalog_returns
+  ON cs_order_number = cr_order_number AND cs_item_sk = cr_item_sk,
+warehouse, item, date_dim
+WHERE i_current_price BETWEEN 10 AND 90
+  AND i_item_sk = cs_item_sk
+  AND cs_warehouse_sk = w_warehouse_sk
+  AND cs_sold_date_sk = d_date_sk
+  AND d_date BETWEEN DATE '2000-02-10' AND DATE '2000-04-10'
+GROUP BY w_state, i_item_id
+ORDER BY w_state, i_item_id
+LIMIT 100
+"""
+
+QUERIES["q44"] = """
+WITH v AS (SELECT ss_item_sk item_sk, avg(ss_net_profit) rank_col
+           FROM store_sales WHERE ss_store_sk = 2 GROUP BY ss_item_sk)
+SELECT asceding.rnk AS rnk, i1.i_product_name AS best_performing,
+       i2.i_product_name AS worst_performing
+FROM (SELECT item_sk, rank() OVER (ORDER BY rank_col ASC) rnk
+      FROM v) asceding,
+     (SELECT item_sk, rank() OVER (ORDER BY rank_col DESC) rnk
+      FROM v) descending,
+     item i1, item i2
+WHERE asceding.rnk = descending.rnk AND asceding.rnk < 11
+  AND i1.i_item_sk = asceding.item_sk
+  AND i2.i_item_sk = descending.item_sk
+ORDER BY asceding.rnk
+LIMIT 100
+"""
+
+QUERIES["q46"] = """
+SELECT c_last_name, c_first_name, ca_city, bought_city, amt, profit
+FROM (SELECT ss_customer_sk, ca_city AS bought_city,
+             sum(ss_coupon_amt) AS amt, sum(ss_net_profit) AS profit
+      FROM store_sales, date_dim, store, household_demographics,
+           customer_address
+      WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk AND ss_addr_sk = ca_address_sk
+        AND (hd_dep_count = 4 OR hd_vehicle_count = 3)
+        AND d_dow IN (6, 0)
+        AND d_year IN (1999, 2000, 2001)
+      GROUP BY ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+WHERE ss_customer_sk = c_customer_sk
+  AND customer.c_current_addr_sk = current_addr.ca_address_sk
+  AND current_addr.ca_city <> bought_city
+ORDER BY c_last_name, c_first_name, ca_city, bought_city, amt, profit
+LIMIT 1000
+"""
+
+QUERIES["q47"] = """
+WITH v1 AS (
+  SELECT i_category, i_brand, s_store_name, d_year, d_moy,
+         sum(ss_sales_price) AS sum_sales,
+         avg(sum(ss_sales_price)) OVER (PARTITION BY i_category, i_brand,
+                                        s_store_name, d_year)
+           AS avg_monthly_sales,
+         rank() OVER (PARTITION BY i_category, i_brand, s_store_name
+                      ORDER BY d_year, d_moy) AS rn
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND (d_year = 1999 OR (d_year = 1998 AND d_moy = 12)
+         OR (d_year = 2000 AND d_moy = 1))
+  GROUP BY i_category, i_brand, s_store_name, d_year, d_moy)
+SELECT v1.i_category, v1.i_brand, v1.s_store_name, v1.d_year, v1.d_moy,
+       v1.avg_monthly_sales, v1.sum_sales,
+       v1_lag.sum_sales AS psum, v1_lead.sum_sales AS nsum
+FROM v1, v1 v1_lag, v1 v1_lead
+WHERE v1.i_category = v1_lag.i_category
+  AND v1.i_category = v1_lead.i_category
+  AND v1.i_brand = v1_lag.i_brand AND v1.i_brand = v1_lead.i_brand
+  AND v1.s_store_name = v1_lag.s_store_name
+  AND v1.s_store_name = v1_lead.s_store_name
+  AND v1.rn = v1_lag.rn + 1 AND v1.rn = v1_lead.rn - 1
+  AND v1.d_year = 1999
+  AND v1.avg_monthly_sales > 0
+  AND abs(v1.sum_sales - v1.avg_monthly_sales) / v1.avg_monthly_sales > 0.1
+ORDER BY v1.i_category, v1.i_brand, v1.s_store_name, v1.d_moy
+LIMIT 100
+"""
+
+QUERIES["q51"] = """
+WITH web_v1 AS (
+  SELECT ws_item_sk item_sk, d_date,
+         sum(sum(ws_sales_price)) OVER (PARTITION BY ws_item_sk
+                                        ORDER BY d_date
+                                        ROWS BETWEEN UNBOUNDED PRECEDING
+                                        AND CURRENT ROW) cume_sales
+  FROM web_sales, date_dim
+  WHERE ws_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 24 AND 35
+  GROUP BY ws_item_sk, d_date),
+store_v1 AS (
+  SELECT ss_item_sk item_sk, d_date,
+         sum(sum(ss_sales_price)) OVER (PARTITION BY ss_item_sk
+                                        ORDER BY d_date
+                                        ROWS BETWEEN UNBOUNDED PRECEDING
+                                        AND CURRENT ROW) cume_sales
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 24 AND 35
+  GROUP BY ss_item_sk, d_date)
+SELECT item_sk, d_date, web_sales, store_sales
+FROM (SELECT CASE WHEN web.item_sk IS NOT NULL THEN web.item_sk
+                  ELSE store.item_sk END item_sk,
+             CASE WHEN web.d_date IS NOT NULL THEN web.d_date
+                  ELSE store.d_date END d_date,
+             web.cume_sales web_sales, store.cume_sales store_sales
+      FROM web_v1 web FULL OUTER JOIN store_v1 store
+        ON web.item_sk = store.item_sk AND web.d_date = store.d_date) x
+WHERE web_sales > store_sales
+ORDER BY item_sk, d_date
+LIMIT 100
+"""
+
+QUERIES["q35"] = """
+SELECT ca_state, cd_gender, cd_marital_status, cd_dep_count,
+       count(*) AS cnt1, avg(cd_dep_count) AS a1, max(cd_dep_count) AS m1,
+       sum(cd_dep_count) AS s1
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk AND d_year = 2002
+                AND d_qoy < 4)
+  AND (EXISTS (SELECT * FROM web_sales, date_dim
+               WHERE c.c_customer_sk = ws_bill_customer_sk
+                 AND ws_sold_date_sk = d_date_sk AND d_year = 2002
+                 AND d_qoy < 4)
+       OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_bill_customer_sk
+                    AND cs_sold_date_sk = d_date_sk AND d_year = 2002
+                    AND d_qoy < 4))
+GROUP BY ca_state, cd_gender, cd_marital_status, cd_dep_count
+ORDER BY ca_state, cd_gender, cd_marital_status, cd_dep_count
+LIMIT 100
+"""
+
+QUERIES["q39"] = """
+WITH inv AS (
+  SELECT w_warehouse_sk, i_item_sk, d_moy, stddev_samp(inv_quantity_on_hand) stdev,
+         avg(inv_quantity_on_hand) mean
+  FROM inventory, item, warehouse, date_dim
+  WHERE inv_item_sk = i_item_sk AND inv_warehouse_sk = w_warehouse_sk
+    AND inv_date_sk = d_date_sk AND d_year = 1999
+  GROUP BY w_warehouse_sk, i_item_sk, d_moy)
+SELECT inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean,
+       inv1.stdev / inv1.mean AS cov1,
+       inv2.d_moy AS d_moy_2, inv2.mean AS mean2,
+       inv2.stdev / inv2.mean AS cov2
+FROM inv inv1, inv inv2
+WHERE inv1.i_item_sk = inv2.i_item_sk
+  AND inv1.w_warehouse_sk = inv2.w_warehouse_sk
+  AND inv1.d_moy = 1 AND inv2.d_moy = 2
+  AND inv1.mean > 0 AND inv2.mean > 0
+  AND inv1.stdev / inv1.mean > 0.5
+ORDER BY inv1.w_warehouse_sk, inv1.i_item_sk
+LIMIT 200
+"""
+
+QUERIES["q58"] = """
+WITH ss_items AS (
+  SELECT i_item_id item_id, sum(ss_ext_sales_price) ss_item_rev
+  FROM store_sales, item, date_dim
+  WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_month_seq = (SELECT d_month_seq FROM date_dim
+                       WHERE d_date = DATE '2000-03-11')
+  GROUP BY i_item_id),
+cs_items AS (
+  SELECT i_item_id item_id, sum(cs_ext_sales_price) cs_item_rev
+  FROM catalog_sales, item, date_dim
+  WHERE cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_month_seq = (SELECT d_month_seq FROM date_dim
+                       WHERE d_date = DATE '2000-03-11')
+  GROUP BY i_item_id),
+ws_items AS (
+  SELECT i_item_id item_id, sum(ws_ext_sales_price) ws_item_rev
+  FROM web_sales, item, date_dim
+  WHERE ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_month_seq = (SELECT d_month_seq FROM date_dim
+                       WHERE d_date = DATE '2000-03-11')
+  GROUP BY i_item_id)
+SELECT ss_items.item_id, ss_item_rev, cs_item_rev, ws_item_rev
+FROM ss_items, cs_items, ws_items
+WHERE ss_items.item_id = cs_items.item_id
+  AND ss_items.item_id = ws_items.item_id
+  AND ss_item_rev BETWEEN 0.5 * cs_item_rev AND 2.0 * cs_item_rev
+  AND ss_item_rev BETWEEN 0.5 * ws_item_rev AND 2.0 * ws_item_rev
+ORDER BY ss_items.item_id
+LIMIT 100
+"""
+
+QUERIES["q59"] = """
+WITH wss AS (
+  SELECT d_week_seq, ss_store_sk,
+         sum(CASE WHEN d_day_name = 'Sunday' THEN ss_sales_price
+                  ELSE 0 END) sun_sales,
+         sum(CASE WHEN d_day_name = 'Monday' THEN ss_sales_price
+                  ELSE 0 END) mon_sales,
+         sum(CASE WHEN d_day_name = 'Friday' THEN ss_sales_price
+                  ELSE 0 END) fri_sales
+  FROM store_sales, date_dim
+  WHERE d_date_sk = ss_sold_date_sk
+  GROUP BY d_week_seq, ss_store_sk)
+SELECT s_store_name, y.d_week_seq AS week1,
+       y.sun_sales / x.sun_sales AS r_sun,
+       y.mon_sales / x.mon_sales AS r_mon,
+       y.fri_sales / x.fri_sales AS r_fri
+FROM wss y, wss x, store
+WHERE y.ss_store_sk = x.ss_store_sk
+  AND y.ss_store_sk = s_store_sk
+  AND y.d_week_seq = x.d_week_seq - 52
+  AND y.d_week_seq BETWEEN 52 AND 103
+  AND x.sun_sales > 0 AND x.mon_sales > 0 AND x.fri_sales > 0
+ORDER BY s_store_name, week1
+LIMIT 200
+"""
+
+QUERIES["q60"] = """
+WITH ss AS (
+  SELECT i_item_id, sum(ss_ext_sales_price) AS total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Children')
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 9
+    AND ss_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+cs AS (
+  SELECT i_item_id, sum(cs_ext_sales_price) AS total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Children')
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 9
+    AND cs_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+ws AS (
+  SELECT i_item_id, sum(ws_ext_sales_price) AS total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Children')
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 9
+    AND ws_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id)
+SELECT i_item_id, sum(total_sales) AS total_sales
+FROM (SELECT * FROM ss UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY i_item_id, total_sales
+LIMIT 100
+"""
+
+QUERIES["q63"] = """
+SELECT mgr, sum_sales, avg_monthly
+FROM (SELECT i_manager_id AS mgr, sum(ss_sales_price) AS sum_sales,
+             avg(sum(ss_sales_price)) OVER (PARTITION BY i_manager_id)
+               AS avg_monthly
+      FROM item, store_sales, date_dim, store
+      WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk
+        AND d_year = 1999
+        AND ((i_category IN ('Books', 'Children', 'Electronics')
+              AND i_class IN ('class01', 'class02', 'class03', 'class04'))
+             OR (i_category IN ('Women', 'Music', 'Men')
+                 AND i_class IN ('class05', 'class06', 'class07',
+                                 'class08')))
+      GROUP BY i_manager_id, d_moy) tmp1
+WHERE CASE WHEN avg_monthly > 0
+           THEN abs(sum_sales - avg_monthly) / avg_monthly
+           ELSE NULL END > 0.0001
+ORDER BY mgr, sum_sales
+LIMIT 100
+"""
+
+QUERIES["q66"] = """
+SELECT w_warehouse_name, w_warehouse_sq_ft, ship_carriers, d_year,
+       sum(jan_sales) AS jan_sales, sum(feb_sales) AS feb_sales,
+       sum(mar_sales) AS mar_sales
+FROM (SELECT w_warehouse_name, w_warehouse_sq_ft,
+             'DHL,BARIAN' AS ship_carriers, d_year,
+             sum(CASE WHEN d_moy = 1 THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) AS jan_sales,
+             sum(CASE WHEN d_moy = 2 THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) AS feb_sales,
+             sum(CASE WHEN d_moy = 3 THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) AS mar_sales
+      FROM web_sales, warehouse, date_dim, time_dim, ship_mode
+      WHERE ws_warehouse_sk = w_warehouse_sk
+        AND ws_sold_date_sk = d_date_sk AND d_year = 1999
+        AND ws_sold_time_sk = t_time_sk
+        AND t_hour BETWEEN 8 AND 17
+        AND ws_ship_mode_sk = sm_ship_mode_sk
+        AND sm_carrier IN ('DHL', 'BARIAN', 'UPS', 'FEDEX', 'AIRBORNE',
+                           'USPS', 'TBS', 'ZOUROS', 'MSC', 'LATVIAN')
+      GROUP BY w_warehouse_name, w_warehouse_sq_ft, d_year
+      UNION ALL
+      SELECT w_warehouse_name, w_warehouse_sq_ft,
+             'DHL,BARIAN' AS ship_carriers, d_year,
+             sum(CASE WHEN d_moy = 1 THEN cs_ext_sales_price * cs_quantity
+                      ELSE 0 END) AS jan_sales,
+             sum(CASE WHEN d_moy = 2 THEN cs_ext_sales_price * cs_quantity
+                      ELSE 0 END) AS feb_sales,
+             sum(CASE WHEN d_moy = 3 THEN cs_ext_sales_price * cs_quantity
+                      ELSE 0 END) AS mar_sales
+      FROM catalog_sales, warehouse, date_dim, ship_mode
+      WHERE cs_warehouse_sk = w_warehouse_sk
+        AND cs_sold_date_sk = d_date_sk AND d_year = 1999
+        AND cs_ship_mode_sk = sm_ship_mode_sk
+        AND sm_carrier IN ('DHL', 'BARIAN', 'UPS', 'FEDEX', 'AIRBORNE',
+                           'USPS', 'TBS', 'ZOUROS', 'MSC', 'LATVIAN')
+      GROUP BY w_warehouse_name, w_warehouse_sq_ft, d_year) x
+GROUP BY w_warehouse_name, w_warehouse_sq_ft, ship_carriers, d_year
+ORDER BY w_warehouse_name
+LIMIT 100
+"""
+
+QUERIES["q71"] = """
+SELECT i_brand_id AS brand_id, i_brand AS brand, t_hour, t_minute,
+       sum(ext_price) AS ext_price
+FROM item,
+     (SELECT ws_ext_sales_price AS ext_price,
+             ws_sold_date_sk AS sold_date_sk, ws_item_sk AS sold_item_sk,
+             ws_sold_time_sk AS time_sk
+      FROM web_sales, date_dim
+      WHERE d_date_sk = ws_sold_date_sk AND d_moy = 11 AND d_year = 1999
+      UNION ALL
+      SELECT ss_ext_sales_price AS ext_price,
+             ss_sold_date_sk AS sold_date_sk, ss_item_sk AS sold_item_sk,
+             ss_sold_time_sk AS time_sk
+      FROM store_sales, date_dim
+      WHERE d_date_sk = ss_sold_date_sk AND d_moy = 11 AND d_year = 1999
+     ) tmp, time_dim
+WHERE sold_item_sk = i_item_sk AND i_manager_id = 1
+  AND time_sk = t_time_sk
+  AND (t_hour BETWEEN 7 AND 9 OR t_hour BETWEEN 19 AND 21)
+GROUP BY i_brand, i_brand_id, t_hour, t_minute
+ORDER BY ext_price DESC, i_brand_id, t_hour, t_minute
+LIMIT 200
+"""
+
+QUERIES["q73"] = """
+SELECT c_last_name, c_first_name, c_customer_id, cnt
+FROM (SELECT ss_customer_sk, count(*) AS cnt
+      FROM store_sales, store, household_demographics
+      WHERE ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND hd_buy_potential IN ('501-1000', '5001-10000')
+        AND hd_vehicle_count > 0
+        AND CASE WHEN hd_vehicle_count > 0
+                 THEN hd_dep_count / hd_vehicle_count ELSE NULL END > 0
+      GROUP BY ss_customer_sk) dj, customer
+WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 3 AND 8
+ORDER BY c_last_name, c_first_name, c_customer_id
+LIMIT 1000
+"""
+
+QUERIES["q76"] = """
+SELECT channel, col_name, d_year, d_qoy, i_category, count(*) AS sales_cnt,
+       sum(ext_sales_price) AS sales_amt
+FROM (SELECT 'store' AS channel, 'ss_promo_sk' AS col_name, d_year, d_qoy,
+             i_category, ss_ext_sales_price AS ext_sales_price
+      FROM store_sales, item, date_dim
+      WHERE ss_promo_sk IS NULL AND ss_sold_date_sk = d_date_sk
+        AND ss_item_sk = i_item_sk
+      UNION ALL
+      SELECT 'web' AS channel, 'ws_promo_sk' AS col_name, d_year, d_qoy,
+             i_category, ws_ext_sales_price AS ext_sales_price
+      FROM web_sales, item, date_dim
+      WHERE ws_promo_sk IS NULL AND ws_sold_date_sk = d_date_sk
+        AND ws_item_sk = i_item_sk
+      UNION ALL
+      SELECT 'catalog' AS channel, 'cs_promo_sk' AS col_name, d_year, d_qoy,
+             i_category, cs_ext_sales_price AS ext_sales_price
+      FROM catalog_sales, item, date_dim
+      WHERE cs_promo_sk IS NULL AND cs_sold_date_sk = d_date_sk
+        AND cs_item_sk = i_item_sk) foo
+GROUP BY channel, col_name, d_year, d_qoy, i_category
+ORDER BY channel, col_name, d_year, d_qoy, i_category
+LIMIT 500
+"""
+
+QUERIES["q84"] = """
+SELECT c_customer_id AS customer_id, c_last_name AS customername
+FROM customer, customer_address, customer_demographics,
+     household_demographics, income_band
+WHERE ca_city = 'Riverside'
+  AND c_current_addr_sk = ca_address_sk
+  AND ib_lower_bound >= 10000
+  AND ib_upper_bound <= 200000
+  AND ib_income_band_sk = hd_income_band_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+ORDER BY c_customer_id
+LIMIT 100
+"""
+
+QUERIES["q85"] = """
+SELECT r_reason_desc, avg(ws_quantity) AS a1, avg(wr_return_amt) AS a2,
+       avg(wr_fee) AS a3
+FROM web_sales, web_returns, web_page, customer_demographics cd1, reason
+WHERE ws_web_page_sk = wp_web_page_sk
+  AND ws_item_sk = wr_item_sk AND ws_order_number = wr_order_number
+  AND wr_refunded_cdemo_sk = cd1.cd_demo_sk
+  AND wr_reason_sk = r_reason_sk
+  AND ((cd1.cd_marital_status = 'M'
+        AND cd1.cd_education_status = 'Advanced Degree'
+        AND ws_sales_price BETWEEN 50.00 AND 150.00)
+       OR (cd1.cd_marital_status = 'S'
+           AND cd1.cd_education_status = 'College'
+           AND ws_sales_price BETWEEN 10.00 AND 100.00)
+       OR (cd1.cd_marital_status = 'W'
+           AND cd1.cd_education_status = '2 yr Degree'
+           AND ws_sales_price BETWEEN 50.00 AND 200.00))
+GROUP BY r_reason_desc
+ORDER BY r_reason_desc
+LIMIT 100
+"""
+
+QUERIES["q90"] = """
+SELECT CAST(amc AS DOUBLE) / CAST(pmc AS DOUBLE) AS am_pm_ratio
+FROM (SELECT count(*) AS amc FROM web_sales, time_dim, web_page
+      WHERE ws_sold_time_sk = t_time_sk
+        AND ws_web_page_sk = wp_web_page_sk
+        AND t_hour BETWEEN 8 AND 9
+        AND wp_char_count BETWEEN 2500 AND 5200) at1,
+     (SELECT count(*) AS pmc FROM web_sales, time_dim, web_page
+      WHERE ws_sold_time_sk = t_time_sk
+        AND ws_web_page_sk = wp_web_page_sk
+        AND t_hour BETWEEN 19 AND 20
+        AND wp_char_count BETWEEN 2500 AND 5200) pt
+"""
+
+QUERIES["q91"] = """
+SELECT cc_call_center_id AS call_center, cc_name, sum(cr_net_loss) AS returns_loss
+FROM call_center, catalog_returns, date_dim, customer,
+     customer_demographics, household_demographics
+WHERE cr_call_center_sk = cc_call_center_sk
+  AND cr_returned_date_sk = d_date_sk
+  AND cr_returning_customer_sk = c_customer_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND d_year = 1999
+  AND ((cd_marital_status = 'M' AND cd_education_status = 'Unknown')
+       OR (cd_marital_status = 'W'
+           AND cd_education_status = 'Advanced Degree'))
+  AND hd_buy_potential LIKE 'Unknown%'
+GROUP BY cc_call_center_id, cc_name
+ORDER BY cc_call_center_id
+LIMIT 100
+"""
+
+QUERIES["q93"] = """
+SELECT ss_customer_sk, sum(act_sales) AS sumsales
+FROM (SELECT ss_customer_sk,
+             CASE WHEN sr_return_quantity IS NOT NULL
+                  THEN (ss_quantity - sr_return_quantity) * ss_sales_price
+                  ELSE ss_quantity * ss_sales_price END AS act_sales
+      FROM store_sales
+      LEFT OUTER JOIN store_returns
+        ON sr_item_sk = ss_item_sk AND sr_ticket_number = ss_ticket_number,
+      reason
+      WHERE sr_reason_sk = r_reason_sk AND r_reason_sk = 5) t
+GROUP BY ss_customer_sk
+ORDER BY sumsales, ss_customer_sk
+LIMIT 100
+"""
