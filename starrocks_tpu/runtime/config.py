@@ -90,6 +90,9 @@ config.define("default_agg_groups", 1024, True, "initial group capacity before a
 config.define("max_recompiles", 6, True, "adaptive capacity recompile limit per query")
 config.define("join_expand_headroom", 1.2, True, "growth factor applied on capacity overflow")
 config.define("enable_zonemap_pruning", True, True, "prune parquet rowsets by zonemap stats")
+config.define("compaction_trigger_rowsets", 8, True,
+              "compact a stored table when its rowset count reaches this "
+              "(0 disables auto-compaction)")
 config.define("enable_runtime_filters", True, True, "build-side min/max filters applied to join probes")
 config.define("enable_lowcard_agg", True, True,
               "sort-free packed-code aggregation for dictionary-bounded group keys")

@@ -181,6 +181,8 @@ class Session:
             return self._refresh_mv(stmt.name.lower())
         if isinstance(stmt, ast.ShowTables):
             return sorted(self.catalog.tables)
+        if isinstance(stmt, ast.ShowPartitions):
+            return self._show_partitions(stmt.table.lower())
         if isinstance(stmt, ast.ShowCreate):
             return self._show_create(stmt.table)
         if isinstance(stmt, ast.Describe):
@@ -192,6 +194,50 @@ class Session:
                 for f in h.schema
             ]
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
+
+    def _show_partitions(self, name: str):
+        """SHOW PARTITIONS FROM t: per-partition bounds, rows, files (the
+        fe ShowPartitionsStmt surface at this scale)."""
+        if self.store is None:
+            raise ValueError("SHOW PARTITIONS requires a persistent store")
+        m = self.store.read_manifest(name)
+        pb = m.get("partition_by")
+        if not pb:
+            raise ValueError(f"table {name!r} is not partitioned")
+        rows_by_part: dict = {}
+        files_by_part: dict = {}
+        for rs in m["rowsets"]:
+            for f in rs["files"]:
+                p = f.get("part")
+                rows_by_part[p] = rows_by_part.get(p, 0) + f["rows"] - len(
+                    f.get("delvec") or ())
+                files_by_part[p] = files_by_part.get(p, 0) + 1
+        from ..storage.store import schema_from_json
+
+        ptype = schema_from_json(m["schema"]).field(pb["column"]).type
+
+        def fmt(v):
+            if v is None:
+                return None
+            import datetime
+
+            if ptype.kind is T.TypeKind.DATE:
+                return str(datetime.date(1970, 1, 1)
+                           + datetime.timedelta(days=int(v)))
+            if ptype.kind is T.TypeKind.DATETIME:
+                return str(datetime.datetime(1970, 1, 1)
+                           + datetime.timedelta(microseconds=int(v)))
+            return str(v)
+
+        lo = None
+        out = []
+        for i, (pn, up) in enumerate(zip(pb["names"], pb["uppers"])):
+            out.append((pn, pb["column"],
+                        "MIN" if lo is None else fmt(lo),
+                        "MAXVALUE" if up is None else fmt(up),
+                        rows_by_part.get(i, 0), files_by_part.get(i, 0)))
+            lo = up
+        return out
 
     def _refresh_mv(self, name: str) -> int:
         """(Re)materialize an MV: run its defining query, replace the backing
@@ -412,9 +458,31 @@ class Session:
             from ..storage.catalog import StoredTableHandle
 
             name = stmt.name.lower()
+            part = stmt.partition_by
+            if part is not None:
+                if part["column"] not in {f.name for f in schema}:
+                    raise ValueError(
+                        f"partition column {part['column']!r} not in schema")
+                pf = schema.field(part["column"])
+                if pf.type.is_temporal:
+                    import datetime as _dt
+
+                    def _bound(u):
+                        if u is None:
+                            return None
+                        if pf.type.kind is T.TypeKind.DATETIME:
+                            dt = _dt.datetime.fromisoformat(
+                                str(u).replace(" ", "T"))
+                            return int((dt - _dt.datetime(1970, 1, 1))
+                                       // _dt.timedelta(microseconds=1))
+                        return (_dt.date.fromisoformat(str(u))
+                                - _dt.date(1970, 1, 1)).days
+
+                    part = dict(part)
+                    part["uppers"] = [_bound(u) for u in part["uppers"]]
             self.store.create_table(
                 name, schema, stmt.distributed_by, stmt.buckets or 1,
-                unique_keys=pk,
+                unique_keys=pk, partition_by=part,
             )
             self.catalog.register_handle(
                 StoredTableHandle(
@@ -470,7 +538,15 @@ class Session:
                         raise ValueError(
                             f"NULL value in PRIMARY KEY column {k!r}"
                         )
-            # PRIMARY KEY model: merge + dedupe (last write wins), rewrite
+            if self.store is not None and isinstance(handle, StoredTableHandle):
+                # delta path: append rowset + delete vectors, O(delta) bytes
+                # (be/src/storage/tablet_updates.h:108)
+                conformed = _conform_to_schema(handle.schema, incoming)
+                self.store.upsert(handle.name, conformed)
+                handle.invalidate()
+                self.cache.invalidate(handle.name)
+                return n
+            # in-memory tables: merge + dedupe (last write wins), rewrite
             merged = concat_tables(handle.table, incoming, target_schema=handle.schema)
             deduped = self._upsert_merge(handle, merged)
             self._replace_table_data(handle, deduped)

@@ -160,6 +160,7 @@ class CreateTable:
     properties: tuple = ()
     select: object = None  # Select | SetOp for CREATE TABLE .. AS SELECT
     primary_key: tuple = ()  # PRIMARY KEY(cols): upsert-on-insert model
+    partition_by: object = None  # {"column","names","uppers"} RANGE spec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -210,6 +211,11 @@ class DropTable:
 @dataclasses.dataclass(frozen=True)
 class ShowTables:
     pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowPartitions:
+    table: str
 
 
 @dataclasses.dataclass(frozen=True)

@@ -23,6 +23,7 @@ KEYWORDS = {
     "show", "describe", "desc", "tables", "delete", "truncate",
     "primary", "key", "update", "set", "intersect", "except",
     "view", "materialized", "refresh", "full",
+    "partitions", "less", "than", "maxvalue",
 }
 
 

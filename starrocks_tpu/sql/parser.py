@@ -99,6 +99,7 @@ class Parser:
     SOFT_KEYWORDS = frozenset({
         "year", "month", "day", "date", "first", "last", "tables", "values",
         "show", "key", "primary", "update", "set", "delete", "truncate",
+        "partitions", "less", "than", "maxvalue",
         "describe", "desc", "view", "materialized", "refresh",
         "row", "rows", "range", "following", "unbounded", "preceding",
         "current",
@@ -185,6 +186,11 @@ class Parser:
                 name = self.parse_table_name()
                 self.accept_op(";")
                 return ast.ShowCreate(name)
+            if self.accept_kw("partitions"):
+                self.expect_kw("from")
+                name = self.parse_table_name()
+                self.accept_op(";")
+                return ast.ShowPartitions(name)
             self.expect_kw("tables")
             self.accept_op(";")
             return ast.ShowTables()
@@ -975,6 +981,48 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
+        part = None
+        if self.accept_kw("partition"):
+            # PARTITION BY RANGE(col) (PARTITION p VALUES LESS THAN (lit|
+            # MAXVALUE), ...) — fe catalog/RangePartitionInfo.java surface
+            self.expect_kw("by")
+            self.expect_kw("range")
+            self.expect_op("(")
+            pcol = self.expect_ident()
+            self.expect_op(")")
+            self.expect_op("(")
+            pnames, uppers = [], []
+            while True:
+                self.expect_kw("partition")
+                pnames.append(self.expect_ident())
+                self.expect_kw("values")
+                self.expect_kw("less")
+                self.expect_kw("than")
+                if self.accept_kw("maxvalue"):
+                    uppers.append(None)
+                else:
+                    self.expect_op("(")
+                    if self.accept_kw("maxvalue"):
+                        uppers.append(None)
+                    else:
+                        lit = self.parse_expr()
+                        if not isinstance(lit, Lit):
+                            raise ParseError(
+                                "partition bound must be a literal")
+                        uppers.append(lit.value)
+                    self.expect_op(")")
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            for u1, u2 in zip(uppers, uppers[1:]):
+                try:
+                    bad = u1 is None or (u2 is not None and u2 <= u1)
+                except TypeError:
+                    raise ParseError(
+                        "partition bounds must share one comparable type")
+                if bad:
+                    raise ParseError("partition bounds must be increasing")
+            part = {"column": pcol, "names": pnames, "uppers": uppers}
         dist = ()
         buckets = 0
         if self.accept_kw("distributed"):
@@ -989,7 +1037,8 @@ class Parser:
             if self.accept_kw("buckets"):
                 buckets = int(self.next().value)
         self.accept_op(";")
-        return ast.CreateTable(name, tuple(cols), dist, buckets, primary_key=pk)
+        return ast.CreateTable(name, tuple(cols), dist, buckets,
+                               primary_key=pk, partition_by=part)
 
     def parse_insert(self):
         self.expect_kw("insert")

@@ -104,7 +104,10 @@ class StoredTableHandle(TableHandle):
         if self._table is not None:
             return self._table.num_rows
         m = self.store.read_manifest(self.name)
-        return sum(rs["rows"] for rs in m["rowsets"])
+        return sum(
+            f["rows"] - len(f.get("delvec") or ())
+            for rs in m["rowsets"] for f in rs["files"]
+        )
 
     def invalidate(self):
         self._table = None

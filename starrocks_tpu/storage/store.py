@@ -63,6 +63,7 @@ class TabletStore:
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.log_path = os.path.join(root, "edit_log.jsonl")
+        self._pk_index: dict = {}  # table -> {pk tuple: (rowset, file, pos)}
 
     # --- edit log ------------------------------------------------------------
     def log(self, op: dict):
@@ -98,8 +99,18 @@ class TabletStore:
 
     def create_table(
         self, name: str, schema: Schema, distribution=(), buckets: int = 1,
-        unique_keys=(), record: bool = True,
+        unique_keys=(), record: bool = True, partition_by=None,
     ):
+        """partition_by: {"column": c, "names": [...], "uppers": [...]} —
+        RANGE partitioning, uppers are exclusive upper bounds in partition
+        order with None = MAXVALUE last (reference:
+        fe catalog/RangePartitionInfo.java)."""
+        if partition_by is not None:
+            pf = schema.field(partition_by["column"])
+            if not (pf.type.is_integer or pf.type.is_temporal):
+                raise ValueError(
+                    "RANGE partition column must be integer or date/datetime"
+                    f", got {pf.type} for {partition_by['column']!r}")
         os.makedirs(self._tdir(name), exist_ok=True)
         m = {
             "name": name,
@@ -107,6 +118,7 @@ class TabletStore:
             "distribution": list(distribution),
             "buckets": max(buckets, 1),
             "unique_keys": [list(k) for k in unique_keys],
+            "partition_by": partition_by,
             "rowsets": [],
             "next_rowset": 0,
         }
@@ -114,9 +126,11 @@ class TabletStore:
         if record:
             self.log({"op": "create", "table": name, "schema": schema_to_json(schema),
                       "distribution": list(distribution), "buckets": max(buckets, 1),
-                      "unique_keys": [list(k) for k in unique_keys]})
+                      "unique_keys": [list(k) for k in unique_keys],
+                      "partition_by": partition_by})
 
     def drop_table(self, name: str, record: bool = True):
+        self._pk_index.pop(name, None)
         tdir = self._tdir(name)
         if os.path.isdir(tdir):
             for f in os.listdir(tdir):
@@ -144,54 +158,70 @@ class TabletStore:
 
         m = self.read_manifest(name)
         nb = m["buckets"]
-        dist = m["distribution"]
+        bucket = self._bucket_of(m, data)
         n = data.num_rows
-        if dist and nb > 1:
-            if len(dist) == 1:
-                bucket = hash_partition_i64(
-                    np.asarray(data.arrays[dist[0]], dtype=np.int64), nb
-                ).astype(np.int64)
-            else:
-                h = np.zeros(n, dtype=np.uint64)
-                for c in dist:
-                    a = np.asarray(data.arrays[c], dtype=np.int64).view(np.uint64)
-                    am = a * np.uint64(0x9E3779B97F4A7C15)
-                    z = (am ^ (am >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-                    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-                    h = h ^ (z ^ (z >> np.uint64(31)))
-                bucket = (h % np.uint64(nb)).astype(np.int64)
-        else:
-            bucket = np.zeros(n, dtype=np.int64)
-
+        part = self._partition_of(m, data)
         rid = m["next_rowset"]
-        files = self._write_rowset_files(name, rid, data, bucket, nb)
+        files = self._write_rowset_files(name, rid, data, bucket, nb, part)
         m["rowsets"].append({"id": rid, "files": files, "rows": n})
         m["next_rowset"] = rid + 1
         self._write_manifest(name, m)
         if record:
             self.log({"op": "insert", "table": name, "rowset": rid, "rows": n})
+        self._maybe_compact(name, m)
         return n
 
-    def _write_rowset_files(self, name, rid, data, bucket, nb):
+    def _partition_of(self, m: dict, data: HostTable):
+        """Per-row partition index under the manifest's RANGE spec (None
+        when unpartitioned). Rows above the last bound raise — the
+        reference rejects them the same way unless dynamic partitions are
+        on (clone/DynamicPartitionScheduler.java)."""
+        pb = m.get("partition_by")
+        if not pb:
+            return None
+        vals = np.asarray(data.arrays[pb["column"]])
+        uppers = pb["uppers"]
+        finite = [u for u in uppers if u is not None]
+        idx = np.searchsorted(np.asarray(finite, dtype=vals.dtype), vals,
+                              side="right")
+        if uppers and uppers[-1] is None:
+            pass  # overflow rows land in the MAXVALUE partition
+        elif len(vals) and idx.max() >= len(uppers):
+            bad = vals[idx >= len(uppers)][0]
+            raise ValueError(
+                f"value {bad!r} exceeds the last partition bound of "
+                f"{m['name']!r}")
+        return idx
+
+    def _write_rowset_files(self, name, rid, data, bucket, nb, part=None):
         import pyarrow as pa
         import pyarrow.parquet as pq
 
         files = []
         table = _to_arrow(data)
-        for b in range(nb):
-            sel = bucket == b
-            rows = int(sel.sum())
-            if rows == 0:
-                continue
-            part = table.filter(pa.array(sel))
-            fname = f"rowset_{rid}_bucket_{b}.parquet"
-            pq.write_table(part, os.path.join(self._tdir(name), fname))
-            files.append({
-                "file": fname,
-                "bucket": b,
-                "rows": rows,
-                "zonemap": _zonemap(data, sel),
-            })
+        parts = [None] if part is None else sorted(set(part.tolist()))
+        for p in parts:
+            psel = slice(None) if p is None else (part == p)
+            for b in range(nb):
+                sel = bucket == b
+                if p is not None:
+                    sel = sel & psel
+                rows = int(sel.sum())
+                if rows == 0:
+                    continue
+                suffix = "" if p is None else f"_part_{p}"
+                fname = f"rowset_{rid}{suffix}_bucket_{b}.parquet"
+                fpart = table.filter(pa.array(sel))
+                pq.write_table(fpart, os.path.join(self._tdir(name), fname))
+                meta = {
+                    "file": fname,
+                    "bucket": b,
+                    "rows": rows,
+                    "zonemap": _zonemap(data, sel),
+                }
+                if p is not None:
+                    meta["part"] = int(p)
+                files.append(meta)
         return files
 
     def rewrite_table(self, name: str, data: HostTable, record: bool = True) -> int:
@@ -208,13 +238,15 @@ class TabletStore:
         rid = m["next_rowset"]
         n = data.num_rows
         if n:
-            bucket = np.zeros(n, dtype=np.int64)
-            nb = 1
-            files = self._write_rowset_files(name, rid, data, bucket, nb)
+            bucket = self._bucket_of(m, data)
+            part = self._partition_of(m, data)
+            files = self._write_rowset_files(name, rid, data, bucket,
+                                             m["buckets"], part)
             m["rowsets"] = [{"id": rid, "files": files, "rows": n}]
         else:
             m["rowsets"] = []
         m["next_rowset"] = rid + 1
+        self._pk_index.pop(name, None)
         self._write_manifest(name, m)  # atomic swap: new state is now durable
         for f in old_files:
             try:
@@ -224,6 +256,222 @@ class TabletStore:
         if record:
             self.log({"op": "rewrite", "table": name, "rows": n})
         return n
+
+    # --- compaction -----------------------------------------------------------
+    def _maybe_compact(self, name: str, m: dict):
+        from ..runtime.config import config
+
+        trigger = config.get("compaction_trigger_rowsets")
+        if trigger and len(m["rowsets"]) >= trigger:
+            self.compact_table(name)
+
+    def compact_table(self, name: str, record: bool = True) -> int:
+        """Merge every rowset into one per (partition, bucket), applying
+        delete vectors (cumulative+base compaction collapsed into one pass —
+        be/src/storage/compaction_manager.h:36; at this scale the
+        generational split buys nothing). Atomic via manifest swap."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        m = self.read_manifest(name)
+        if len(m["rowsets"]) <= 1 and not any(
+            f.get("delvec") for rs in m["rowsets"] for f in rs["files"]
+        ):
+            return 0
+        old_files = [f["file"] for rs in m["rowsets"] for f in rs["files"]]
+        groups: dict = {}
+        for rs in m["rowsets"]:
+            for fmeta in rs["files"]:
+                groups.setdefault(
+                    (fmeta.get("part"), fmeta["bucket"]), []
+                ).append(fmeta)
+        rid = m["next_rowset"]
+        schema = schema_from_json(m["schema"])
+        new_files = []
+        total_rows = 0
+        for (part, b), metas in sorted(
+            groups.items(), key=lambda kv: (kv[0][0] is not None, kv[0])
+        ):
+            tabs = []
+            for fmeta in metas:
+                t = pq.read_table(os.path.join(self._tdir(name), fmeta["file"]))
+                dv = fmeta.get("delvec")
+                if dv:
+                    keep = np.ones(t.num_rows, dtype=bool)
+                    keep[np.asarray(dv, dtype=np.int64)] = False
+                    t = t.filter(pa.array(keep))
+                tabs.append(t)
+            merged = pa.concat_tables(tabs, promote_options="default")
+            if merged.num_rows == 0:
+                continue
+            ht = _conform(HostTable.from_arrow(merged), schema, None)
+            suffix = "" if part is None else f"_part_{part}"
+            fname = f"rowset_{rid}{suffix}_bucket_{b}.parquet"
+            pq.write_table(_to_arrow(ht), os.path.join(self._tdir(name), fname))
+            meta = {
+                "file": fname, "bucket": b, "rows": ht.num_rows,
+                "zonemap": _zonemap(ht, np.ones(ht.num_rows, dtype=bool)),
+            }
+            if part is not None:
+                meta["part"] = part
+            new_files.append(meta)
+            total_rows += ht.num_rows
+        m["rowsets"] = (
+            [{"id": rid, "files": new_files, "rows": total_rows}]
+            if new_files else []
+        )
+        m["next_rowset"] = rid + 1
+        self._write_manifest(name, m)
+        self._pk_index.pop(name, None)  # positions changed
+        for f in old_files:
+            try:
+                os.remove(os.path.join(self._tdir(name), f))
+            except OSError:
+                pass
+        if record:
+            self.log({"op": "compact", "table": name, "rows": total_rows})
+        return total_rows
+
+    # --- primary-key delta path -------------------------------------------------
+    def _load_pk_index(self, name: str, m: dict, keys) -> dict:
+        """canonical-PK tuple -> (rowset_idx, file_idx, row_pos) for LIVE
+        rows. Built once per table from the key columns only, then
+        maintained incrementally by upserts (the tablet_updates primary
+        index analog). Keys are CANONICALIZED (str for VARCHAR, epoch
+        days/us ints for DATE/DATETIME) so in-memory dict codes and
+        parquet round-trips agree."""
+        import pyarrow.parquet as pq
+
+        if name in self._pk_index:
+            return self._pk_index[name]
+        schema = schema_from_json(m["schema"])
+        index: dict = {}
+        for ri, rs in enumerate(m["rowsets"]):
+            for fi, fmeta in enumerate(rs["files"]):
+                t = pq.read_table(
+                    os.path.join(self._tdir(name), fmeta["file"]),
+                    columns=list(keys),
+                )
+                dead = set(fmeta.get("delvec") or ())
+                cols = [
+                    [_canon_key(v, schema.field(k).type)
+                     for v in t.column(k).to_pylist()]
+                    for k in keys
+                ]
+                for pos, kv in enumerate(zip(*cols)):
+                    if pos in dead:
+                        continue
+                    index[kv] = (ri, fi, pos)
+        self._pk_index[name] = index
+        return index
+
+    @staticmethod
+    def _canon_key_rows(data: HostTable, keys):
+        """Canonical per-row key tuples for an in-memory HostTable batch
+        (decode dict codes to strings; temporal ints pass through)."""
+        cols = []
+        for k in keys:
+            f = data.schema.field(k)
+            a = np.asarray(data.arrays[k])
+            if f.type.is_string and f.dict is not None:
+                nv = max(len(f.dict), 1)
+                vals = [str(f.dict.values[int(c)]) if len(f.dict) else ""
+                        for c in np.clip(a, 0, nv - 1)]
+            else:
+                vals = [
+                    _canon_key(v, f.type) for v in a.tolist()
+                ]
+            cols.append(vals)
+        return list(zip(*cols))
+
+    def upsert(self, name: str, data: HostTable, record: bool = True) -> int:
+        """PRIMARY KEY write: append the batch as a DELTA rowset and mark
+        superseded rows in older rowsets via per-file delete vectors —
+        O(delta) bytes written instead of rewriting the table
+        (be/src/storage/tablet_updates.h:108 + del_vector.h). Within one
+        batch, last write wins."""
+        m = self.read_manifest(name)
+        keys = [k for ks in m["unique_keys"] for k in ks]
+        if not keys:
+            return self.insert(name, data, record=record)
+        # within-batch dedupe: keep the LAST occurrence per key
+        key_rows = self._canon_key_rows(data, keys)
+        seen: dict = {}
+        for pos, kv in enumerate(key_rows):
+            seen[kv] = pos
+        if len(seen) != data.num_rows:
+            keep = np.zeros(data.num_rows, dtype=bool)
+            keep[list(seen.values())] = True
+            data = HostTable(
+                data.schema,
+                {n: a[keep] for n, a in data.arrays.items()},
+                {n: v[keep] for n, v in data.valids.items()},
+            )
+            key_rows = self._canon_key_rows(data, keys)
+        index = self._load_pk_index(name, m, keys)
+        touched: dict = {}
+        for kv in key_rows:
+            hit = index.get(kv)
+            if hit is not None:
+                ri, fi, pos = hit
+                touched.setdefault((ri, fi), set()).add(pos)
+        for (ri, fi), dead in touched.items():
+            fmeta = m["rowsets"][ri]["files"][fi]
+            dv = set(fmeta.get("delvec") or ())
+            dv |= dead
+            fmeta["delvec"] = sorted(dv)
+        # append the delta rowset (same bucketing/partitioning as insert)
+        n = data.num_rows
+        rid = m["next_rowset"]
+        part = self._partition_of(m, data)
+        bucket = self._bucket_of(m, data)
+        files = self._write_rowset_files(name, rid, data, bucket,
+                                         m["buckets"], part)
+        new_ri = len(m["rowsets"])
+        m["rowsets"].append({"id": rid, "files": files, "rows": n})
+        m["next_rowset"] = rid + 1
+        self._write_manifest(name, m)
+        # maintain the index: map each appended row to its new location
+        file_by_bucket_part = {
+            (f.get("part"), f["bucket"]): fi for fi, f in enumerate(files)
+        }
+        counters: dict = {}
+        part_l = part.tolist() if part is not None else [None] * n
+        for pos in range(n):
+            key = key_rows[pos]
+            fk = (part_l[pos], int(bucket[pos]))
+            fi = file_by_bucket_part[fk]
+            row_in_file = counters.get(fk, 0)
+            counters[fk] = row_in_file + 1
+            index[key] = (new_ri, fi, row_in_file)
+        if record:
+            self.log({"op": "upsert", "table": name, "rowset": rid, "rows": n})
+        self._maybe_compact(name, m)
+        return n
+
+    def _bucket_of(self, m: dict, data: HostTable):
+        """Per-row bucket under the manifest's hash distribution (the one
+        routing recipe for insert AND upsert: single column via the native
+        splitmix64 partitioner, multi-column via xor-combined mixes)."""
+        from ..native import hash_partition_i64
+
+        nb = m["buckets"]
+        dist = m["distribution"]
+        n = data.num_rows
+        if not dist or nb <= 1:
+            return np.zeros(n, dtype=np.int64)
+        if len(dist) == 1:
+            return hash_partition_i64(
+                np.asarray(data.arrays[dist[0]], dtype=np.int64), nb
+            ).astype(np.int64)
+        h = np.zeros(n, dtype=np.uint64)
+        for c in dist:
+            a = np.asarray(data.arrays[c], dtype=np.int64).view(np.uint64)
+            am = a * np.uint64(0x9E3779B97F4A7C15)
+            z = (am ^ (am >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+            h = h ^ (z ^ (z >> np.uint64(31)))
+        return (h % np.uint64(nb)).astype(np.int64)
 
     # --- read path ------------------------------------------------------------
     def load_table(
@@ -238,19 +486,31 @@ class TabletStore:
         m = self.read_manifest(name)
         schema = schema_from_json(m["schema"])
         prune_enabled = config.get("enable_zonemap_pruning")
-        paths = []
-        total, pruned = 0, 0
+        pb = m.get("partition_by")
+        part_zms = _partition_zonemaps(pb)
+        chosen = []
+        total, pruned, part_pruned = 0, 0, 0
         for rs in m["rowsets"]:
             for fmeta in rs["files"]:
                 total += 1
+                if (prune_enabled and predicate is not None
+                        and part_zms is not None and "part" in fmeta
+                        and _zonemap_excludes(part_zms[fmeta["part"]],
+                                              predicate)):
+                    # manifest-only partition pruning: decided from the
+                    # DECLARED range bounds, no per-file stats needed
+                    part_pruned += 1
+                    continue
                 if prune_enabled and predicate is not None and _zonemap_excludes(
                     fmeta["zonemap"], predicate
                 ):
                     pruned += 1
                     continue
-                paths.append(os.path.join(self._tdir(name), fmeta["file"]))
-        self.last_scan_stats = {"files": total, "pruned": pruned}
-        if not paths:
+                chosen.append(fmeta)
+        self.last_scan_stats = {
+            "files": total, "pruned": pruned, "partition_pruned": part_pruned,
+        }
+        if not chosen:
             # empty table with correct schema
             sub = schema if columns is None else Schema(
                 tuple(schema.field(c) for c in columns)
@@ -260,8 +520,18 @@ class TabletStore:
             )
         import pyarrow as pa
 
-        tables = [pq.read_table(p, columns=list(columns) if columns else None)
-                  for p in paths]
+        tables = []
+        for fmeta in chosen:
+            t = pq.read_table(os.path.join(self._tdir(name), fmeta["file"]),
+                              columns=list(columns) if columns else None)
+            dv = fmeta.get("delvec")
+            if dv:
+                # primary-key delete vector: superseded rows masked at read
+                # (be/src/storage/del_vector.h analog)
+                keep = np.ones(t.num_rows, dtype=bool)
+                keep[np.asarray(dv, dtype=np.int64)] = False
+                t = t.filter(pa.array(keep))
+            tables.append(t)
         merged = pa.concat_tables(tables, promote_options="default")
         ht = HostTable.from_arrow(merged)
         # re-type to declared schema (decimals/dates read back as declared)
@@ -348,6 +618,44 @@ def _lit_cmp_value(lit: Lit, ltype_hint=None):
     return v
 
 
+def _canon_key(v, t: T.LogicalType):
+    """Canonical python value for a PK component: strings as str, DATE as
+    epoch days, DATETIME as epoch microseconds, ints as int — identical for
+    in-memory batches and parquet round-trips."""
+    import datetime
+
+    if v is None:
+        return None
+    if isinstance(v, datetime.datetime):
+        return int((v - datetime.datetime(1970, 1, 1))
+                   // datetime.timedelta(microseconds=1))
+    if isinstance(v, datetime.date):
+        return (v - datetime.date(1970, 1, 1)).days
+    if t.is_string:
+        return str(v)
+    if isinstance(v, float) and t.is_integer:
+        return int(v)
+    return int(v) if isinstance(v, (bool, np.integer)) else v
+
+
+def _partition_zonemaps(pb):
+    """Synthetic per-partition zonemaps from DECLARED range bounds: partition
+    i covers [prev_upper, upper) on the partition column, so the existing
+    zonemap-vs-predicate prover doubles as the partition pruner."""
+    if not pb:
+        return None
+    col = pb["column"]
+    out = []
+    lo = None
+    for u in pb["uppers"]:
+        hi = None if u is None else u  # exclusive; prover treats as max
+        out.append({col: {
+            "min": lo, "max": hi, "exclusive_max": u is not None,
+        }})
+        lo = u
+    return out
+
+
 def _zonemap_excludes(zm: dict, predicate: Expr) -> bool:
     """True only when the zonemap PROVES no row can satisfy the predicate.
     Conservative: unknown shapes never exclude. Handles conjuncts of
@@ -381,8 +689,14 @@ def _conjunct_excludes(zm: dict, c: Expr) -> bool:
             if any(isinstance(v, str) for v in vals):
                 return False
             vals = [v * (10 ** ent["scale"]) for v in vals]
+        lo_, hi_ = ent["min"], ent["max"]
+        excl_ = ent.get("exclusive_max", False)
         try:
-            return all(v < ent["min"] or v > ent["max"] for v in vals)
+            return all(
+                (lo_ is not None and v < lo_)
+                or (hi_ is not None and (v >= hi_ if excl_ else v > hi_))
+                for v in vals
+            )
         except TypeError:
             return False
     if not (isinstance(c, Call) and c.fn in _FLIP and len(c.args) == 2):
@@ -415,17 +729,22 @@ def _conjunct_excludes(zm: dict, c: Expr) -> bool:
             return False
         v = v * (10 ** ent["scale"])
     lo, hi = ent["min"], ent["max"]
+    # None bound = unbounded (synthetic partition maps); exclusive_max marks
+    # a range partition's open upper bound
+    excl = ent.get("exclusive_max", False)
     try:
         if fn == "eq":
-            return v < lo or v > hi
+            return ((lo is not None and v < lo)
+                    or (hi is not None
+                        and (v >= hi if excl else v > hi)))
         if fn == "lt":
-            return lo >= v
+            return lo is not None and lo >= v
         if fn == "le":
-            return lo > v
+            return lo is not None and lo > v
         if fn == "gt":
-            return hi <= v
+            return hi is not None and hi <= v
         if fn == "ge":
-            return hi < v
+            return hi is not None and (hi <= v if excl else hi < v)
     except TypeError:
         return False
     return False
