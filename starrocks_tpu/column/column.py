@@ -219,7 +219,8 @@ def chunk_from_arrays(
         if a.dtype != f.type.np_dtype:
             a = a.astype(f.type.np_dtype)
         if len(a) < cap:
-            a = np.concatenate([a, np.zeros(cap - len(a), dtype=a.dtype)])
+            pad_shape = (cap - len(a),) + a.shape[1:]
+            a = np.concatenate([a, np.zeros(pad_shape, dtype=a.dtype)])
         elif len(a) > cap:
             raise ValueError(f"column {f.name}: {len(a)} rows > capacity {cap}")
         data.append(jnp.asarray(a))

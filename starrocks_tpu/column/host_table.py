@@ -58,6 +58,24 @@ class HostTable:
                 continue
             vals = list(values) if not isinstance(values, np.ndarray) else values
             t = types.get(name)
+            if (t is not None and t.is_array) or (
+                t is None and isinstance(vals, list) and any(
+                    isinstance(v, (list, tuple)) for v in vals
+                    if v is not None)
+            ):
+                f, arr, vl = _build_array_column(name, vals, t, nullable)
+                fields.append(f)
+                arrays[name] = arr
+                if vl is not None:
+                    valids[name] = vl
+                continue
+            if t is not None and t.is_decimal128:
+                arr, vl = _build_dec128_column(vals, t)
+                fields.append(Field(name, t, nullable))
+                arrays[name] = arr
+                if vl is not None:
+                    valids[name] = vl
+                continue
             nulls = None
             if isinstance(vals, list) and any(v is None for v in vals):
                 nulls = np.array([v is None for v in vals])
@@ -98,7 +116,15 @@ class HostTable:
             nulls = None
             if col.null_count:
                 nulls = ~np.asarray(col.is_null())
-            if pa.types.is_string(at) or pa.types.is_large_string(at) or pa.types.is_dictionary(at):
+            if pa.types.is_list(at) or pa.types.is_large_list(at):
+                lists = col.to_pylist()
+                f, arr, vl = _build_array_column(col_name, lists, None, True)
+                fields.append(f)
+                arrays[col_name] = arr
+                if vl is not None:
+                    valids[col_name] = vl
+                nulls = None  # handled by the builder
+            elif pa.types.is_string(at) or pa.types.is_large_string(at) or pa.types.is_dictionary(at):
                 if pa.types.is_dictionary(at):
                     col = col.cast(pa.string())
                 svals = col.to_pylist()
@@ -108,13 +134,29 @@ class HostTable:
                 arrays[col_name] = codes
             elif pa.types.is_decimal(at):
                 scale = at.scale
-                ints = np.array(
-                    [0 if v is None else int(v.scaleb(scale).to_integral_value()) for v in col.to_pylist()],
-                    dtype=np.int64,
-                )
-                t = LogicalType(TypeKind.DECIMAL, min(at.precision, 18), scale)
-                fields.append(Field(col_name, t, True))
-                arrays[col_name] = ints
+                if at.precision > 18:
+                    import decimal as _d
+
+                    ctx = _d.Context(prec=60)  # default ctx rounds to 28
+                    vals = col.to_pylist()
+                    mat = np.zeros((len(vals), _D128_LIMBS), dtype=np.int64)
+                    for i, dv in enumerate(vals):
+                        if dv is None:
+                            continue
+                        mat[i] = _int_to_dec128(
+                            int(dv.scaleb(scale, ctx)
+                                .to_integral_value(_d.ROUND_HALF_EVEN, ctx)))
+                    t = LogicalType(TypeKind.DECIMAL, at.precision, scale)
+                    fields.append(Field(col_name, t, True))
+                    arrays[col_name] = mat
+                else:
+                    ints = np.array(
+                        [0 if v is None else int(v.scaleb(scale).to_integral_value()) for v in col.to_pylist()],
+                        dtype=np.int64,
+                    )
+                    t = LogicalType(TypeKind.DECIMAL, at.precision, scale)
+                    fields.append(Field(col_name, t, True))
+                    arrays[col_name] = ints
             elif pa.types.is_date(at):
                 days = col.cast(pa.int32()).to_numpy(zero_copy_only=False)
                 fields.append(Field(col_name, LogicalType(TypeKind.DATE), True))
@@ -172,6 +214,25 @@ class HostTable:
             for a, v, f in cols:
                 if v is not None and not v[r]:
                     row.append(None)
+                elif f.type.is_array:
+                    ln = int(a[r, 0])
+                    et = f.type.elem
+                    ev = a[r, 1:1 + ln]
+                    if et.is_string and f.dict is not None:
+                        row.append([str(f.dict.values[int(c)])
+                                    for c in ev])
+                    elif et.is_float:
+                        row.append([float(x) for x in ev])
+                    else:
+                        row.append([int(x) for x in ev])
+                elif f.type.is_decimal128:
+                    import decimal
+
+                    # default context rounds to 28 digits; DECIMAL(38) needs
+                    # the full width
+                    ctx = decimal.Context(prec=60)
+                    row.append(decimal.Decimal(
+                        _dec128_to_int(a[r])).scaleb(-f.type.scale, ctx))
                 elif f.type.is_decimal:
                     row.append(int(a[r]) / (10 ** f.type.scale))
                 elif f.type.kind is TypeKind.DATE:
@@ -261,3 +322,88 @@ def _numpy_to_logical(dt) -> LogicalType:
     if dt in m:
         return LogicalType(m[dt])
     raise TypeError(f"unsupported numpy dtype {dt}")
+
+
+# --- wide-column builders (ARRAY / DECIMAL128 2-D layouts) -------------------
+
+_D128_LIMBS = 4
+_D128_BASE = 1 << 32
+
+
+def _int_to_dec128(v: int) -> list:
+    """Signed 128-bit int -> 4x32-bit limbs, most significant first, stored
+    in int64 lanes (two's complement across the 128-bit value)."""
+    u = v & ((1 << 128) - 1)
+    return [(u >> (96 - 32 * i)) & 0xFFFFFFFF for i in range(_D128_LIMBS)]
+
+
+def _dec128_to_int(limbs) -> int:
+    u = 0
+    for x in np.asarray(limbs).tolist():
+        u = (u << 32) | (int(x) & 0xFFFFFFFF)
+    if u >= 1 << 127:
+        u -= 1 << 128
+    return u
+
+
+def _build_dec128_column(vals, t):
+    """DECIMAL(p>18): values (ints = unscaled logical, floats/str/Decimal =
+    logical) -> [n, 4] limb matrix."""
+    import decimal
+
+    n = len(vals)
+    out = np.zeros((n, _D128_LIMBS), dtype=np.int64)
+    valid = np.ones(n, dtype=bool)
+    scale = 10 ** t.scale
+    for i, v in enumerate(vals):
+        if v is None:
+            valid[i] = False
+            continue
+        if isinstance(v, (decimal.Decimal, str)):
+            # wide context everywhere: the default one rounds EVERY operation
+            # (including *) to 28 significant digits
+            ctx = decimal.Context(prec=60, rounding=decimal.ROUND_HALF_EVEN)
+            scaled = int(decimal.Decimal(str(v)).scaleb(t.scale, ctx)
+                         .to_integral_value(decimal.ROUND_HALF_EVEN, ctx))
+        elif isinstance(v, float):
+            scaled = int(round(v * scale))
+        else:
+            scaled = int(v) * scale
+        out[i] = _int_to_dec128(scaled)
+    return out, (None if valid.all() else valid)
+
+
+def _build_array_column(name, vals, t, nullable):
+    """list-of-list values -> Field(ARRAY<elem>) + [n, K+1] matrix whose
+    column 0 is the LENGTH and 1..K the zero-padded elements (self-contained
+    single-array layout: every row-wise op — gather, scatter, compact —
+    treats it like any other column, just rank 2)."""
+    from ..types import ARRAY as _ARR
+
+    n = len(vals)
+    valid = np.ones(n, dtype=bool)
+    lists = []
+    for i, v in enumerate(vals):
+        if v is None:
+            valid[i] = False
+            lists.append([])
+        else:
+            lists.append(list(v))
+    flat = [x for sub in lists for x in sub if x is not None]
+    if any(x is None for sub in lists for x in sub):
+        raise NotImplementedError("NULL array elements not supported")
+    elem = t.elem if t is not None else _infer_type(flat if flat else [0])
+    k = max((len(sub) for sub in lists), default=0)
+    k = max(k, 1)
+    d = None
+    if elem.is_string:
+        d, codes = StringDict.from_strings([str(x) for x in flat])
+        it = iter(codes.tolist())
+        lists = [[next(it) for _ in sub] for sub in lists]
+    out = np.zeros((n, k + 1), dtype=elem.np_dtype)
+    for i, sub in enumerate(lists):
+        out[i, 0] = len(sub)
+        if sub:
+            out[i, 1:1 + len(sub)] = np.asarray(sub, dtype=elem.np_dtype)
+    f = Field(name, _ARR(elem), nullable, d)
+    return f, out, (None if valid.all() else valid)

@@ -88,6 +88,13 @@ def _infer_lit(value, ltype: T.LogicalType | None) -> tuple:
         if ltype is not None and ltype.is_decimal:
             return int(round(value * 10 ** ltype.scale)), ltype
         return value, ltype or T.DOUBLE
+    import decimal
+
+    if isinstance(value, decimal.Decimal):
+        if ltype is not None and ltype.is_decimal:
+            return int(value.scaleb(ltype.scale,
+                                    decimal.Context(prec=60))), ltype
+        return float(value), ltype or T.DOUBLE
     if isinstance(value, datetime.date):
         return (value - datetime.date(1970, 1, 1)).days, T.DATE
     if isinstance(value, str):
@@ -457,7 +464,16 @@ def _f_abs(cc, a):
     return EVal(jnp.abs(jnp.asarray(a.data)), a.valid, a.type)
 
 
+def _dec128_guard(*vals):
+    for v in vals:
+        if v.type.is_decimal128 or v.type.is_array:
+            raise NotImplementedError(
+                f"comparisons over {v.type} are not supported yet "
+                "(cast to DOUBLE, or compare via array functions)")
+
+
 def _compare(cc, a, b, op):
+    _dec128_guard(a, b)
     a, b = _promote_temporal_literals(a, b)
     if a.type.is_string or b.type.is_string:
         return _compare_strings(cc, a, b, op)

@@ -1,0 +1,281 @@
+"""ARRAY functions over the wide-column layout.
+
+Reference behavior: be/src/exprs/array_functions.{h,cpp} over
+be/src/column/array_column.h (offsets+elements). The TPU re-design stores an
+array column as ONE rank-2 array [capacity, K+1]: column 0 holds the LENGTH,
+columns 1..K the zero-padded elements (K = static per-column max). Every
+function is a masked row-wise reduce/permute along axis 1 — no offsets, no
+ragged shapes, everything fuses under jit.
+
+NULL ELEMENTS inside arrays are not represented (row-level NULLs are); the
+builders reject them at ingest.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column.dict_encoding import StringDict
+from .compile import EVal, _and_valid, _to_numeric, function
+
+
+def _arr(a: EVal):
+    if not a.type.is_array:
+        raise TypeError(f"expected ARRAY, got {a.type}")
+    d = jnp.asarray(a.data)
+    k = d.shape[1] - 1
+    length = jnp.asarray(d[:, 0], jnp.int32)
+    vals = d[:, 1:]
+    mask = jnp.arange(k)[None, :] < length[:, None]  # live element lanes
+    return length, vals, mask, a.type.elem
+
+
+@function("array_length")
+def _f_array_length(cc, a):
+    length, _, _, _ = _arr(a)
+    return EVal(length, a.valid, T.INT)
+
+
+@function("cardinality")
+def _f_cardinality(cc, a):
+    return cc.call("array_length", a)
+
+
+@function("element_at")
+def _f_element_at(cc, a, i):
+    """1-based indexing; out-of-range -> NULL (reference semantics)."""
+    length, vals, _, elem = _arr(a)
+    idx = jnp.asarray(_to_numeric(i, T.BIGINT), jnp.int32)
+    in_range = (idx >= 1) & (idx <= length)
+    k = vals.shape[1]
+    take = jnp.clip(idx - 1, 0, k - 1)
+    if jnp.ndim(take) == 0:
+        out = vals[:, take]
+    else:
+        out = jnp.take_along_axis(vals, take[:, None], axis=1)[:, 0]
+    valid = _and_valid(a.valid, i.valid, in_range)
+    return EVal(out, valid, elem, a.dict)
+
+
+@function("array_contains")
+def _f_array_contains(cc, a, v):
+    length, vals, mask, elem = _arr(a)
+    if elem.is_string:
+        if not isinstance(v.data, str):
+            raise NotImplementedError(
+                "array_contains over strings needs a literal needle")
+        code = a.dict.encode_one(v.data) if a.dict is not None else -1
+        hit = (vals == code) & mask
+    else:
+        needle = jnp.asarray(v.data, vals.dtype)
+        hit = (vals == needle[..., None]
+               if jnp.ndim(needle) else vals == needle) & mask
+    out = jnp.any(hit, axis=1)
+    return EVal(out, _and_valid(a.valid, v.valid), T.BOOLEAN)
+
+
+@function("array_position")
+def _f_array_position(cc, a, v):
+    """1-based index of the first occurrence, 0 when absent."""
+    length, vals, mask, elem = _arr(a)
+    if elem.is_string:
+        if not isinstance(v.data, str):
+            raise NotImplementedError(
+                "array_position over strings needs a literal needle")
+        code = a.dict.encode_one(v.data) if a.dict is not None else -1
+        hit = (vals == code) & mask
+    else:
+        hit = (vals == jnp.asarray(v.data, vals.dtype)) & mask
+    k = vals.shape[1]
+    first = jnp.min(jnp.where(hit, jnp.arange(1, k + 1)[None, :], k + 1),
+                    axis=1)
+    out = jnp.where(first > k, 0, first)
+    return EVal(jnp.asarray(out, jnp.int32), _and_valid(a.valid, v.valid),
+                T.INT)
+
+
+def _masked_reduce(a: EVal, red, identity, out_t=None):
+    length, vals, mask, elem = _arr(a)
+    if not (elem.is_numeric or elem.is_temporal):
+        raise TypeError(f"numeric array required, got ARRAY<{elem}>")
+    filled = jnp.where(mask, vals, jnp.asarray(identity, vals.dtype))
+    out = red(filled, axis=1)
+    valid = _and_valid(a.valid, length > 0)
+    return EVal(out, valid, out_t or elem)
+
+
+@function("array_sum")
+def _f_array_sum(cc, a):
+    length, vals, mask, elem = _arr(a)
+    if not elem.is_numeric:
+        raise TypeError(f"numeric array required, got ARRAY<{elem}>")
+    out_t = T.DOUBLE if elem.is_float else T.BIGINT
+    out = jnp.sum(jnp.where(mask, jnp.asarray(vals, out_t.dtype), 0), axis=1)
+    return EVal(out, _and_valid(a.valid, length > 0), out_t)
+
+
+@function("array_avg")
+def _f_array_avg(cc, a):
+    length, vals, mask, elem = _arr(a)
+    if not elem.is_numeric:
+        raise TypeError(f"numeric array required, got ARRAY<{elem}>")
+    s = jnp.sum(jnp.where(mask, jnp.asarray(vals, jnp.float64), 0.0), axis=1)
+    out = s / jnp.maximum(length, 1)
+    return EVal(out, _and_valid(a.valid, length > 0), T.DOUBLE)
+
+
+@function("array_min")
+def _f_array_min(cc, a):
+    ident = (jnp.inf if a.type.elem.is_float
+             else jnp.iinfo(a.type.elem.dtype).max)
+    return _masked_reduce(a, jnp.min, ident)
+
+
+@function("array_max")
+def _f_array_max(cc, a):
+    ident = (-jnp.inf if a.type.elem.is_float
+             else jnp.iinfo(a.type.elem.dtype).min)
+    return _masked_reduce(a, jnp.max, ident)
+
+
+def _resort(a: EVal, keyed_vals):
+    """Sort each row's live elements by keyed_vals ascending, repack with
+    zero padding; returns the new [cap, K+1] matrix."""
+    length, vals, mask, elem = _arr(a)
+    k = vals.shape[1]
+    big = jnp.asarray(jnp.inf if elem.is_float
+                      else jnp.iinfo(keyed_vals.dtype).max, keyed_vals.dtype)
+    keys = jnp.where(mask, keyed_vals, big)  # pads sort last
+    order = jnp.argsort(keys, axis=1)
+    sorted_vals = jnp.take_along_axis(vals, order, axis=1)
+    packed = jnp.where(mask, sorted_vals, jnp.zeros((), vals.dtype))
+    return jnp.concatenate(
+        [jnp.asarray(length, vals.dtype)[:, None], packed], axis=1)
+
+
+@function("array_sort")
+def _f_array_sort(cc, a):
+    length, vals, mask, elem = _arr(a)
+    # dict codes sort by rank = lexicographic (sorted dictionaries)
+    return EVal(_resort(a, vals), a.valid, a.type, a.dict)
+
+
+@function("array_distinct")
+def _f_array_distinct(cc, a):
+    length, vals, mask, elem = _arr(a)
+    k = vals.shape[1]
+    big = jnp.asarray(jnp.inf if elem.is_float
+                      else jnp.iinfo(vals.dtype).max, vals.dtype)
+    srt = jnp.sort(jnp.where(mask, vals, big), axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((srt.shape[0], 1), bool), srt[:, 1:] == srt[:, :-1]],
+        axis=1)
+    live = (jnp.arange(k)[None, :] < length[:, None])
+    srt_mask = jnp.take_along_axis(
+        mask, jnp.argsort(jnp.where(mask, vals, big), axis=1), axis=1)
+    keep = srt_mask & ~dup
+    new_len = jnp.sum(keep, axis=1)
+    # compact kept elements to the front: sort by (dropped, position)
+    rank = jnp.where(keep, jnp.arange(k)[None, :], k + jnp.arange(k)[None, :])
+    order2 = jnp.argsort(rank, axis=1)
+    packed = jnp.where(jnp.arange(k)[None, :] < new_len[:, None],
+                       jnp.take_along_axis(srt, order2, axis=1),
+                       jnp.zeros((), vals.dtype))
+    out = jnp.concatenate(
+        [jnp.asarray(new_len, vals.dtype)[:, None], packed], axis=1)
+    return EVal(out, a.valid, a.type, a.dict)
+
+
+@function("array")
+def _f_array(cc, *args):
+    """array(e1, e2, ...): constructor from scalar expressions. Numeric
+    elements promote to a common type; string elements remap onto ONE
+    merged dictionary (codes from different columns are not comparable)."""
+    from ..types import common_numeric_type
+
+    if not args:
+        raise ValueError("array() needs at least one element")
+    elem = args[0].type
+    for x in args[1:]:
+        if elem.is_numeric and x.type.is_numeric:
+            elem = common_numeric_type(elem, x.type)
+        elif elem.kind is not x.type.kind:
+            raise TypeError(
+                f"array() element types differ: {elem} vs {x.type}")
+    cap = None
+    for x in args:
+        if isinstance(x.data, str):
+            continue
+        d = jnp.asarray(x.data)
+        if d.ndim:
+            cap = d.shape[0]
+    dct = None
+    remaps = []
+    if elem.is_string:
+        # merge every argument's dictionary (+ literals) into one
+        dct = StringDict.from_values([])
+        for x in args:
+            if x.dict is not None:
+                dct, _, _ = dct.merge(x.dict)
+            elif isinstance(x.data, str):
+                lit_d, _ = StringDict.from_strings([x.data])
+                dct, _, _ = dct.merge(lit_d)
+        for x in args:
+            if x.dict is not None:
+                _, _, r = dct.merge(x.dict)
+                remaps.append(jnp.asarray(r))
+            else:
+                remaps.append(None)
+    cols = []
+    for i, x in enumerate(args):
+        d = x.data
+        if x.type.is_string and isinstance(d, str):
+            d = dct.encode_one(d)
+        elif elem.is_string and x.dict is not None:
+            n = max(len(x.dict), 1)
+            d = remaps[i][jnp.clip(jnp.asarray(d), 0, n - 1)]
+        d = jnp.asarray(d, elem.dtype)
+        if d.ndim == 0 and cap is not None:
+            d = jnp.broadcast_to(d, (cap,))
+        cols.append(d)
+    if cap is None:  # all literals: broadcast to the chunk's capacity
+        cap = cc.chunk.capacity
+        cols = [jnp.broadcast_to(c, (cap,)) for c in cols]
+    n = len(cols)
+    mat = jnp.stack(cols, axis=1)
+    length = jnp.full((cap, 1), n, elem.dtype)
+    out = jnp.concatenate([length, mat], axis=1)
+    valid = _and_valid(*[x.valid for x in args])
+    return EVal(out, valid, T.ARRAY(elem), dct)
+
+
+@function("split")
+def _f_split(cc, s, sep):
+    """split(str_col, sep_literal) -> ARRAY<VARCHAR> via a dictionary LUT:
+    every dictionary value splits ONCE at trace time into a [dict, K+1]
+    code matrix; rows gather their split row by code."""
+    if not isinstance(sep.data, str):
+        raise NotImplementedError("split needs a literal separator")
+    if s.dict is None and isinstance(s.data, str):
+        parts = s.data.split(sep.data)
+        d, codes = StringDict.from_strings(parts)
+        row = jnp.concatenate([
+            jnp.asarray([len(parts)], jnp.int32), jnp.asarray(codes)])
+        return EVal(row[None, :], s.valid, T.ARRAY(T.VARCHAR), d)
+    assert s.dict is not None, "split needs a string column"
+    all_parts = [str(v).split(sep.data) for v in s.dict.values]
+    flat = [p for ps in all_parts for p in ps]
+    d, codes = StringDict.from_strings(flat) if flat else (
+        StringDict.from_values([]), np.zeros(0, np.int32))
+    k = max((len(ps) for ps in all_parts), default=1)
+    lut = np.zeros((max(len(s.dict), 1), k + 1), np.int32)
+    it = iter(np.asarray(codes).tolist())
+    for i, ps in enumerate(all_parts):
+        lut[i, 0] = len(ps)
+        for j in range(len(ps)):
+            lut[i, 1 + j] = next(it)
+    lutj = jnp.asarray(lut)
+    idx = jnp.clip(jnp.asarray(s.data), 0, lut.shape[0] - 1)
+    return EVal(lutj[idx], s.valid, T.ARRAY(T.VARCHAR), d)

@@ -53,6 +53,8 @@ FINAL = "final"
 
 
 def _sum_out_type(t: T.LogicalType) -> T.LogicalType:
+    if t.is_decimal128:
+        return t
     if t.is_decimal:
         return T.DECIMAL(18, t.scale)
     if t.is_float:
@@ -77,7 +79,7 @@ def _minmax_identity(t: T.LogicalType, is_min: bool):
 _VAR_FNS = {"var_pop", "var_samp", "stddev_pop", "stddev_samp"}
 _COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
 # need the full value multiset -> cannot be split into partial/final
-_HOLISTIC_FNS = {"percentile_cont", "percentile_disc"}
+_HOLISTIC_FNS = {"percentile_cont", "percentile_disc", "array_agg"}
 
 
 def decomposable(aggs: tuple) -> bool:
@@ -148,7 +150,7 @@ def bounded_domain(chunk: Chunk, group_by) -> Optional[int]:
     return total
 
 
-def _try_lowcard(chunk, group_by, keys, live, num_groups: int, mode: str):
+def _try_lowcard(chunk, group_by, keys, live, num_groups: int, mode: str, aggs=()):
     """Sort-free fast path when every group key has a bounded domain
     (dictionary codes / booleans): group id = mixed-radix packed codes, and
     aggregates are direct segment reductions — no lexsort. This is the
@@ -161,6 +163,9 @@ def _try_lowcard(chunk, group_by, keys, live, num_groups: int, mode: str):
     from ..runtime.config import config as _cfg
 
     if mode == FINAL or not group_by or not _cfg.get("enable_lowcard_agg"):
+        return None
+    if any(a.fn == "array_agg" for _, a in aggs):
+        # array_agg needs group-contiguous positions (the sort path)
         return None
     infos = []
     total = 1
@@ -208,7 +213,8 @@ def _lowcard_key_columns(infos, total: int, num_groups: int):
 
 
 def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
-                      num_groups, indices_sorted):
+                      num_groups, indices_sorted, arr_cap=256,
+                      aux_checks=None):
     """Emit aggregate output columns — shared by the sort path (reorder
     permutes rows into group order) and the low-cardinality packed-gid path
     (reorder is identity). live_rows is the row-liveness mask AFTER reorder."""
@@ -357,7 +363,7 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
                 out_valid.append(ok)
             continue
 
-        if agg.fn in _HOLISTIC_FNS:
+        if agg.fn in _HOLISTIC_FNS and agg.fn != "array_agg":
             if mode != COMPLETE:
                 raise NotImplementedError(
                     f"{agg.fn} cannot be split into partial/final")
@@ -398,6 +404,10 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
 
         # sum / min / max / count(x)
         a = cc.eval(Col(name)) if mode == FINAL else cc.eval(agg.arg)
+        if a.type.is_decimal128 and agg.fn not in ("sum", "count"):
+            raise NotImplementedError(
+                f"{agg.fn} over DECIMAL(>18) is not supported yet "
+                "(sum/count/avg-via-sum are; cast to DOUBLE for the rest)")
         m = live_rows if a.valid is None else (
             live_rows & reorder(jnp.broadcast_to(a.valid, (cap,)))
         )
@@ -411,6 +421,26 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
             out_fields.append(Field(name, T.BIGINT, False))
             out_data.append(res)
             out_valid.append(None)
+        elif agg.fn == "sum" and a.type.is_decimal128:
+            # 128-bit exact sum: per-32-bit-limb segment sums (limb sums of
+            # up to 2^31 rows fit int64), then one device carry-propagation
+            # pass; wraps mod 2^128 like the reference's int128 accumulator
+            d = reorder(jnp.asarray(a.data))  # [cap, 4] limbs, ms first
+            limb_sums = [
+                _seg_sum(jnp.where(m, d[:, i] & 0xFFFFFFFF, 0))
+                for i in range(4)
+            ]
+            out_limbs = [None] * 4
+            carry = jnp.zeros_like(limb_sums[0])
+            for i in (3, 2, 1, 0):  # least significant first
+                tot = limb_sums[i] + carry
+                out_limbs[i] = tot & 0xFFFFFFFF
+                carry = tot >> 32
+            res = jnp.stack(out_limbs, axis=1)
+            nonempty = _seg_sum(m, nbits=1) > 0
+            out_fields.append(Field(name, a.type, True))
+            out_data.append(res)
+            out_valid.append(nonempty)
         elif agg.fn == "sum":
             out_t = a.type if mode == FINAL else _sum_out_type(a.type)
             d = reorder(jnp.broadcast_to(_to_rep(a, out_t), (cap,)))
@@ -431,6 +461,31 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
             out_fields.append(Field(name, a.type, True, a.dict))
             out_data.append(res)
             out_valid.append(nonempty)
+        elif agg.fn == "array_agg":
+            if not indices_sorted:
+                raise NotImplementedError(
+                    "array_agg requires the sorted aggregation path")
+            # rows are group-contiguous: position within group = row index -
+            # group start; scatter (gid, pos) -> [G, K+1] (unique indices,
+            # TPU-fast); K adapts via the aux overflow check
+            d = reorder(jnp.broadcast_to(jnp.asarray(a.data), (cap,)))
+            left = seg_first_index(gid, num_groups, cap)
+            pos = jnp.arange(cap) - left[jnp.clip(gid, 0, num_groups - 1)]
+            ok = m & (pos >= 0) & (pos < arr_cap)
+            gi = jnp.where(ok, gid, num_groups)
+            pi = jnp.where(ok, pos, 0)
+            mat = jnp.zeros((num_groups + 1, arr_cap + 1), d.dtype)
+            mat = mat.at[gi, 1 + pi].set(d, mode="drop")
+            counts = seg_count(m, gid, num_groups,
+                               sorted_gid=indices_sorted)
+            if aux_checks is not None:
+                aux_checks["array_agg_max"] = jnp.max(
+                    jnp.concatenate([counts, jnp.zeros(1, counts.dtype)]))
+            mat = mat.at[:num_groups, 0].set(
+                jnp.asarray(jnp.minimum(counts, arr_cap), d.dtype))
+            out_fields.append(Field(name, T.ARRAY(a.type), True, a.dict))
+            out_data.append(mat[:num_groups])
+            out_valid.append(counts > 0)
         else:
             raise NotImplementedError(f"aggregate {agg.fn}")
     return out_fields, out_data, out_valid
@@ -442,6 +497,8 @@ def hash_aggregate(
     aggs: tuple,  # tuple[(name, AggExpr)]
     num_groups: int,
     mode: str = COMPLETE,
+    arr_cap: int = 256,
+    aux_checks: dict | None = None,
 ):
     """Returns (output_chunk, true_group_count). Output capacity=num_groups.
 
@@ -453,7 +510,7 @@ def hash_aggregate(
     live = chunk.sel_mask()
     keys = eval_keys(chunk, tuple(e for _, e in group_by))
 
-    lowcard = _try_lowcard(chunk, group_by, keys, live, num_groups, mode)
+    lowcard = _try_lowcard(chunk, group_by, keys, live, num_groups, mode, aggs)
     if lowcard is not None:
         return _aggregate_with_gid(
             chunk, cc, group_by, aggs, num_groups, mode, *lowcard, live=live
@@ -491,7 +548,7 @@ def hash_aggregate(
     # --- aggregate columns ----------------------------------------------------
     agg_fields, agg_data, agg_valid = _emit_agg_columns(
         cc, aggs, mode, cap, live_s, lambda x: x[order], gid, num_groups,
-        indices_sorted=True,
+        indices_sorted=True, arr_cap=arr_cap, aux_checks=aux_checks,
     )
     out_fields += agg_fields
     out_data += agg_data

@@ -37,7 +37,11 @@ def project(chunk: Chunk, exprs, names) -> Chunk:
 
             d, codes = StringDict.from_strings([v.data])
             v = _dc.replace(v, data=jnp.asarray(codes[0]), dict=d)
-        d = jnp.broadcast_to(jnp.asarray(v.data), (chunk.capacity,))
+        vd = jnp.asarray(v.data)
+        if vd.ndim == 2:
+            d = vd  # wide layout (ARRAY/DECIMAL128): already per-row
+        else:
+            d = jnp.broadcast_to(vd, (chunk.capacity,))
         fields.append(Field(name, v.type, v.valid is not None, v.dict,
                             bounds=v.bounds))
         data.append(d)

@@ -1,0 +1,45 @@
+"""Array explosion (the table-function operator).
+
+Reference behavior: be/src/exec/table_func/unnest.cpp — one output row per
+array element, parent columns repeated. Compiled like the run-length
+expansion join: repeat row ids by per-row lengths into a static capacity,
+gather elements by (row, offset); true size returned for the host
+overflow-recompile contract.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..column.column import Chunk, Field, Schema
+from ..exprs.compile import ExprCompiler
+
+
+def unnest_op(chunk: Chunk, expr, out_name: str, out_capacity: int):
+    """Returns (chunk_with_element_column, true_row_count)."""
+    cc = ExprCompiler(chunk)
+    v = cc.eval(expr)
+    if not v.type.is_array:
+        raise TypeError(f"unnest() needs an ARRAY, got {v.type}")
+    d = jnp.asarray(v.data)
+    k = d.shape[1] - 1
+    live = chunk.sel_mask()
+    if v.valid is not None:
+        live = live & v.valid  # NULL arrays contribute no rows
+    counts = jnp.where(live, jnp.asarray(d[:, 0], jnp.int32), 0)
+    total = jnp.sum(counts)
+    rows = jnp.repeat(jnp.arange(chunk.capacity), counts,
+                      total_repeat_length=out_capacity)
+    run_start = jnp.cumsum(counts) - counts
+    offs = jnp.arange(out_capacity) - run_start[rows]
+    elem = d[rows, 1 + jnp.clip(offs, 0, k - 1)]
+    out_live = jnp.arange(out_capacity) < total
+
+    taken = chunk.take(rows)
+    fields = list(taken.schema.fields) + [
+        Field(out_name, v.type.elem, False, v.dict)
+    ]
+    data = list(taken.data) + [elem]
+    valid = list(taken.valid) + [None]
+    sel = out_live if taken.sel is None else (out_live & taken.sel)
+    return Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel), total

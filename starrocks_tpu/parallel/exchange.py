@@ -74,6 +74,13 @@ def shuffle_chunk(
     `pack_keys`'s ok flag is ignored here on purpose (exchange must move
     every live row).
     """
+    for f, d in zip(chunk.schema.fields, chunk.data):
+        if getattr(d, "ndim", 1) > 1:
+            raise NotImplementedError(
+                f"distributed exchange of wide column {f.name!r} "
+                "(ARRAY/DECIMAL128) is not supported yet — these queries "
+                "run single-chip or via broadcast placements")
+
     live = chunk.sel_mask()
     # dead rows -> bucket n (dropped); NULL-key live rows still travel
     keys = eval_keys(chunk, key_exprs)

@@ -139,15 +139,18 @@ class DeviceCache:
         cap = self._caps.setdefault(cap_key, default_cap)
 
         def layout(a, fill):
-            """Host layout: pad (range mode) or bucket-slotted (hash mode)."""
+            """Host layout: pad (range mode) or bucket-slotted (hash mode).
+            Handles rank-2 wide columns (ARRAY/DECIMAL128) row-wise."""
+            tail = a.shape[1:]
             if reorder is None:
                 if len(a) < cap:
                     a = np.concatenate(
-                        [a, np.full(cap - len(a), fill, dtype=a.dtype)]
+                        [a, np.full((cap - len(a),) + tail, fill,
+                                    dtype=a.dtype)]
                     )
                 return a
             shard_cap = cap // n_shards
-            out = np.full(cap, fill, dtype=a.dtype)
+            out = np.full((cap,) + tail, fill, dtype=a.dtype)
             srt = a[reorder]
             off = 0
             for b in range(n_shards):

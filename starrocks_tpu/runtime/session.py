@@ -24,6 +24,18 @@ from .executor import DeviceCache, Executor, QueryResult
 
 
 
+def _fold_lit(x):
+    """Literal value of an INSERT VALUES cell (unary minus folds)."""
+    from ..exprs.ir import Call, Lit
+
+    if isinstance(x, Lit):
+        return x.value
+    if (isinstance(x, Call) and x.fn in ("negate", "negative")
+            and len(x.args) == 1 and isinstance(x.args[0], Lit)):
+        return -x.args[0].value
+    raise ValueError("INSERT VALUES must be literals")
+
+
 def _writable(name: str):
     """Reserve the hidden-table namespace from DML/DDL (e.g. __dual__, the
     constant table behind FROM-less SELECT)."""
@@ -444,9 +456,15 @@ class Session:
         fields, arrays = [], {}
         for c in stmt.columns:
             t = c.type
-            d = StringDict.from_values([]) if t.is_string else None
+            d = StringDict.from_values([]) if (
+                t.is_string or (t.is_array and t.elem.is_string)) else None
             fields.append(Field(c.name, t, c.nullable, d))
-            arrays[c.name] = np.zeros(0, dtype=t.np_dtype)
+            if t.is_array:
+                arrays[c.name] = np.zeros((0, 2), dtype=t.np_dtype)
+            elif t.is_decimal128:
+                arrays[c.name] = np.zeros((0, 4), dtype=np.int64)
+            else:
+                arrays[c.name] = np.zeros(0, dtype=t.np_dtype)
         schema = Schema(tuple(fields))
         # DISTRIBUTED BY HASH is bucketing, NOT a uniqueness guarantee; the
         # PRIMARY KEY clause is one (upsert model enforces it on write)
@@ -567,15 +585,16 @@ class Session:
         cols = stmt.columns or tuple(f.name for f in handle.schema)
         rows = stmt.values
         data = {c: [] for c in cols}
-        from ..exprs.ir import Lit
+        from ..exprs.ir import Call, Lit  # noqa: F401 (fold helper shares)
 
         for row in rows:
             if len(row) != len(cols):
                 raise ValueError("INSERT arity mismatch")
             for c, e in zip(cols, row):
-                if not isinstance(e, Lit):
-                    raise ValueError("INSERT VALUES must be literals")
-                data[c].append(e.value)
+                if isinstance(e, Call) and e.fn == "array":
+                    data[c].append([_fold_lit(x) for x in e.args])
+                    continue
+                data[c].append(_fold_lit(e))
         types = {}
         valids = {}
         out = {}
@@ -600,7 +619,38 @@ def concat_tables(a: HostTable, b: HostTable, target_schema: Schema) -> HostTabl
         fb = b.schema.field(bname)
         aa = a.arrays[name]
         ba = b.arrays[bname]
-        if f.type.is_string:
+        if f.type.is_array:
+            # width-align the two [n, K+1] layouts; remap string elements
+            # through a merged dictionary
+            fa = a.schema.field(name)
+            dct = None
+            if f.type.elem.is_string:
+                da = fa.dict or StringDict.from_values([])
+                db = fb.dict or StringDict.from_values([])
+                dct, ra, rb = da.merge(db)
+
+                def remap(m, lut, dlen):
+                    if not len(m) or not dlen:
+                        return m  # no rows / all-empty arrays: codes unused
+                    body = lut[np.clip(m[:, 1:], 0, dlen - 1)]
+                    body = np.where(
+                        np.arange(m.shape[1] - 1)[None, :]
+                        < m[:, :1], body, 0)
+                    return np.concatenate([m[:, :1], body], axis=1)
+
+                aa = remap(aa, ra, len(da))
+                ba = remap(ba, rb, len(db))
+            k = max(aa.shape[1], ba.shape[1])
+
+            def widen(m):
+                if m.shape[1] < k:
+                    pad = np.zeros((len(m), k - m.shape[1]), m.dtype)
+                    m = np.concatenate([m, pad], axis=1)
+                return m
+
+            aa, ba = widen(aa), widen(ba)
+            fields.append(Field(name, f.type, f.nullable, dct))
+        elif f.type.is_string:
             # remap through each side's ACTUAL dict (the target schema's dict
             # may be the declared empty one for stored tables)
             fa = a.schema.field(name)
@@ -631,11 +681,14 @@ def concat_tables(a: HostTable, b: HostTable, target_schema: Schema) -> HostTabl
 
 
 def _empty_like(schema: Schema) -> HostTable:
-    return HostTable(
-        schema,
-        {f.name: np.zeros(0, dtype=f.type.np_dtype) for f in schema},
-        {},
-    )
+    def empty(f):
+        if f.type.is_array:
+            return np.zeros((0, 2), dtype=f.type.np_dtype)
+        if f.type.is_decimal128:
+            return np.zeros((0, 4), dtype=np.int64)
+        return np.zeros(0, dtype=f.type.np_dtype)
+
+    return HostTable(schema, {f.name: empty(f) for f in schema}, {})
 
 
 def _conform_to_schema(schema: Schema, data: HostTable) -> HostTable:

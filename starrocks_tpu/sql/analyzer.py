@@ -20,8 +20,8 @@ from typing import Optional
 from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit, WindowExpr
 from . import ast
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion, LWindow,
-    LogicalPlan,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion,
+    LUnnest, LWindow, LogicalPlan,
 )
 
 
@@ -378,8 +378,23 @@ class Analyzer:
             else:
                 sub_plan = self._analyze_select(rel.select, outer, ctes)
             return self._aliased_subplan(sub_plan, rel.alias, outer)
+        if isinstance(rel, ast.UnnestRef):
+            raise AnalyzerError(
+                "unnest() must follow a table in the FROM list "
+                "(lateral: FROM t, unnest(t.arr) u(x))")
         if isinstance(rel, ast.JoinRef):
             lplan, lscope = self._analyze_relation(rel.left, outer, ctes)
+            if isinstance(rel.right, ast.UnnestRef):
+                if rel.kind not in ("cross", "inner") or rel.on is not None:
+                    raise AnalyzerError(
+                        "unnest() only combines via comma/CROSS JOIN")
+                u = rel.right
+                e = self._lower(u.expr, lscope, ctes, allow_agg=False)
+                out_name = f"{u.alias}.{u.col}"
+                plan = LUnnest(lplan, e, out_name)
+                scope = Scope(
+                    lscope.entries + [(u.alias, (u.col,))], outer)
+                return plan, scope
             rplan, rscope = self._analyze_relation(rel.right, outer, ctes)
             scope = Scope(lscope.entries + rscope.entries, outer)
             kind = rel.kind

@@ -92,6 +92,13 @@ class SubqueryRef:
 
 
 @dataclasses.dataclass(frozen=True)
+class UnnestRef:
+    expr: object  # raw expression yielding an ARRAY (lateral)
+    alias: str
+    col: str  # output column base name
+
+
+@dataclasses.dataclass(frozen=True)
 class JoinRef:
     left: object
     right: object

@@ -50,8 +50,8 @@ from ..parallel.exchange import (
 from ..parallel.mesh import DATA_AXIS
 from .analyzer import _conjuncts
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion, LWindow,
-    LogicalPlan,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion,
+    LUnnest, LWindow, LogicalPlan,
 )
 from .optimizer import and_all
 from .physical import Caps, PlanError, _equi_pair, _key_bit_width, unique_sets
@@ -251,6 +251,8 @@ def compile_distributed(
                 )
             if isinstance(p, LWindow):
                 return emit_window(p)
+            if isinstance(p, LUnnest):
+                return emit_unnest(p)
             if isinstance(p, LSort):
                 return emit_sort(p)
             if isinstance(p, LLimit):
@@ -328,9 +330,17 @@ def compile_distributed(
             key = f"agg_{ordinal(p)}"
             agg_default = 1024 if p.group_by else 1
             if m == REPLICATED:
+                kwargs = {}
+                if any(a.fn == "array_agg" for _, a in p.aggs):
+                    akey = f"aggarr_{ordinal(p)}"
+                    aux: dict = {}
+                    kwargs = {"arr_cap": caps.get(akey, 256),
+                              "aux_checks": aux}
                 out, ng = hash_aggregate(c, p.group_by, p.aggs,
-                                         caps.get(key, agg_default))
+                                         caps.get(key, agg_default), **kwargs)
                 checks[key] = ng[None]
+                if kwargs:
+                    checks[akey] = aux["array_agg_max"][None]
                 return out, REPLICATED
             final_group_by = tuple((n, Col(n)) for n, _ in p.group_by)
             est = estimated_group_ndv(p, catalog)
@@ -358,9 +368,17 @@ def compile_distributed(
                 # value in one place and the input is not colocated on the
                 # group keys: gather rows, aggregate COMPLETE.
                 gathered = all_gather_chunk(c, axis)
+                kwargs = {}
+                if any(a.fn == "array_agg" for _, a in p.aggs):
+                    akey = f"aggarr_{ordinal(p)}"
+                    aux: dict = {}
+                    kwargs = {"arr_cap": caps.get(akey, 256),
+                              "aux_checks": aux}
                 out, ng = hash_aggregate(gathered, p.group_by, p.aggs,
-                                         caps.get(key, agg_default))
+                                         caps.get(key, agg_default), **kwargs)
                 checks[key] = ng[None]
+                if kwargs:
+                    checks[akey] = aux["array_agg_max"][None]
                 return out, REPLICATED
             if est is not None and est > SHUFFLE_AGG_MIN_GROUPS:
                 # high cardinality: shuffle partial states by group key so
@@ -402,6 +420,16 @@ def compile_distributed(
             # both partial and final counts must fit the capacity
             checks[key] = jnp.maximum(png, ng)[None]
             return out, REPLICATED
+
+        def emit_unnest(p: LUnnest):
+            from ..ops.unnest import unnest_op
+
+            c, m = emit(p.child)
+            key = f"unnest_{ordinal(p)}"
+            cap = caps.get(key, pad_capacity(c.capacity * 4))
+            out, total = unnest_op(c, p.expr, p.out_name, cap)
+            checks[key] = total[None]
+            return out, m
 
         def emit_join(p: LJoin):
             lc, lm = emit(p.left)

@@ -185,6 +185,28 @@ def plan_tree_str(p: LogicalPlan, indent: int = 0) -> str:
     return s
 
 
+@dataclasses.dataclass(frozen=True)
+class LUnnest(LogicalPlan):
+    """Lateral array explosion: one output row per element of `expr`
+    evaluated against each child row (reference: table functions,
+    fe sql/.../TableFunctionRelation + be/src/exec/table_func; here the
+    expansion compiles like a run-length join)."""
+
+    child: LogicalPlan
+    expr: object  # Expr producing an ARRAY
+    out_name: str  # qualified output column (alias.col)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def output_names(self):
+        return self.child.output_names() + (self.out_name,)
+
+    def __repr__(self):
+        return f"Unnest[{self.out_name}]"
+
+
 def walk_plan(p: LogicalPlan):
     yield p
     for c in p.children:

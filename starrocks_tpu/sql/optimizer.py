@@ -26,8 +26,8 @@ import dataclasses
 from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit
 from .analyzer import ScalarSubquery, SemiJoinMark, _conjuncts
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion, LWindow,
-    LogicalPlan,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion,
+    LUnnest, LWindow, LogicalPlan,
 )
 
 
@@ -426,6 +426,14 @@ def _push(plan: LogicalPlan, preds: list) -> LogicalPlan:
             LWindow(child, plan.partition_by, plan.order_by, plan.funcs), preds
         )
 
+    if isinstance(plan, LUnnest):
+        ccols = frozenset(plan.child.output_names())
+        down = [p for p in preds
+                if not _has_marker(p) and expr_cols(p) <= ccols]
+        stay = [p for p in preds if p not in down]
+        child = _push(plan.child, down)
+        return _wrap(LUnnest(child, plan.expr, plan.out_name), stay)
+
     if isinstance(plan, LUnion):
         # a filter over a union pushes into every input (same output names)
         pushable = [p for p in preds if not _has_marker(p)]
@@ -512,6 +520,8 @@ def _replace_children(plan, new_children):
         return LSort(new_children[0], plan.keys, plan.limit)
     if isinstance(plan, LLimit):
         return LLimit(new_children[0], plan.limit, plan.offset)
+    if isinstance(plan, LUnnest):
+        return LUnnest(new_children[0], plan.expr, plan.out_name)
     if isinstance(plan, LScan):
         return plan
     raise TypeError(type(plan))
@@ -888,6 +898,8 @@ def estimate_rows(plan: LogicalPlan, catalog) -> float:
         return max(l, r)
     if isinstance(plan, (LSort, LLimit, LWindow)):
         return estimate_rows(plan.child, catalog)
+    if isinstance(plan, LUnnest):
+        return 4.0 * estimate_rows(plan.child, catalog)
     if isinstance(plan, LUnion):
         return sum(estimate_rows(c, catalog) for c in plan.inputs)
     return 1000.0
@@ -1220,6 +1232,11 @@ def prune_columns(plan: LogicalPlan, required: frozenset | None = None) -> Logic
             prune_columns(plan.child, frozenset(need)),
             plan.partition_by, plan.order_by, plan.funcs,
         )
+
+    if isinstance(plan, LUnnest):
+        need = (required - {plan.out_name}) | expr_cols(plan.expr)
+        return LUnnest(prune_columns(plan.child, frozenset(need)),
+                       plan.expr, plan.out_name)
 
     if isinstance(plan, LSort):
         need = set(required)

@@ -19,10 +19,23 @@ class ParseError(ValueError):
     pass
 
 
+def _num_lit(text: str):
+    """Non-integer numeric literal value: float unless the digits exceed
+    float64's exact range — then decimal.Decimal (DECIMAL(38) literals must
+    survive parsing losslessly)."""
+    digits = sum(ch.isdigit() for ch in text)
+    if digits <= 15 or "e" in text.lower():
+        return float(text)
+    import decimal
+
+    return decimal.Decimal(text)
+
+
 AGG_FUNCS = {"sum", "count", "avg", "min", "max",
              "stddev_pop", "stddev_samp", "var_pop", "var_samp",
              "covar_pop", "covar_samp", "corr",
-             "percentile_cont", "percentile_disc", "group_concat"}
+             "percentile_cont", "percentile_disc", "group_concat",
+             "array_agg"}
 # aliases resolving to a canonical aggregate (MySQL/reference naming:
 # std/stddev/variance are population forms; any_value picks an arbitrary
 # row — min is a valid choice; ndv/approx_count_distinct answer exactly here)
@@ -152,7 +165,7 @@ class Parser:
             if t.kind == "string":
                 val = t.value
             elif t.kind == "number":
-                val = float(t.value) if "." in t.value else int(t.value)
+                val = _num_lit(t.value) if "." in t.value else int(t.value)
             elif t.kind == "kw" and t.value in ("true", "false"):
                 val = t.value == "true"
             else:
@@ -471,6 +484,20 @@ class Parser:
             refs = self.parse_table_refs()
             self.expect_op(")")
             return refs
+        if (self.peek().kind == "ident" and self.peek().value.lower() == "unnest"
+                and self.peek(1).kind == "op" and self.peek(1).value == "("):
+            self.next()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_op(")")
+            self.accept_kw("as")
+            alias = (self.next().value
+                     if self.peek().kind == "ident" else "unnest")
+            col = "unnest"
+            if self.accept_op("("):
+                col = self.expect_ident()
+                self.expect_op(")")
+            return ast.UnnestRef(e, alias, col)
         name = self.parse_table_name()
         alias = None
         if self.accept_kw("as"):
@@ -556,7 +583,9 @@ class Parser:
             return t.value
         if t.kind == "number":
             self.next()
-            return float(t.value) if "." in t.value or "e" in t.value.lower() else int(t.value)
+            return (_num_lit(t.value)
+                    if "." in t.value or "e" in t.value.lower()
+                    else int(t.value))
         if t.kind == "kw" and t.value == "null":
             self.next()
             return None
@@ -619,7 +648,9 @@ class Parser:
         t = self.peek()
         if t.kind == "number":
             self.next()
-            v = float(t.value) if "." in t.value or "e" in t.value.lower() else int(t.value)
+            v = (_num_lit(t.value)
+                 if "." in t.value or "e" in t.value.lower()
+                 else int(t.value))
             return Lit(v)
         if t.kind == "string":
             self.next()
@@ -900,6 +931,12 @@ class Parser:
 
     def parse_type_name(self) -> T.LogicalType:
         name = self.next().value.lower()
+        if name == "array":
+            # ARRAY<elem>
+            self.expect_op("<")
+            elem = self.parse_type_name()
+            self.expect_op(">")
+            return T.ARRAY(elem)
         if name in ("int", "integer"):
             return T.INT
         if name == "bigint":

@@ -31,8 +31,8 @@ from ..ops.window import window_op
 from ..column.column import pad_capacity
 from .analyzer import _conjuncts
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion, LWindow,
-    LogicalPlan,
+    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion,
+    LUnnest, LWindow, LogicalPlan,
 )
 from .optimizer import and_all, col_origin, estimate_rows, expr_cols
 
@@ -298,11 +298,28 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
                     # sort-free packed-gid path applies at any cardinality
                     default = max(default, dom)
                 cap = caps.get(key, default)
-                out, ng = hash_aggregate(c, p.group_by, p.aggs, cap)
+                kwargs = {}
+                if any(a.fn == "array_agg" for _, a in p.aggs):
+                    akey = f"aggarr_{ordinal(p)}"
+                    aux: dict = {}
+                    kwargs = {"arr_cap": caps.get(akey, 256),
+                              "aux_checks": aux}
+                out, ng = hash_aggregate(c, p.group_by, p.aggs, cap, **kwargs)
                 checks[key] = ng
+                if kwargs:
+                    checks[akey] = aux["array_agg_max"]
                 return out
             if isinstance(p, LJoin):
                 return emit_join(p)
+            if isinstance(p, LUnnest):
+                from ..ops.unnest import unnest_op
+
+                c = emit(p.child)
+                key = f"unnest_{ordinal(p)}"
+                cap = caps.get(key, pad_capacity(c.capacity * 4))
+                out, total = unnest_op(c, p.expr, p.out_name, cap)
+                checks[key] = total
+                return out
             raise PlanError(f"cannot compile {type(p).__name__}")
 
         def emit_join(p: LJoin):

@@ -29,11 +29,16 @@ from ..exprs.ir import Call, Col, Expr, InList, Lit
 
 
 def _type_to_json(t: T.LogicalType) -> dict:
-    return {"kind": t.kind.value, "precision": t.precision, "scale": t.scale}
+    out = {"kind": t.kind.value, "precision": t.precision, "scale": t.scale}
+    if t.elem is not None:
+        out["elem"] = _type_to_json(t.elem)
+    return out
 
 
 def _type_from_json(d: dict) -> T.LogicalType:
-    return T.LogicalType(T.TypeKind(d["kind"]), d.get("precision"), d.get("scale"))
+    elem = _type_from_json(d["elem"]) if d.get("elem") else None
+    return T.LogicalType(T.TypeKind(d["kind"]), d.get("precision"),
+                         d.get("scale"), elem)
 
 
 def schema_to_json(schema: Schema) -> list:
@@ -511,13 +516,19 @@ class TabletStore:
             "files": total, "pruned": pruned, "partition_pruned": part_pruned,
         }
         if not chosen:
-            # empty table with correct schema
+            # empty table with correct schema (wide layouts keep rank 2)
             sub = schema if columns is None else Schema(
                 tuple(schema.field(c) for c in columns)
             )
-            return HostTable(
-                sub, {f.name: np.zeros(0, dtype=f.type.np_dtype) for f in sub}, {}
-            )
+
+            def empty(f):
+                if f.type.is_array:
+                    return np.zeros((0, 2), dtype=f.type.np_dtype)
+                if f.type.is_decimal128:
+                    return np.zeros((0, 4), dtype=np.int64)
+                return np.zeros(0, dtype=f.type.np_dtype)
+
+            return HostTable(sub, {f.name: empty(f) for f in sub}, {})
         import pyarrow as pa
 
         tables = []
@@ -546,7 +557,35 @@ def _to_arrow(data: HostTable):
         a = data.arrays[f.name]
         v = data.valids.get(f.name)
         mask = None if v is None else ~v
-        if f.type.is_string and f.dict is not None:
+        if f.type.is_array:
+            et = f.type.elem
+            lists = []
+            for r in range(len(a)):
+                if v is not None and not v[r]:
+                    lists.append(None)
+                    continue
+                ln = int(a[r, 0])
+                ev = a[r, 1:1 + ln]
+                if et.is_string and f.dict is not None:
+                    lists.append([str(f.dict.values[int(c)]) for c in ev])
+                else:
+                    lists.append(ev.tolist())
+            pt = pa.string() if et.is_string else pa.from_numpy_dtype(
+                et.np_dtype)
+            arrays.append(pa.array(lists, type=pa.list_(pt)))
+        elif f.type.is_decimal128:
+            import decimal as _dec
+
+            from ..column.host_table import _dec128_to_int
+
+            ctx = _dec.Context(prec=60)  # default ctx rounds to 28 digits
+            vals = [None if (v is not None and not v[r])
+                    else _dec.Decimal(_dec128_to_int(a[r])).scaleb(
+                        -f.type.scale, ctx)
+                    for r in range(len(a))]
+            arrays.append(pa.array(
+                vals, type=pa.decimal128(f.type.precision, f.type.scale)))
+        elif f.type.is_string and f.dict is not None:
             vals = f.dict.decode(a)
             arrays.append(pa.array(vals.tolist(), type=pa.string(),
                                    mask=mask))
@@ -568,7 +607,10 @@ def _conform(ht: HostTable, schema: Schema, columns) -> HostTable:
     for f in fields:
         got = ht.schema.field(f.name)
         a = ht.arrays[f.name]
-        if f.type.is_string:
+        if f.type.is_array:
+            # arrays rebuilt by from_arrow already carry the right layout
+            out_fields.append(Field(f.name, f.type, f.nullable, got.dict))
+        elif f.type.is_string:
             out_fields.append(Field(f.name, f.type, f.nullable, got.dict))
         else:
             # decimals were stored as raw scaled int64; keep as-is

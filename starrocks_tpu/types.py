@@ -40,6 +40,8 @@ class TypeKind(Enum):
     DATE = "date"
     DATETIME = "datetime"
     VARCHAR = "varchar"
+    ARRAY = "array"  # elem type in LogicalType.elem; 2-D device layout
+    DECIMAL128 = "decimal128"  # 4x32-bit limb device layout
     NULL = "null"  # type of a bare NULL literal
 
 
@@ -82,27 +84,53 @@ class LogicalType:
     """A SQL-level type. Hashable and comparable so it can be jit-static."""
 
     kind: TypeKind
-    precision: int | None = None  # DECIMAL only
-    scale: int | None = None  # DECIMAL only
+    precision: int | None = None  # DECIMAL/DECIMAL128 only
+    scale: int | None = None  # DECIMAL/DECIMAL128 only
+    elem: "LogicalType | None" = None  # ARRAY only
 
     def __post_init__(self):
         if self.kind is TypeKind.DECIMAL:
             p = self.precision if self.precision is not None else 18
             s = self.scale if self.scale is not None else 0
             if p > 18:
-                raise NotImplementedError(
-                    f"DECIMAL({p},{s}): precision > 18 not supported yet"
-                )
+                # wide decimals promote to the 128-bit limb layout
+                object.__setattr__(self, "kind", TypeKind.DECIMAL128)
+                if p > 38:
+                    raise NotImplementedError(
+                        f"DECIMAL({p},{s}): precision > 38 not supported")
             object.__setattr__(self, "precision", p)
             object.__setattr__(self, "scale", s)
+        elif self.kind is TypeKind.DECIMAL128:
+            p = self.precision if self.precision is not None else 38
+            sc = self.scale if self.scale is not None else 0
+            if p > 38:
+                raise NotImplementedError(
+                    f"DECIMAL({p},{sc}): precision > 38 not supported")
+            object.__setattr__(self, "precision", p)
+            object.__setattr__(self, "scale", sc)
+        elif self.kind is TypeKind.ARRAY:
+            if self.elem is None:
+                raise ValueError("ARRAY needs an element type")
+            if self.elem.kind in (TypeKind.ARRAY, TypeKind.BOOLEAN,
+                                  TypeKind.DECIMAL128):
+                raise NotImplementedError(
+                    f"ARRAY<{self.elem}> not supported")
 
     # --- device/host dtypes -------------------------------------------------
     @property
     def dtype(self):
+        if self.kind is TypeKind.ARRAY:
+            return self.elem.dtype
+        if self.kind is TypeKind.DECIMAL128:
+            return jnp.int64
         return _DTYPES[self.kind]
 
     @property
     def np_dtype(self):
+        if self.kind is TypeKind.ARRAY:
+            return self.elem.np_dtype
+        if self.kind is TypeKind.DECIMAL128:
+            return np.int64
         return _NP_DTYPES[self.kind]
 
     # --- classification -----------------------------------------------------
@@ -123,6 +151,19 @@ class LogicalType:
         return self.kind is TypeKind.DECIMAL
 
     @property
+    def is_decimal128(self) -> bool:
+        return self.kind is TypeKind.DECIMAL128
+
+    @property
+    def is_array(self) -> bool:
+        return self.kind is TypeKind.ARRAY
+
+    @property
+    def is_wide(self) -> bool:
+        """2-D device layout (ARRAY values+length / DECIMAL128 limbs)."""
+        return self.kind in (TypeKind.ARRAY, TypeKind.DECIMAL128)
+
+    @property
     def is_string(self) -> bool:
         return self.kind is TypeKind.VARCHAR
 
@@ -131,8 +172,10 @@ class LogicalType:
         return self.kind in (TypeKind.DATE, TypeKind.DATETIME)
 
     def __repr__(self):
-        if self.kind is TypeKind.DECIMAL:
+        if self.kind in (TypeKind.DECIMAL, TypeKind.DECIMAL128):
             return f"DECIMAL({self.precision},{self.scale})"
+        if self.kind is TypeKind.ARRAY:
+            return f"ARRAY<{self.elem!r}>"
         return self.kind.name
 
 
@@ -152,6 +195,10 @@ NULLTYPE = LogicalType(TypeKind.NULL)
 
 def DECIMAL(precision: int = 18, scale: int = 0) -> LogicalType:
     return LogicalType(TypeKind.DECIMAL, precision, scale)
+
+
+def ARRAY(elem: LogicalType) -> LogicalType:
+    return LogicalType(TypeKind.ARRAY, elem=elem)
 
 
 # --- type promotion ---------------------------------------------------------

@@ -1,0 +1,160 @@
+"""ARRAY type + DECIMAL128 differential tests.
+
+Layouts under test (re-design of be/src/column/array_column.h offsets+values
+and be/src/types/logical_type.h DECIMAL128): arrays as [cap, K+1] wide
+columns (length prefix), decimal128 as [cap, 4] 32-bit limb matrices.
+"""
+
+import random
+
+import pytest
+
+from starrocks_tpu import types as T
+from starrocks_tpu.column import HostTable
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.storage.catalog import Catalog
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session()
+    s.sql("CREATE TABLE t (id BIGINT, nums ARRAY<BIGINT>, "
+          "tags ARRAY<VARCHAR>, txt VARCHAR)")
+    s.sql("INSERT INTO t VALUES "
+          "(1, array(3, 1, 2, 1), array('x', 'y'), 'a,b,c'),"
+          "(2, array(9), array('z'), 'solo'),"
+          "(3, array(5, 5), array('y', 'x', 'y'), 'p,,q')")
+    return s
+
+
+def test_array_functions(sess):
+    r = sess.sql("SELECT array_length(nums), element_at(nums, 2), "
+                 "array_contains(nums, 1), array_position(tags, 'y') "
+                 "FROM t ORDER BY id").rows()
+    assert r == [(4, 1, True, 2), (1, None, False, 0), (2, 5, False, 1)]
+    r = sess.sql("SELECT array_sum(nums), array_avg(nums), array_min(nums),"
+                 " array_max(nums) FROM t ORDER BY id").rows()
+    assert r == [(7, 1.75, 1, 3), (9, 9.0, 9, 9), (10, 5.0, 5, 5)]
+    r = sess.sql("SELECT array_sort(nums), array_distinct(nums), "
+                 "array_sort(tags) FROM t ORDER BY id").rows()
+    assert r[0] == ([1, 1, 2, 3], [1, 2, 3], ["x", "y"])
+    assert r[2] == ([5, 5], [5], ["x", "y", "y"])
+    r = sess.sql("SELECT split(txt, ',') FROM t ORDER BY id").rows()
+    assert r == [(["a", "b", "c"],), (["solo"],), (["p", "", "q"],)]
+
+
+def test_unnest(sess):
+    r = sess.sql("SELECT id, x FROM t, unnest(nums) u(x) "
+                 "ORDER BY id, x").rows()
+    assert r == [(1, 1), (1, 1), (1, 2), (1, 3), (2, 9), (3, 5), (3, 5)]
+    r = sess.sql("SELECT tag, count(*) c FROM t, unnest(tags) u(tag) "
+                 "GROUP BY tag ORDER BY tag").rows()
+    assert r == [("x", 2), ("y", 3), ("z", 1)]
+    # filter above unnest on the element
+    r = sess.sql("SELECT sum(x) FROM t, unnest(nums) u(x) WHERE x > 2").rows()
+    assert r == [(3 + 9 + 5 + 5,)]
+
+
+def test_array_agg_roundtrip(sess):
+    r = sess.sql("SELECT id, array_sort(array_agg(x)) FROM t, "
+                 "unnest(nums) u(x) GROUP BY id ORDER BY id").rows()
+    assert r == [(1, [1, 1, 2, 3]), (2, [9]), (3, [5, 5])]
+
+
+def test_array_agg_capacity_overflow():
+    """Groups larger than the default 256-element array capacity must
+    trigger the adaptive recompile, not truncate."""
+    s = Session()
+    s.sql("CREATE TABLE big (g BIGINT, v BIGINT)")
+    rows = ", ".join(f"({i % 2}, {i})" for i in range(700))
+    s.sql(f"INSERT INTO big VALUES {rows}")
+    r = s.sql("SELECT g, array_length(array_agg(v)) FROM big "
+              "GROUP BY g ORDER BY g").rows()
+    assert r == [(0, 350), (1, 350)]
+
+
+def test_array_storage_roundtrip(tmp_path):
+    s = Session(data_dir=str(tmp_path))
+    s.sql("CREATE TABLE at (id BIGINT, a ARRAY<INT>, s ARRAY<VARCHAR>)")
+    s.sql("INSERT INTO at VALUES (1, array(1, 2), array('p', 'q')),"
+          "(2, array(7), array('r'))")
+    s2 = Session(data_dir=str(tmp_path))  # parquet + manifest replay
+    r = s2.sql("SELECT id, a, s FROM at ORDER BY id").rows()
+    assert r == [(1, [1, 2], ["p", "q"]), (2, [7], ["r"])]
+    r = s2.sql("SELECT id, x FROM at, unnest(s) u(x) ORDER BY id, x").rows()
+    assert r == [(1, "p"), (1, "q"), (2, "r")]
+
+
+def test_decimal128_exact_aggregation():
+    random.seed(7)
+    vals = [random.randint(-10**30, 10**30) for _ in range(1000)]
+    gs = [i % 4 for i in range(1000)]
+    cat = Catalog()
+    cat.register("d", HostTable.from_pydict(
+        {"g": gs, "v": vals}, types={"v": T.DECIMAL(38, 0)}))
+    s = Session(cat)
+    r = s.sql("SELECT g, sum(v), count(v) FROM d GROUP BY g ORDER BY g").rows()
+    for g, sd, c in r:
+        exp = sum(v for v, gg in zip(vals, gs) if gg == g)
+        assert int(sd) == exp  # exact 128-bit sums vs python ints
+        assert c == 250
+    # global aggregation too
+    r = s.sql("SELECT sum(v) FROM d").rows()
+    assert int(r[0][0]) == sum(vals)
+
+
+def test_decimal128_scale_and_storage(tmp_path):
+    s = Session(data_dir=str(tmp_path))
+    s.sql("CREATE TABLE m (id BIGINT, amt DECIMAL(38, 4))")
+    s.sql("INSERT INTO m VALUES (1, 123456789012345678901234.5678),"
+          "(2, -0.0001), (3, 99)")
+    s2 = Session(data_dir=str(tmp_path))
+    import decimal
+
+    r = s2.sql("SELECT id, amt FROM m ORDER BY id").rows()
+    assert r[0][1] == decimal.Decimal("123456789012345678901234.5678")
+    assert r[1][1] == decimal.Decimal("-0.0001")
+    assert r[2][1] == decimal.Decimal("99")
+    r = s2.sql("SELECT sum(amt) FROM m").rows()
+    assert r[0][0] == decimal.Decimal("123456789012345678901233.5677") + \
+        decimal.Decimal("99") + decimal.Decimal("1")
+
+
+def test_review_regressions():
+    import decimal
+
+    s = Session()
+    # NULL array rows with empty dictionaries must concat cleanly
+    s.sql("CREATE TABLE n (a ARRAY<VARCHAR>)")
+    s.sql("INSERT INTO n VALUES (NULL)")
+    s.sql("INSERT INTO n VALUES (array('k'))")
+    assert s.sql("SELECT a FROM n").rows() == [(None,), (["k"],)]
+    # array() promotes mixed numerics and merges string dictionaries
+    s.sql("CREATE TABLE p (x BIGINT, s1 VARCHAR, s2 VARCHAR)")
+    s.sql("INSERT INTO p VALUES (1, 'aa', 'bb')")
+    r = s.sql("SELECT array(x, 2.5) m, array(s1, s2, 'cc') st FROM p").rows()
+    assert r == [([1.0, 2.5], ["aa", "bb", "cc"])]
+    # half-even rounding matches the narrow-decimal path
+    s.sql("CREATE TABLE rr (d DECIMAL(38, 2))")
+    s.sql("INSERT INTO rr VALUES (1.006)")
+    assert s.sql("SELECT d FROM rr").rows() == [(decimal.Decimal("1.01"),)]
+    # unsupported dec128 operations fail loudly, not with trace errors
+    import pytest as _pt
+
+    with _pt.raises(Exception, match="DECIMAL"):
+        s.sql("SELECT min(d) FROM rr")
+    with _pt.raises(Exception, match="not supported"):
+        s.sql("SELECT count(*) FROM rr WHERE d > 1")
+
+
+def test_dec128_storage_precision(tmp_path):
+    """38-digit values survive the parquet flush bit-exactly (regression:
+    default decimal context rounded to 28 digits at _to_arrow)."""
+    import decimal
+
+    s = Session(data_dir=str(tmp_path))
+    s.sql("CREATE TABLE w (d DECIMAL(38, 2))")
+    s.sql("INSERT INTO w VALUES (123456789012345678901234567890123456.78)")
+    s2 = Session(data_dir=str(tmp_path))
+    assert s2.sql("SELECT d FROM w").rows() == [
+        (decimal.Decimal("123456789012345678901234567890123456.78"),)]

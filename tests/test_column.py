@@ -33,8 +33,10 @@ def test_logical_types():
     assert T.common_numeric_type(T.INT, T.DOUBLE) == T.DOUBLE
     assert T.common_numeric_type(T.DECIMAL(15, 2), T.DECIMAL(15, 4)).scale == 4
     assert T.common_numeric_type(T.DECIMAL(15, 2), T.INT).is_decimal
+    # precision > 18 promotes to the 128-bit limb layout
+    assert T.DECIMAL(38, 10).is_decimal128
     with pytest.raises(NotImplementedError):
-        T.DECIMAL(38, 10)
+        T.DECIMAL(39, 10)
 
 
 def test_string_dict_roundtrip():
