@@ -37,6 +37,21 @@ from ..sql.logical import (
 GROUP_CAP_KEY = "batched_agg"
 
 
+def _apply_top_chain(c, chain):
+    """Interpret the (Project/Sort/Limit/Filter)* nodes above the merge."""
+    for node in reversed(chain):
+        if isinstance(node, LFilter):
+            c = filter_chunk(c, node.predicate)
+        elif isinstance(node, LProject):
+            c = project(c, [e for _, e in node.exprs],
+                        [n for n, _ in node.exprs])
+        elif isinstance(node, LSort):
+            c = sort_chunk(c, node.keys, node.limit)
+        else:
+            c = limit_chunk(c, node.limit, node.offset)
+    return c
+
+
 @dataclasses.dataclass
 class BatchablePlan:
     top_chain: list  # nodes above the aggregate, outermost first
@@ -90,17 +105,7 @@ def make_programs(bp: BatchablePlan, group_cap: int):
             m, final_group_by, final_agg_exprs(bp.agg.aggs), group_cap,
             mode=FINAL,
         )
-        c = out
-        for node in reversed(bp.top_chain):
-            if isinstance(node, LFilter):
-                c = filter_chunk(c, node.predicate)
-            elif isinstance(node, LProject):
-                c = project(c, [e for _, e in node.exprs], [n for n, _ in node.exprs])
-            elif isinstance(node, LSort):
-                c = sort_chunk(c, node.keys, node.limit)
-            else:
-                c = limit_chunk(c, node.limit, node.offset)
-        return c, ng
+        return _apply_top_chain(out, bp.top_chain), ng
 
     return jax.jit(partial_program), jax.jit(final_program)
 
@@ -153,3 +158,230 @@ def execute_batched(
     out, ng = jfinal(merged)
     max_ng = max(max_ng, int(ng))
     return out, [(GROUP_CAP_KEY, max_ng)]
+
+
+# --- Grace join: host-partitioned streaming for joins beyond HBM -------------
+
+
+@dataclasses.dataclass
+class GraceJoinPlan:
+    top_chain: list  # nodes above agg (or above join when agg is None)
+    agg: LAggregate | None
+    mid_chain: list  # Filter/Project between agg and join
+    join: "object"  # LJoin
+    left_chain: list  # Filter/Project between join and left scan
+    left_scan: LScan
+    right_chain: list
+    right_scan: LScan
+    probe_key: str  # base column on the left table
+    build_key: str  # base column on the right table
+
+
+def match_grace_join(plan: LogicalPlan, catalog):
+    """Top (Project/Sort/Limit/Filter)* -> [decomposable LAggregate] ->
+    (Filter/Project)* -> LJoin(inner/left/semi/anti, single INT equi key) ->
+    (Filter/Project)* -> LScan on both sides. The single integer key is what
+    lets the host co-partition both inputs with the native splitmix64
+    bucketing (the Grace hash-partition analog of
+    be/src/compute_env/spill/spiller.h:161)."""
+    from ..sql.logical import LJoin
+    from ..sql.optimizer import col_origin
+    from ..sql.physical import _equi_pair
+    from ..sql.analyzer import _conjuncts
+
+    top = []
+    node = plan
+    while isinstance(node, (LProject, LSort, LLimit, LFilter)):
+        top.append(node)
+        node = node.child
+    agg = None
+    if isinstance(node, LAggregate):
+        if not decomposable(node.aggs):
+            return None
+        agg = node
+        node = node.child
+    mid = []
+    while isinstance(node, (LFilter, LProject)):
+        mid.append(node)
+        node = node.child
+    if not isinstance(node, LJoin) or node.kind not in (
+        "inner", "left", "semi", "anti"
+    ):
+        return None
+    join = node
+    if agg is None and top:
+        # without a decomposable agg the per-partition outputs concat at
+        # full join width; only allow trivial tops then
+        if any(isinstance(t, LFilter) for t in top):
+            return None
+
+    def scan_of(n):
+        chain = []
+        while isinstance(n, (LFilter, LProject)):
+            chain.append(n)
+            n = n.child
+        return (chain, n) if isinstance(n, LScan) else (None, None)
+
+    lchain, lscan = scan_of(join.left)
+    rchain, rscan = scan_of(join.right)
+    if lscan is None or rscan is None:
+        return None
+    lcols = frozenset(join.left.output_names())
+    rcols = frozenset(join.right.output_names())
+    pairs = []
+    for c in (_conjuncts(join.condition) if join.condition is not None else []):
+        pair = _equi_pair(c, lcols, rcols)
+        if pair is not None:
+            pairs.append(pair)
+    if len(pairs) != 1:
+        return None
+    pk, bk = pairs[0]
+    from ..exprs.ir import Col as _Col
+
+    if not (isinstance(pk, _Col) and isinstance(bk, _Col)):
+        return None
+    po = col_origin(join.left, pk.name)
+    bo = col_origin(join.right, bk.name)
+    if po is None or bo is None:
+        return None
+    for origin, scan in ((po, lscan), (bo, rscan)):
+        t = catalog.get_table(origin[0])
+        if t is None:
+            return None
+        f = t.schema.field(origin[1])
+        if not (f.type.is_integer or f.type.is_temporal):
+            return None  # host partitioner needs int64-able keys
+    return GraceJoinPlan(top, agg, mid, join, lchain, lscan, rchain, rscan,
+                         po[1], bo[1])
+
+
+GRACE_GROUP_KEY = "grace_agg"
+
+
+def _grace_part_plan(gp: GraceJoinPlan):
+    """The per-partition JOIN plan (no aggregate: groups span partitions, so
+    aggregation runs PARTIAL per partition and FINAL over the merge — the
+    same decomposition as the scan-agg streaming path)."""
+    return _rebuild_chain(gp.mid_chain, gp.join)
+
+
+def _rebuild_chain(chain, leaf):
+    node = leaf
+    for n in reversed(chain):
+        node = dataclasses.replace(n, child=node)
+    return node
+
+
+def grace_partitions(gp: GraceJoinPlan, catalog, batch_rows: int):
+    """Host co-partitioning of both inputs by the join key (independent of
+    capacities — computed ONCE per query, not per adaptive attempt)."""
+    import numpy as np
+
+    from ..native import hash_partition_i64
+
+    lht = catalog.get_table(gp.left_scan.table).table
+    rht = catalog.get_table(gp.right_scan.table).table
+    n_parts = max(1, -(-max(lht.num_rows, rht.num_rows) // batch_rows))
+
+    def split(ht, key):
+        bucket = hash_partition_i64(
+            np.asarray(ht.arrays[key], dtype=np.int64), n_parts)
+        order = np.argsort(bucket, kind="stable")
+        counts = np.bincount(bucket, minlength=n_parts)
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        return order, offs
+
+    lorder, loffs = split(lht, gp.probe_key)
+    rorder, roffs = split(rht, gp.build_key)
+    lcap = pad_capacity(max(int(np.diff(loffs).max()), 1))
+    rcap = pad_capacity(max(int(np.diff(roffs).max()), 1))
+    return (lht, rht, n_parts, lorder, loffs, rorder, roffs, lcap, rcap)
+
+
+def execute_grace_join(
+    gp: GraceJoinPlan, catalog, caps, profile_node, parts,
+    programs_cache: dict, executor,
+):
+    """One adaptive attempt: stream each host partition pair through one
+    compiled partition program (join [+ PARTIAL agg]), then merge (FINAL
+    agg) and run the top chain."""
+    from ..sql.physical import compile_plan
+
+    lht, rht, n_parts, lorder, loffs, rorder, roffs, lcap, rcap = parts
+    profile_node.set_info("grace_partitions", n_parts)
+
+    part_plan = _grace_part_plan(gp)
+
+    def part_chunk(ht, scan, order, offs, p, cap):
+        alias, cols = scan.alias, scan.columns
+        idx = order[offs[p]:offs[p + 1]]
+        arrays = {f"{alias}.{c}": ht.arrays[c][idx] for c in cols}
+        valids = {f"{alias}.{c}": ht.valids[c][idx]
+                  for c in cols if c in ht.valids}
+        fields = tuple(
+            dataclasses.replace(ht.schema.field(c), name=f"{alias}.{c}")
+            for c in cols
+        )
+        return chunk_from_arrays(Schema(fields), arrays, valids, len(idx),
+                                 capacity=cap)
+
+    # compile once per (plan, caps, partition capacities)
+    pgkey = GRACE_GROUP_KEY + "_partial"
+    pgcap = caps.get(pgkey, 4096) if gp.agg is not None else 0
+    prog_key = (part_plan, tuple(sorted(caps.values.items())), lcap, rcap)
+    if prog_key not in programs_cache:
+        compiled = compile_plan(part_plan, catalog, caps)
+
+        def run_part(inputs, _fn=compiled.fn):
+            c, checks = _fn(inputs)
+            if gp.agg is not None:
+                out, ng = hash_aggregate(
+                    c, gp.agg.group_by, gp.agg.aggs, pgcap, mode=PARTIAL)
+                checks = dict(checks)
+                checks[pgkey] = ng
+                return out, checks
+            return c, checks
+
+        programs_cache[prog_key] = (jax.jit(run_part), compiled.scans)
+    jpart, scans = programs_cache[prog_key]
+
+    outs = []
+    checks_max: dict = {}
+    for p in range(n_parts):
+        inputs = []
+        for table, alias, cols in scans:
+            if alias == gp.left_scan.alias:
+                inputs.append(part_chunk(lht, gp.left_scan, lorder, loffs,
+                                         p, lcap))
+            elif alias == gp.right_scan.alias:
+                inputs.append(part_chunk(rht, gp.right_scan, rorder, roffs,
+                                         p, rcap))
+            else:  # replicated small side inside chains (not expected)
+                inputs.append(executor.cache.chunk_for(
+                    catalog.get_table(table), alias, cols))
+        out, checks = jpart(inputs)
+        outs.append(out)
+        for k, v in checks.items():
+            checks_max[k] = max(checks_max.get(k, 0), int(v))
+
+    if gp.agg is not None:
+        merged = concat_many(outs)
+        final_group_by = tuple((n, Col(n)) for n, _ in gp.agg.group_by)
+        gkey = GRACE_GROUP_KEY
+        gcap = caps.get(gkey, 4096)
+
+        def final_fn(m):
+            out, ng = hash_aggregate(
+                m, final_group_by, final_agg_exprs(gp.agg.aggs), gcap,
+                mode=FINAL)
+            return _apply_top_chain(out, gp.top_chain), ng
+
+        fkey = ("grace_final", tuple(gp.top_chain), gp.agg, gcap,
+                merged.capacity)
+        if fkey not in programs_cache:
+            programs_cache[fkey] = jax.jit(final_fn)
+        out, ng = programs_cache[fkey](merged)
+        checks_max[gkey] = max(checks_max.get(gkey, 0), int(ng))
+    else:
+        out = _apply_top_chain(concat_many(outs), gp.top_chain)
+    return out, list(checks_max.items())

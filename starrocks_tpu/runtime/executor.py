@@ -486,7 +486,7 @@ class Executor:
             p.set_info("capacities", dict(caps.values))
             overflow = False
             for key, v in keyed_checks:
-                if v > caps.values[key]:
+                if v > caps.values.get(key, -1):
                     new_cap = pad_capacity(int(v * headroom) + 1)
                     if new_cap >= (1 << 31):
                         raise ExecError(
@@ -549,15 +549,46 @@ class Executor:
         """Host-offload streaming for big scan-aggregations (spill analog).
         Rides the shared _adaptive loop (headroom config, profile attempts,
         RECOMPILES metric) and caches the partial/final jitted programs."""
-        from .batched import execute_batched, match_batchable
+        from .batched import (
+            execute_batched, execute_grace_join, match_batchable,
+            match_grace_join,
+        )
 
         bp = match_batchable(plan)
+        batch_rows = config.get("spill_batch_rows") or batch_threshold
         if bp is None:
-            return None
+            # Grace join: both sides host-partitioned by the join key when
+            # either exceeds the streaming threshold
+            gp = match_grace_join(plan, self.catalog)
+            if gp is None:
+                return None
+            lh = self.catalog.get_table(gp.left_scan.table)
+            rh = self.catalog.get_table(gp.right_scan.table)
+            if lh is None or rh is None or max(
+                lh.row_count, rh.row_count
+            ) <= batch_threshold:
+                return None
+            from .batched import grace_partitions
+
+            bucket = self.cache.program_bucket(("grace", plan))
+            parts = grace_partitions(gp, self.catalog, batch_rows)
+
+            def attempt(caps, p):
+                # adopt-last protocol (mirrors _cached_attempt): cached
+                # partition programs return checks for capacity keys that
+                # only exist in the caps they were compiled with
+                if not caps.values and bucket["last"]:
+                    caps.values.update(bucket["last"])
+                out = execute_grace_join(
+                    gp, self.catalog, caps, p, parts, bucket["progs"], self
+                )
+                bucket["last"] = caps.values
+                return out
+
+            return self._adaptive(profile, attempt)
         handle = self.catalog.get_table(bp.scan.table)
         if handle is None or handle.row_count <= batch_threshold:
             return None
-        batch_rows = config.get("spill_batch_rows") or batch_threshold
         prog_cache = self.cache.program_bucket(("batched", plan))["progs"]
 
         def attempt(caps, p):

@@ -345,3 +345,46 @@ def test_delete_keeps_partition_metadata(tmp_path):
     parts = s.sql("SHOW PARTITIONS FROM pd")
     assert [p[4] for p in parts] == [1, 1]  # rewrite kept partition files
     assert s.sql("SELECT sum(v) FROM pd").rows() == [(40,)]
+
+
+def test_grace_join_spill():
+    """A join whose inputs exceed the forced streaming threshold completes
+    via host partition-pair streaming and matches the oracle (VERDICT:
+    the Grace-join analog of spiller.h)."""
+    import numpy as np
+    import pandas as pd
+
+    from starrocks_tpu.column import HostTable
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.session import Session
+    from starrocks_tpu.storage.catalog import Catalog
+
+    rng = np.random.default_rng(5)
+    n, m = 50_000, 20_000
+    fact = {"k": rng.integers(0, 30_000, n), "v": rng.integers(0, 100, n)}
+    dim = {"k": np.arange(m), "w": rng.integers(0, 10, m)}
+    cat = Catalog()
+    cat.register("fact", HostTable.from_pydict(
+        {k: list(v) for k, v in fact.items()}))
+    cat.register("dim", HostTable.from_pydict(
+        {k: list(v) for k, v in dim.items()}), unique_keys=[("k",)])
+    s = Session(cat)
+    old_t = config.get("batch_rows_threshold")
+    old_b = config.get("spill_batch_rows")
+    config.set("batch_rows_threshold", 8_000)  # force the spill path
+    config.set("spill_batch_rows", 8_000)
+    try:
+        q = ("SELECT w, count(*) c, sum(v) sv FROM fact, dim "
+             "WHERE fact.k = dim.k GROUP BY w ORDER BY w")
+        r = s.sql(q).rows()
+        prof = s.last_profile
+        assert "grace_partitions" in prof.render(), prof.render()[:500]
+        # re-execution reuses cached programs + adopted capacities
+        assert s.sql(q).rows() == r
+    finally:
+        config.set("batch_rows_threshold", old_t)
+        config.set("spill_batch_rows", old_b)
+    df = pd.DataFrame(fact).merge(pd.DataFrame(dim), on="k")
+    exp = df.groupby("w", as_index=False).agg(c=("v", "size"), sv=("v", "sum"))
+    assert r == [(int(w), int(c), int(sv))
+                 for w, c, sv in exp.itertuples(index=False)]
