@@ -221,6 +221,7 @@ def hash_join_unique(
     join_type: str = INNER,
     payload=None,  # build column names to attach; default all
     bit_widths=None,
+    build_order=None,  # precomputed argsort of the packed build keys
 ):
     """Join where build keys are unique (validated by planner/caller).
 
@@ -231,7 +232,8 @@ def hash_join_unique(
         probe, build, probe_keys, build_keys, bit_widths
     )  # build NULL/dead rows pack to the sentinel
 
-    order = jnp.argsort(bk, stable=True)  # sentinels (dead/null) go last
+    order = (build_order if build_order is not None
+             else jnp.argsort(bk, stable=True))  # sentinels go last
     bk_sorted = bk[order]
     bcap = build.capacity
 
@@ -320,6 +322,7 @@ def hash_join_expand(
     join_type: str = INNER,
     payload=None,
     bit_widths=None,
+    build_order=None,  # precomputed argsort of the packed build keys
 ):
     """General join allowing duplicate build keys.
 
@@ -333,7 +336,8 @@ def hash_join_expand(
         probe, build, probe_keys, build_keys, bit_widths
     )  # build NULL/dead rows pack to the sentinel
 
-    order = jnp.argsort(bk, stable=True)
+    order = (build_order if build_order is not None
+             else jnp.argsort(bk, stable=True))
     bk_sorted = bk[order]
     bcap = build.capacity
 

@@ -330,7 +330,10 @@ def execute_grace_join(
     pgcap = caps.get(pgkey, 4096) if gp.agg is not None else 0
     prog_key = (part_plan, tuple(sorted(caps.values.items())), lcap, rcap)
     if prog_key not in programs_cache:
-        compiled = compile_plan(part_plan, catalog, caps)
+        # partition chunks differ per partition: per-table cached sort
+        # orders don't apply here
+        compiled = compile_plan(part_plan, catalog, caps,
+                                cached_build_sort=False)
 
         def run_part(inputs, _fn=compiled.fn):
             c, checks = _fn(inputs)

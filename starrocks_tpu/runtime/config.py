@@ -100,6 +100,9 @@ config.define("enable_scatter_free_segments", True, True,
               "lower segment reductions to one-hot matmuls / sorted prefix "
               "tricks instead of XLA scatters (TPU scatter serializes on "
               "duplicate indices)")
+config.define("enable_cached_build_sort", True, True,
+              "pass cached per-(table, key) build-side sort permutations "
+              "into compiled joins (skips the per-query build argsort)")
 config.define("rand_seed", 42, True,
               "seed for rand()/random() (deterministic per trace)")
 config.define("dense_agg_domain_max", 0, True,

@@ -92,6 +92,25 @@ class DeviceCache:
         for key in [k for k in self.opt_plans if scans_table((k,))]:
             del self.opt_plans[key]
 
+    def build_order_for(self, handle, alias: str, key_cols, bit_widths):
+        """Cached argsort permutation of a scan's packed join keys (single
+        device). Computed once per (table, keys, bit_widths) eagerly on the
+        cached device columns; the compiled join receives it as an extra
+        input and skips the per-query build sort."""
+        import jax.numpy as jnp
+
+        from ..exprs.ir import Col as _Col
+        from ..ops.join import pack_keys
+
+        key = (handle.name, "__border__", tuple(key_cols), bit_widths,
+               "local")
+        if key not in self._cols:
+            chunk = self.chunk_for(handle, alias, tuple(key_cols))
+            keys = tuple(_Col(f"{alias}.{c}") for c in key_cols)
+            bk, _ = pack_keys(chunk, keys, bit_widths)
+            self._cols[key] = (jnp.argsort(bk, stable=True), None)
+        return self._cols[key][0]
+
     def chunk_for(self, handle, alias: str, columns, placement=None) -> Chunk:
         """Device chunk of the requested columns, renamed to alias-qualified."""
         import jax.numpy as jnp
@@ -530,13 +549,18 @@ class Executor:
         def attempt(caps, p):
             def compile_cb():
                 compiled = compile_plan(plan, self.catalog, caps)
-                return jax.jit(compiled.fn), compiled.scans
+                return jax.jit(compiled.fn), (compiled.scans, compiled.aux)
 
-            def place_cb(scans):
-                return tuple(
+            def place_cb(scans_aux):
+                scans, aux = scans_aux
+                inputs = [
                     self.cache.chunk_for(self.catalog.get_table(t), a, cols)
                     for t, a, cols in scans
-                )
+                ]
+                for table, a, key_cols, bw in aux:
+                    inputs.append(self.cache.build_order_for(
+                        self.catalog.get_table(table), a, key_cols, bw))
+                return tuple(inputs)
 
             out, checks = self._cached_attempt(
                 ("local", plan), caps, p, compile_cb, place_cb

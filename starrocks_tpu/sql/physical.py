@@ -179,6 +179,70 @@ def choose_key_packing(p, probe_keys, build_keys, residual, catalog):
     return bit_widths, residual, unique
 
 
+def join_equi_keys(p):
+    """(probe_keys, build_keys, residual) split of a join's condition —
+    THE single source for both the emit path and build_order_desc (they
+    must agree or a cached argsort would permute differently-packed
+    keys)."""
+    lcols = frozenset(p.left.output_names())
+    rcols = frozenset(p.right.output_names())
+    probe_keys, build_keys, residual = [], [], []
+    for conj in (_conjuncts(p.condition) if p.condition is not None else []):
+        pair = _equi_pair(conj, lcols, rcols)
+        if pair is not None:
+            probe_keys.append(pair[0])
+            build_keys.append(pair[1])
+        else:
+            residual.append(conj)
+    return probe_keys, build_keys, residual
+
+
+def build_order_desc(p, catalog):
+    """Aux-input descriptor (table, alias, key_cols, bit_widths) for a
+    cachable build-side sort permutation of join `p`, or None. Eligible when
+    the build side is a PURE scan with integer/temporal Col keys — then the
+    packed keys are a per-(table, keys) constant and the host caches their
+    argsort like it caches device columns (the reference caches join hash
+    tables per tablet the same way)."""
+    from ..runtime.config import config as _cfg
+
+    if not _cfg.get("enable_cached_build_sort"):
+        return None
+    if not isinstance(p.right, LScan) or p.condition is None:
+        return None
+    probe_keys, build_keys, residual = join_equi_keys(p)
+    if not probe_keys:
+        return None
+    bit_widths, residual, unique = choose_key_packing(
+        p, probe_keys, build_keys, residual, catalog)
+    # 3 paths never argsort the build: the LUT join (unique bounded single
+    # key), and the residual semi/anti bitmap path
+    if residual and p.kind in ("semi", "anti"):
+        return None
+    if (unique and len(probe_keys) == 1
+            and p.kind in ("inner", "left", "semi", "anti")
+            and not (residual and p.kind != "inner")
+            and dense_rf_range(p.left, p.right, probe_keys, build_keys,
+                               catalog, max_range=LUT_JOIN_MAX_RANGE)
+            is not None):
+        return None
+    if bit_widths == "hash" or not all(
+        isinstance(k, Col) for k in build_keys
+    ):
+        return None
+    key_cols = []
+    for k in build_keys:
+        origin = col_origin(p.right, k.name)
+        if origin is None or origin[0] != p.right.table:
+            return None
+        t = catalog.get_table(origin[0])
+        f = t.schema.field(origin[1]) if t is not None else None
+        if f is None or not (f.type.is_integer or f.type.is_temporal):
+            return None
+        key_cols.append(origin[1])
+    return (p.right.table, p.right.alias, tuple(key_cols), bit_widths)
+
+
 # --- compilation -------------------------------------------------------------
 
 
@@ -194,15 +258,21 @@ class Caps:
 
 
 class Compiled:
-    def __init__(self, fn, scans, checks_meta, out_names):
-        self.fn = fn  # (chunks tuple) -> (chunk, checks tuple)
+    def __init__(self, fn, scans, checks_meta, out_names, aux=()):
+        self.fn = fn  # (inputs tuple) -> (chunk, checks tuple)
         self.scans = scans  # list[(table, alias, columns)]
         self.checks_meta = checks_meta  # list[(cap_key,)] parallel to checks
         self.out_names = out_names
+        # aux inputs appended after the scan chunks: precomputed build-side
+        # sort permutations, (table, alias, key_cols, bit_widths) each
+        self.aux = aux
 
 
-def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
+def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
+                 cached_build_sort: bool = True) -> Compiled:
     scans: list = []
+    aux: list = []  # build-order descriptors (see Compiled.aux)
+    aux_index: dict = {}
     node_ord: dict = {}  # plan node (by value) -> deterministic ordinal
 
     def ordinal(p) -> int:
@@ -223,6 +293,17 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
 
     collect_scans(plan)
 
+    def collect_build_orders(p):
+        if isinstance(p, LJoin) and cached_build_sort:
+            desc = build_order_desc(p, catalog)
+            if desc is not None and desc not in aux_index:
+                aux_index[desc] = len(aux)
+                aux.append(desc)
+        for c in p.children:
+            collect_build_orders(c)
+
+    collect_build_orders(plan)
+
     def run(inputs):
         """The traced program. ALL mutable trace state lives inside this
         function so cached jitted versions retrace safely (shape changes
@@ -237,6 +318,19 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
             out = _emit(p)
             emit_memo[p] = out
             return out
+
+        def build_order_input(p, rc, rc0):
+            """Index of the precomputed build argsort among aux inputs
+            (registered by the eager pre-pass), or None. The rc-is-rc0 guard
+            drops the cached order if the build was compacted after scan
+            emit (row positions changed)."""
+            if rc is not rc0:
+                return None
+            desc = build_order_desc(p, catalog)
+            if desc is None:
+                return None
+            idx = aux_index.get(desc)
+            return idx
 
         def maybe_compact(child_plan, c, tag: str):
             """Shrink a sparse chunk before a sort-heavy op: selective
@@ -301,13 +395,13 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
                 kwargs = {}
                 if any(a.fn == "array_agg" for _, a in p.aggs):
                     akey = f"aggarr_{ordinal(p)}"
-                    aux: dict = {}
+                    agg_aux: dict = {}
                     kwargs = {"arr_cap": caps.get(akey, 256),
-                              "aux_checks": aux}
+                              "aux_checks": agg_aux}
                 out, ng = hash_aggregate(c, p.group_by, p.aggs, cap, **kwargs)
                 checks[key] = ng
                 if kwargs:
-                    checks[akey] = aux["array_agg_max"]
+                    checks[akey] = agg_aux["array_agg_max"]
                 return out
             if isinstance(p, LJoin):
                 return emit_join(p)
@@ -325,17 +419,8 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
         def emit_join(p: LJoin):
             lc = emit(p.left)
             rc = emit(p.right)
-            lcols = frozenset(p.left.output_names())
-            rcols = frozenset(p.right.output_names())
-
-            probe_keys, build_keys, residual = [], [], []
-            for conj in (_conjuncts(p.condition) if p.condition is not None else []):
-                pair = _equi_pair(conj, lcols, rcols)
-                if pair is not None:
-                    probe_keys.append(pair[0])
-                    build_keys.append(pair[1])
-                else:
-                    residual.append(conj)
+            rc0 = rc  # pristine build (cached sort orders key off it)
+            probe_keys, build_keys, residual = join_equi_keys(p)
 
             kind = {
                 "inner": INNER, "left": LEFT_OUTER, "semi": LEFT_SEMI,
@@ -399,6 +484,10 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
             # the sorted join paths argsort the BUILD side at full capacity —
             # compact it first when it is sparse (filtered dimension chains)
             rc = maybe_compact(p.right, rc, f"{ordinal(p)}r")
+            bo_idx = build_order_input(p, rc, rc0)
+            build_order = (
+                inputs[len(scans) + bo_idx] if bo_idx is not None else None
+            )
 
             if residual and p.kind in ("semi", "anti"):
                 # Residual-capable (anti)semi join: tag probe rows with a rowid,
@@ -453,6 +542,7 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
                 out = hash_join_unique(
                     lc, rc, tuple(probe_keys), tuple(build_keys), kind,
                     payload=payload, bit_widths=bit_widths,
+                    build_order=build_order,
                 )
                 if residual:
                     out = filter_chunk(out, and_all(residual))
@@ -466,6 +556,7 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
             out, total = hash_join_expand(
                 lc, rc, tuple(probe_keys), tuple(build_keys), cap, kind,
                 payload=payload, bit_widths=bit_widths,
+                build_order=build_order,
             )
             if p.kind not in ("semi", "anti"):
                 checks[key] = total
@@ -476,7 +567,7 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps) -> Compiled:
         chunk = emit(plan)
         return chunk, checks
 
-    return Compiled(run, scans, None, plan.output_names())
+    return Compiled(run, scans, None, plan.output_names(), tuple(aux))
 
 
 def _equi_pair(conj: Expr, lcols: frozenset, rcols: frozenset):
