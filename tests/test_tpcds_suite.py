@@ -1825,3 +1825,52 @@ def test_q93(env):
         assert len(g) > 0
         return g.sort_values(["sumsales", "ss_customer_sk"]).head(100)
     run(env, "q93", oracle, limit=None)
+
+
+def test_q81(env):
+    def oracle(F):
+        ctr = (F["catalog_returns"]
+               .merge(F["date_dim"][F["date_dim"].d_year == 2000],
+                      left_on="cr_returned_date_sk", right_on="d_date_sk")
+               .merge(F["customer"], left_on="cr_returning_customer_sk",
+                      right_on="c_customer_sk")
+               .merge(F["customer_address"], left_on="c_current_addr_sk",
+                      right_on="ca_address_sk")
+               .groupby(["cr_returning_customer_sk", "ca_state"],
+                        as_index=False)["cr_return_amount"].sum()
+               .rename(columns={"cr_return_amount": "ctr_total_return"}))
+        avg_by_state = ctr.groupby("ca_state")["ctr_total_return"].mean()
+        x = ctr[ctr.ctr_total_return > 1.2 * ctr.ca_state.map(avg_by_state)]
+        out = x.merge(F["customer"], left_on="cr_returning_customer_sk",
+                      right_on="c_customer_sk")
+        assert len(out) > 0
+        return out[["c_customer_id", "c_first_name", "c_last_name",
+                    "ctr_total_return"]].sort_values("c_customer_id").head(100)
+    run(env, "q81", oracle, limit=None)
+
+
+def test_q86(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        x = (F["web_sales"]
+             .merge(dd[dd.d_month_seq.between(12, 23)],
+                    left_on="ws_sold_date_sk", right_on="d_date_sk")
+             .merge(F["item"], left_on="ws_item_sk", right_on="i_item_sk"))
+        assert len(x) > 0
+
+        def agg(sub):
+            return {"total_sum": sub.ws_net_paid.sum()}
+
+        lv = rollup_levels(x, ["i_category", "i_class"], agg,
+                           grouping_cols="all")
+        lv["lochierarchy"] = lv["__g0"] + lv["__g1"]
+        lv["parent"] = lv.i_category.where(lv["__g1"] == 0, None)
+        lv["rank_within_parent"] = lv.groupby(
+            ["lochierarchy", "parent"], dropna=False)["total_sum"].rank(
+            method="min", ascending=False).astype(int)
+        lv = lv.sort_values(
+            ["lochierarchy", "i_category", "i_class"],
+            ascending=[False, True, True], na_position="last").head(100)
+        return lv[["total_sum", "i_category", "i_class", "lochierarchy",
+                   "rank_within_parent"]]
+    run(env, "q86", oracle, limit=None)

@@ -1578,3 +1578,36 @@ GROUP BY ss_customer_sk
 ORDER BY sumsales, ss_customer_sk
 LIMIT 100
 """
+
+QUERIES["q81"] = """
+WITH customer_total_return AS (
+  SELECT cr_returning_customer_sk AS ctr_customer_sk, ca_state AS ctr_state,
+         sum(cr_return_amount) AS ctr_total_return
+  FROM catalog_returns, date_dim, customer_address, customer
+  WHERE cr_returned_date_sk = d_date_sk AND d_year = 2000
+    AND cr_returning_customer_sk = c_customer_sk
+    AND c_current_addr_sk = ca_address_sk
+  GROUP BY cr_returning_customer_sk, ca_state)
+SELECT c_customer_id, c_first_name, c_last_name, ctr_total_return
+FROM customer_total_return ctr1, customer
+WHERE ctr1.ctr_total_return > (SELECT avg(ctr_total_return) * 1.2
+                               FROM customer_total_return ctr2
+                               WHERE ctr1.ctr_state = ctr2.ctr_state)
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id
+LIMIT 100
+"""
+
+QUERIES["q86"] = """
+SELECT sum(ws_net_paid) AS total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) AS lochierarchy,
+       rank() OVER (PARTITION BY grouping(i_category) + grouping(i_class),
+                    CASE WHEN grouping(i_class) = 0 THEN i_category END
+                    ORDER BY sum(ws_net_paid) DESC) AS rank_within_parent
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 12 AND 23
+  AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+GROUP BY ROLLUP (i_category, i_class)
+ORDER BY lochierarchy DESC, i_category, i_class
+LIMIT 100
+"""
