@@ -41,7 +41,7 @@ def make_handler(session: Session, lock: threading.Lock):
             if self.path == "/metrics":
                 self._send(200, metrics.render_prometheus(), "text/plain")
             elif self.path == "/profile":
-                prof = getattr(session, "last_profile", None)
+                prof = session.last_profile
                 self._send(200, prof.render() if prof else "no queries yet",
                            "text/plain")
             elif self.path == "/tables":

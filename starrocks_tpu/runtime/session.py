@@ -57,6 +57,7 @@ class Session:
     ):
         self.catalog = catalog or Catalog()
         self.cache = DeviceCache()
+        self.last_profile = None  # most recent query's RuntimeProfile
         self.store = None
         self.dist_shards = dist_shards
         self._dist_executor = None
@@ -195,6 +196,11 @@ class Session:
             return sorted(self.catalog.tables)
         if isinstance(stmt, ast.ShowPartitions):
             return self._show_partitions(stmt.table.lower())
+        if isinstance(stmt, ast.ShowProfile):
+            # the reference's SHOW PROFILE: render the last query's
+            # RuntimeProfile tree (qe/StmtExecutor profile surface)
+            return (self.last_profile.render()
+                    if self.last_profile is not None else "no queries yet")
         if isinstance(stmt, ast.ShowCreate):
             return self._show_create(stmt.table)
         if isinstance(stmt, ast.Describe):

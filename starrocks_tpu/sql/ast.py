@@ -226,6 +226,11 @@ class ShowPartitions:
 
 
 @dataclasses.dataclass(frozen=True)
+class ShowProfile:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowCreate:
     table: str
 

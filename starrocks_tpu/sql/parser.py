@@ -204,6 +204,11 @@ class Parser:
                 name = self.parse_table_name()
                 self.accept_op(";")
                 return ast.ShowPartitions(name)
+            if (self.peek().kind == "ident"
+                    and self.peek().value.lower() == "profile"):
+                self.next()
+                self.accept_op(";")
+                return ast.ShowProfile()
             self.expect_kw("tables")
             self.accept_op(";")
             return ast.ShowTables()

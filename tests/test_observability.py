@@ -131,3 +131,15 @@ def test_batched_aggregation_spill_path():
         assert got2 == ref2
     finally:
         config.set("batch_rows_threshold", 0)
+
+
+def test_show_profile_statement():
+    from starrocks_tpu.runtime.session import Session
+
+    s = Session()
+    s.sql("CREATE TABLE t (a BIGINT)")
+    s.sql("INSERT INTO t VALUES (1), (2)")
+    assert s.sql("SHOW PROFILE") == "no queries yet"
+    s.sql("SELECT sum(a) FROM t")
+    out = s.sql("SHOW PROFILE")
+    assert "attempt_0" in out or "query" in out
