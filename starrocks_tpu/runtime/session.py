@@ -196,6 +196,8 @@ class Session:
             return sorted(self.catalog.tables)
         if isinstance(stmt, ast.ShowPartitions):
             return self._show_partitions(stmt.table.lower())
+        if isinstance(stmt, ast.AlterTable):
+            return self._alter(stmt)
         if isinstance(stmt, ast.ShowProfile):
             # the reference's SHOW PROFILE: render the last query's
             # RuntimeProfile tree (qe/StmtExecutor profile surface)
@@ -212,6 +214,62 @@ class Session:
                 for f in h.schema
             ]
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
+
+    def _alter(self, stmt: ast.AlterTable):
+        """ALTER TABLE ADD/DROP COLUMN (linked schema change: stored data
+        files are untouched; reads fill added columns with NULL)."""
+        from ..storage.catalog import StoredTableHandle
+
+        _writable(stmt.table)
+        name = stmt.table.lower()
+        handle = self.catalog.get_table(name)
+        if handle is None:
+            raise ValueError(f"unknown table {name}")
+        if self.store is not None and isinstance(handle, StoredTableHandle):
+            new_schema = self.store.alter_table(
+                name, stmt.action, stmt.column, stmt.type, stmt.nullable)
+            self.catalog.register_handle(StoredTableHandle(
+                name, self.store, new_schema, handle.unique_keys,
+                handle.distribution))
+        else:
+            from ..storage.store import TabletStore
+
+            ht = handle.table
+            protected = set(handle.distribution) | {
+                k for ks in handle.unique_keys for k in ks}
+            TabletStore.validate_alter(
+                ht.schema, stmt.action, stmt.column, stmt.nullable,
+                ht.num_rows > 0, protected)
+            if stmt.action == "add":
+                t = stmt.type
+                d = StringDict.from_values([]) if t.is_string else None
+                fields = tuple(ht.schema.fields) + (
+                    Field(stmt.column, t, stmt.nullable, d),)
+                arrays = dict(ht.arrays)
+                if t.is_array:
+                    shape = (ht.num_rows, 2)
+                elif t.is_decimal128:
+                    shape = (ht.num_rows, 4)
+                else:
+                    shape = ht.num_rows
+                arrays[stmt.column] = np.zeros(shape, dtype=t.np_dtype)
+                valids = dict(ht.valids)
+                if ht.num_rows:
+                    valids[stmt.column] = np.zeros(ht.num_rows,
+                                                   dtype=np.bool_)
+                new = HostTable(Schema(fields), arrays, valids)
+            else:
+                fields = tuple(f for f in ht.schema.fields
+                               if f.name != stmt.column)
+                arrays = {k: v for k, v in ht.arrays.items()
+                          if k != stmt.column}
+                valids = {k: v for k, v in ht.valids.items()
+                          if k != stmt.column}
+                new = HostTable(Schema(fields), arrays, valids)
+            self.catalog.register(name, new, handle.unique_keys,
+                                  handle.distribution)
+        self.cache.invalidate(name)
+        return None
 
     def _show_partitions(self, name: str):
         """SHOW PARTITIONS FROM t: per-partition bounds, rows, files (the

@@ -231,6 +231,15 @@ class ShowProfile:
 
 
 @dataclasses.dataclass(frozen=True)
+class AlterTable:
+    table: str
+    action: str  # "add" | "drop"
+    column: str
+    type: object = None  # LogicalType for "add"
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowCreate:
     table: str
 

@@ -212,6 +212,32 @@ class Parser:
             self.expect_kw("tables")
             self.accept_op(";")
             return ast.ShowTables()
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "alter"):
+            self.next()
+            self.expect_kw("table")
+            name = self.parse_table_name()
+            word = self.next().value.lower()
+            if word == "add":
+                if (self.peek().kind in ("ident", "kw")
+                        and self.peek().value.lower() == "column"):
+                    self.next()
+                cname = self.expect_ident()
+                t = self.parse_type_name()
+                nullable = True
+                if self.accept_kw("not"):
+                    self.expect_kw("null")
+                    nullable = False
+                self.accept_op(";")
+                return ast.AlterTable(name, "add", cname, t, nullable)
+            if word == "drop":
+                if (self.peek().kind in ("ident", "kw")
+                        and self.peek().value.lower() == "column"):
+                    self.next()
+                cname = self.expect_ident()
+                self.accept_op(";")
+                return ast.AlterTable(name, "drop", cname)
+            raise ParseError(f"unsupported ALTER TABLE action {word!r}")
         if self.at_kw("describe", "desc"):
             self.next()
             name = self.parse_table_name()

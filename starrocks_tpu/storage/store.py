@@ -222,6 +222,10 @@ class TabletStore:
                     "file": fname,
                     "bucket": b,
                     "rows": rows,
+                    # live columns in THIS file: schema changes are linked
+                    # (files never rewritten), so readers consult this list
+                    # — a re-added name must NOT resurrect dropped bytes
+                    "cols": [f.name for f in data.schema],
                     "zonemap": _zonemap(data, sel),
                 }
                 if p is not None:
@@ -261,6 +265,74 @@ class TabletStore:
         if record:
             self.log({"op": "rewrite", "table": name, "rows": n})
         return n
+
+    # --- schema change --------------------------------------------------------
+    @staticmethod
+    def validate_alter(schema: Schema, action: str, column: str,
+                       nullable: bool, has_rows: bool, protected: set):
+        """Shared ALTER TABLE validation (stored + in-memory tables)."""
+        names = [f.name for f in schema]
+        if action == "add":
+            if column in names:
+                raise ValueError(f"column {column!r} already exists")
+            if not nullable and has_rows:
+                raise ValueError(
+                    "ADD COLUMN ... NOT NULL requires an empty table "
+                    "(no default values yet)")
+        elif action == "drop":
+            if column not in names:
+                raise ValueError(f"unknown column {column!r}")
+            if column in protected:
+                raise ValueError(
+                    f"column {column!r} is a key/distribution/partition "
+                    "column and cannot be dropped")
+            if len(names) == 1:
+                raise ValueError("cannot drop the last column")
+        else:
+            raise ValueError(f"unknown ALTER action {action!r}")
+
+    def alter_table(self, name: str, action: str, column: str,
+                    ctype=None, nullable: bool = True, record: bool = True):
+        """ADD COLUMN (nullable; existing rows read back NULL — linked
+        schema change: data files are NOT rewritten, the reader fills
+        missing columns) / DROP COLUMN (metadata-only; bytes reclaimed at
+        the next compaction). Reference: alter/SchemaChangeJobV2.java's
+        linked-schema-change fast path."""
+        import pyarrow.parquet as pq
+
+        m = self.read_manifest(name)
+        schema = schema_from_json(m["schema"])
+        protected = set(m["distribution"]) | {
+            k for ks in m["unique_keys"] for k in ks}
+        pb = m.get("partition_by")
+        if pb:
+            protected.add(pb["column"])
+        has_rows = any(
+            f["rows"] for rs in m["rowsets"] for f in rs["files"])
+        self.validate_alter(schema, action, column, nullable, has_rows,
+                            protected)
+        if action == "add":
+            d = StringDict.from_values([]) if ctype.is_string else None
+            fields = tuple(schema.fields) + (
+                Field(column, ctype, nullable, d),)
+        else:
+            fields = tuple(f for f in schema.fields if f.name != column)
+            # strip the name from every file's live-column list (legacy
+            # entries materialize theirs from the parquet footer once) so a
+            # future same-named ADD reads NULL, never the dropped bytes
+            for rs in m["rowsets"]:
+                for fmeta in rs["files"]:
+                    if "cols" not in fmeta:
+                        fmeta["cols"] = pq.read_schema(os.path.join(
+                            self._tdir(name), fmeta["file"])).names
+                    fmeta["cols"] = [c for c in fmeta["cols"] if c != column]
+        m["schema"] = schema_to_json(Schema(fields))
+        self._write_manifest(name, m)
+        self._pk_index.pop(name, None)
+        if record:
+            self.log({"op": "alter", "table": name, "action": action,
+                      "column": column})
+        return Schema(fields)
 
     # --- compaction -----------------------------------------------------------
     def _maybe_compact(self, name: str, m: dict):
@@ -531,10 +603,21 @@ class TabletStore:
             return HostTable(sub, {f.name: empty(f) for f in sub}, {})
         import pyarrow as pa
 
+        want = list(columns) if columns else [f.name for f in schema]
         tables = []
         for fmeta in chosen:
-            t = pq.read_table(os.path.join(self._tdir(name), fmeta["file"]),
-                              columns=list(columns) if columns else None)
+            fpath = os.path.join(self._tdir(name), fmeta["file"])
+            have = set(fmeta.get("cols")
+                       or pq.read_schema(fpath).names)  # legacy: footer
+            t = pq.read_table(fpath, columns=[c for c in want if c in have])
+            # linked schema change: columns added after this file was
+            # written read back as NULL
+            for c in want:
+                if c not in have:
+                    t = t.append_column(
+                        c, pa.nulls(t.num_rows, type=_arrow_type_of(
+                            schema.field(c).type)))
+            t = t.select(want)
             dv = fmeta.get("delvec")
             if dv:
                 # primary-key delete vector: superseded rows masked at read
@@ -678,6 +761,25 @@ def _canon_key(v, t: T.LogicalType):
     if isinstance(v, float) and t.is_integer:
         return int(v)
     return int(v) if isinstance(v, (bool, np.integer)) else v
+
+
+def _arrow_type_of(t: T.LogicalType):
+    """Arrow type for NULL-fill of columns absent from a data file."""
+    import pyarrow as pa
+
+    if t.is_array:
+        et = (pa.string() if t.elem.is_string
+              else pa.from_numpy_dtype(t.elem.np_dtype))
+        return pa.list_(et)
+    if t.is_decimal128:
+        return pa.decimal128(t.precision, t.scale)
+    if t.is_string:
+        return pa.string()
+    if t.kind is T.TypeKind.DATE:
+        return pa.date32()
+    if t.kind is T.TypeKind.DATETIME:
+        return pa.timestamp("us")
+    return pa.from_numpy_dtype(t.np_dtype)
 
 
 def _partition_zonemaps(pb):

@@ -388,3 +388,82 @@ def test_grace_join_spill():
     exp = df.groupby("w", as_index=False).agg(c=("v", "size"), sv=("v", "sum"))
     assert r == [(int(w), int(c), int(sv))
                  for w, c, sv in exp.itertuples(index=False)]
+
+
+def test_alter_table_add_drop_column(tmp_path):
+    """Linked schema change: ADD COLUMN leaves data files untouched (old
+    rows read NULL), DROP is metadata-only; both survive restart."""
+    from starrocks_tpu.runtime.session import Session
+
+    s = Session(data_dir=str(tmp_path))
+    s.sql("CREATE TABLE t (a BIGINT, b VARCHAR)")
+    s.sql("INSERT INTO t VALUES (1, 'x'), (2, 'y')")
+    s.sql("ALTER TABLE t ADD COLUMN c DOUBLE")
+    assert s.sql("SELECT a, c FROM t ORDER BY a").rows() == [
+        (1, None), (2, None)]
+    s.sql("INSERT INTO t VALUES (3, 'z', 1.5)")
+    assert s.sql("SELECT a, c FROM t ORDER BY a").rows() == [
+        (1, None), (2, None), (3, 1.5)]
+    assert s.sql("SELECT sum(c) FROM t").rows() == [(1.5,)]
+    s.sql("ALTER TABLE t DROP COLUMN b")
+    assert [d[0] for d in s.sql("DESCRIBE t")] == ["a", "c"]
+    # restart: schema replayed from the manifest
+    s2 = Session(data_dir=str(tmp_path))
+    assert s2.sql("SELECT a, c FROM t ORDER BY a").rows() == [
+        (1, None), (2, None), (3, 1.5)]
+    import pytest as _pt
+
+    with _pt.raises(Exception, match="unknown column"):
+        s2.sql("SELECT b FROM t")
+
+
+def test_alter_table_in_memory_and_guards():
+    from starrocks_tpu.runtime.session import Session
+
+    s = Session()
+    s.sql("CREATE TABLE m (k BIGINT, v BIGINT, PRIMARY KEY(k))")
+    s.sql("INSERT INTO m VALUES (1, 10)")
+    s.sql("ALTER TABLE m ADD COLUMN note VARCHAR")
+    assert s.sql("SELECT k, note FROM m").rows() == [(1, None)]
+    import pytest as _pt
+
+    with _pt.raises(Exception, match="cannot be dropped"):
+        s.sql("ALTER TABLE m DROP COLUMN k")
+    with _pt.raises(Exception, match="NOT NULL"):
+        s.sql("ALTER TABLE m ADD COLUMN req BIGINT NOT NULL")
+
+
+def test_alter_drop_then_readd_reads_null(tmp_path):
+    """Re-adding a dropped column name must NOT resurrect the old bytes
+    (and type changes must not reinterpret them)."""
+    from starrocks_tpu.runtime.session import Session
+
+    s = Session(data_dir=str(tmp_path))
+    s.sql("CREATE TABLE t (a BIGINT, b VARCHAR)")
+    s.sql("INSERT INTO t VALUES (1, 'xyz'), (2, 'pq')")
+    s.sql("ALTER TABLE t DROP COLUMN b")
+    s.sql("ALTER TABLE t ADD COLUMN b DOUBLE")
+    assert s.sql("SELECT a, b FROM t ORDER BY a").rows() == [
+        (1, None), (2, None)]
+    s.sql("INSERT INTO t VALUES (3, 4.5)")
+    assert s.sql("SELECT sum(b) FROM t").rows() == [(4.5,)]
+
+
+def test_alter_add_array_column(tmp_path):
+    from starrocks_tpu.runtime.session import Session
+
+    s = Session(data_dir=str(tmp_path))
+    s.sql("CREATE TABLE v (a BIGINT)")
+    s.sql("INSERT INTO v VALUES (1)")
+    s.sql("ALTER TABLE v ADD COLUMN arr ARRAY<BIGINT>")
+    s.sql("INSERT INTO v VALUES (2, array(7, 8))")
+    assert s.sql("SELECT a, arr FROM v ORDER BY a").rows() == [
+        (1, None), (2, [7, 8])]
+    # in-memory variant
+    s2 = Session()
+    s2.sql("CREATE TABLE w (a BIGINT)")
+    s2.sql("INSERT INTO w VALUES (1)")
+    s2.sql("ALTER TABLE w ADD COLUMN arr ARRAY<BIGINT>")
+    s2.sql("INSERT INTO w VALUES (2, array(7, 8))")
+    assert s2.sql("SELECT a, arr FROM w ORDER BY a").rows() == [
+        (1, None), (2, [7, 8])]
