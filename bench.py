@@ -11,8 +11,8 @@ is rewritten incrementally after every entry.  At the end a second,
 enriched JSON line (same metric/value, plus suite geomean) is printed —
 either line satisfies the driver.
 
-Families: TPC-H Q1 (hand-built plan, the headline), TPC-H Q3/Q9 (joins +
-partial-agg), four SSB flat queries (wide scan), TPC-DS Q67 (high-card
+Families: TPC-H Q1 (hand-built plan, the headline), the full TPC-H 22
+SQL queries, all 13 SSB flat queries (wide scan), TPC-DS Q67 (high-card
 group-by + window) — each against a single-process pandas implementation
 of the same query on the same host (the stand-in for the reference BE's
 single-node vectorized CPU path; BASELINE.md has the reference's
@@ -172,7 +172,7 @@ def _ensure_live_backend(probe_timeout_s: int = 120):
         if r.returncode == 0:
             backend = r.stdout.strip().splitlines()[-1]
             print(f"# device probe ok: {backend}", file=sys.stderr)
-            return
+            return True
         tail = (r.stderr or "")[-2000:]
         print(f"# device probe rc={r.returncode}; stderr tail:\n{tail}",
               file=sys.stderr)
@@ -188,6 +188,7 @@ def _ensure_live_backend(probe_timeout_s: int = 120):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    return False
 
 
 def run_q1_handplan(sf: float, repeats: int):
@@ -246,7 +247,7 @@ def run_q1_handplan(sf: float, repeats: int):
     }
 
 
-def run_suite(sf: float, repeats: int):
+def run_suite(sf: float, repeats: int, probe_failed: bool = False):
     """All BASELINE.json config families.  Headline JSON line prints right
     after Q1; the rest runs under the wall-clock budget with incremental
     BENCH_DETAIL.json writes."""
@@ -312,7 +313,7 @@ def run_suite(sf: float, repeats: int):
         detail["tpch_setup"] = {"error": f"{type(e).__name__}: {e}"}
         flush_detail()
     else:
-        for qn in (3, 9):
+        for qn in range(1, 23):
             try_entry(
                 f"tpch_q{qn}",
                 lambda qn=qn: _bench_sql(
@@ -338,7 +339,7 @@ def run_suite(sf: float, repeats: int):
         detail["ssb_setup"] = {"error": f"{type(e).__name__}: {e}"}
         flush_detail()
     else:
-        for qid in ("q1.1", "q2.1", "q3.1", "q4.1"):
+        for qid in sorted(FLAT_QUERIES):
             try_entry(
                 f"ssb_{qid}",
                 lambda qid=qid: _bench_sql(
@@ -364,6 +365,31 @@ def run_suite(sf: float, repeats: int):
     detail["suite_geomean_vs_pandas"] = geomean
     flush_detail()
 
+    # --- TPU tunnel forensics (only when the probe failed) ------------------
+    # Runs LAST so it can never eat the headline; staged subprocess probes
+    # record WHERE the tunnel wedges (tools/tpu_forensics.py writes
+    # TPU_PROBE.json; round-4 signature: PJRT make_c_api_client claim/bind
+    # retry loop — see that file's deep_probe docstring).
+    if probe_failed and _remaining_s() > 0:
+        probe_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "TPU_PROBE.json")
+        try:
+            import subprocess as _sp
+
+            if os.path.exists(probe_path):  # never report a stale probe
+                os.remove(probe_path)
+            _sp.run([sys.executable, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "tpu_forensics.py")],
+                timeout=max(60, min(420, _remaining_s())), check=False,
+                capture_output=True)
+            with open(probe_path) as f:
+                detail["tpu_forensics"] = json.load(f)
+            flush_detail()
+        except Exception as e:  # noqa: BLE001
+            detail["tpu_forensics"] = {"error": f"{type(e).__name__}: {e}"}
+            flush_detail()
+
     # Enriched final line: same metric/value as the headline (either line
     # satisfies the driver), plus the suite geomean.
     print(json.dumps({
@@ -377,11 +403,11 @@ def main():
     sf = float(os.environ.get("SR_TPU_BENCH_SF", "1.0"))
     repeats = int(os.environ.get("SR_TPU_BENCH_REPEATS", "5"))
     query_key = os.environ.get("SR_TPU_BENCH_QUERY", "suite")
-    _ensure_live_backend()
+    probe_ok = _ensure_live_backend()
     global _T0
     _T0 = time.time()  # budget clock starts after the device probe
     if query_key == "suite":
-        return run_suite(sf, repeats)
+        return run_suite(sf, repeats, probe_failed=not probe_ok)
     if query_key != "q1":
         return run_sql_bench(query_key, sf, repeats)
 

@@ -516,38 +516,40 @@ def hash_aggregate(
             chunk, cc, group_by, aggs, num_groups, mode, *lowcard, live=live
         )
 
+    out_fields, out_data, out_valid = [], [], []
+
     if keys:
         order = jnp.lexsort(tuple(key_sort_arrays(keys, live)))
         is_new = boundaries(keys, live, order)
+        gid = jnp.clip(jnp.cumsum(is_new) - 1, 0, num_groups - 1)
+        live_s = live[order]
+        ngroups = jnp.sum(is_new, dtype=jnp.int64)
+        reorder = lambda x: x[order]  # noqa: E731
+
+        # --- group key columns ------------------------------------------------
+        first_pos = seg_first_index(gid, num_groups, cap)
+        safe_first = jnp.clip(first_pos, 0, cap - 1)
+        for (kname, _), k in zip(group_by, keys):
+            ks = k.data[order][safe_first]
+            kv = None if k.valid is None else k.valid[order][safe_first]
+            out_fields.append(Field(kname, k.type, k.valid is not None, k.dict,
+                                    bounds=k.bounds))
+            out_data.append(ks)
+            out_valid.append(kv)
     else:
-        # global aggregation: one group holding all live rows
-        order = jnp.arange(cap)
-        is_new = jnp.zeros((cap,), jnp.bool_).at[0].set(jnp.any(live))
-        live = live  # group 0 regardless; contributions masked by live
-
-    gid = jnp.clip(jnp.cumsum(is_new) - 1, 0, num_groups - 1)
-    live_s = live[order]
-    ngroups = jnp.sum(is_new, dtype=jnp.int64)
-    if not keys:
+        # global aggregation: one group holding all live rows. No sort, no
+        # cumsum, no row permutation — each aggregate collapses to ONE fused
+        # masked reduction over the chunk (seg_* have a num_groups==1 fast
+        # path), which is the cheapest possible formulation on any backend.
+        gid = jnp.zeros((cap,), jnp.int32)
+        live_s = live
         # a global agg always yields one row (COUNT over empty set = 0)
-        ngroups = jnp.maximum(ngroups, 1)
-
-    out_fields, out_data, out_valid = [], [], []
-
-    # --- group key columns ---------------------------------------------------
-    first_pos = seg_first_index(gid, num_groups, cap)
-    safe_first = jnp.clip(first_pos, 0, cap - 1)
-    for (kname, _), k in zip(group_by, keys):
-        ks = k.data[order][safe_first]
-        kv = None if k.valid is None else k.valid[order][safe_first]
-        out_fields.append(Field(kname, k.type, k.valid is not None, k.dict,
-                                bounds=k.bounds))
-        out_data.append(ks)
-        out_valid.append(kv)
+        ngroups = jnp.asarray(1, jnp.int64)
+        reorder = lambda x: x  # noqa: E731
 
     # --- aggregate columns ----------------------------------------------------
     agg_fields, agg_data, agg_valid = _emit_agg_columns(
-        cc, aggs, mode, cap, live_s, lambda x: x[order], gid, num_groups,
+        cc, aggs, mode, cap, live_s, reorder, gid, num_groups,
         indices_sorted=True, arr_cap=arr_cap, aux_checks=aux_checks,
     )
     out_fields += agg_fields

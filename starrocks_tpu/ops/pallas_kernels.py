@@ -13,12 +13,12 @@ a grid over row blocks, each block building its one-hot tile in VMEM and
 accumulating partial sums into a [G, M] accumulator — HBM->VMEM streaming
 handled by the Pallas pipeline.
 
-STATUS: experimental. Validated against oracles in interpret mode
-(tests/test_lowcard_agg.py); NOT yet wired into the aggregate operator —
-the product fast path uses unsorted segment reductions
-(ops/aggregate.py _aggregate_with_gid), and this kernel replaces them only
-after real-TPU benchmarking shows a win (the decimal/int64 exactness
-requirement limits it to float sums).
+STATUS: wired behind `SET segment_strategy = 'pallas'` (ops/segment.py
+_seg_sum_pallas): float segment sums route through this kernel — interpret
+mode on CPU (correctness-testable without hardware,
+tests/test_lowcard_agg.py), compiled on TPU. Integer/decimal sums keep the
+exact strategies (f32 accumulation here). The moment the tunnel yields a
+live chip, `SET segment_strategy='pallas'` + bench.py measures it.
 """
 
 from __future__ import annotations

@@ -175,6 +175,27 @@ def _seg_minmax_bcast(vals, gid, num_groups: int, is_min: bool, identity):
     return (jnp.min if is_min else jnp.max)(masked, axis=0)
 
 
+def _seg_sum_pallas(vals, gid, num_groups: int):
+    """Float segment sums through the explicit Pallas kernel
+    (ops/pallas_kernels.py): one-hot tiles in VMEM, partial sums on the MXU.
+    Flag-gated via segment_strategy=pallas; interpret mode on CPU keeps the
+    path correctness-testable without hardware. f32 accumulation — callers
+    gate exact (int/decimal) sums away from it. Returns None when the shape
+    doesn't block-divide (caller falls through to the default strategy)."""
+    n = vals.shape[0]
+    block = min(n & -n, 2048)
+    if block < 8:
+        return None
+    from .pallas_kernels import segment_sum_pallas
+
+    g = jnp.clip(jnp.asarray(gid, jnp.int32), 0, num_groups)
+    out = segment_sum_pallas(
+        g, jnp.asarray(vals, jnp.float32)[:, None], num_groups, block=block,
+        interpret=jax.default_backend() == "cpu",
+    )
+    return jnp.asarray(out[:, 0], vals.dtype)
+
+
 def _use_mxu() -> bool:
     """True when the scatter-free (matmul / broadcast / scan) strategies
     should be used.  They exist because TPU scatters serialize on duplicate
@@ -190,7 +211,10 @@ def _use_mxu() -> bool:
     s = config.get("segment_strategy")
     if s == "auto":
         return jax.default_backend() not in ("cpu",)
-    return s == "mxu"
+    # "pallas" only reroutes float sums; every other reduction must keep
+    # its scatter-free strategy (degrading them to scatters would make the
+    # pallas A/B benchmark measure scatter serialization instead)
+    return s in ("mxu", "pallas")
 
 
 def seg_sum(vals, gid, num_groups: int, *, sorted_gid: bool = False,
@@ -205,6 +229,21 @@ def seg_sum(vals, gid, num_groups: int, *, sorted_gid: bool = False,
     vals = jnp.asarray(vals)
     if vals.dtype == jnp.bool_:
         vals = jnp.asarray(vals, jnp.int64)
+    if num_groups == 1:
+        # global aggregate: one fused masked reduction, no scatter / one-hot
+        # on ANY backend (the gid==0 compare folds away when gid is the
+        # constant zeros of the no-group-key path)
+        m = jnp.asarray(gid, jnp.int32) == 0
+        return jnp.sum(jnp.where(m, vals, jnp.zeros((), vals.dtype)),
+                       keepdims=True)
+    from ..runtime.config import config as _cfg
+
+    if (_cfg.get("segment_strategy") == "pallas"
+            and not jnp.issubdtype(vals.dtype, jnp.integer)
+            and num_groups <= _matmul_groups_max()):
+        out = _seg_sum_pallas(vals, gid, num_groups)
+        if out is not None:
+            return out
     if _use_mxu():
         if jnp.issubdtype(vals.dtype, jnp.integer):
             v64 = jnp.asarray(vals, jnp.int64)
@@ -231,6 +270,10 @@ def seg_count(live, gid, num_groups: int, *, sorted_gid: bool = False):
 def _seg_minmax(vals, gid, num_groups: int, is_min: bool, identity,
                 sorted_gid: bool):
     vals = jnp.asarray(vals)
+    if num_groups == 1:
+        m = jnp.asarray(gid, jnp.int32) == 0
+        masked = jnp.where(m, vals, jnp.asarray(identity, vals.dtype))
+        return (jnp.min if is_min else jnp.max)(masked, keepdims=True)
     if _use_mxu():
         if num_groups <= _bcast_groups_max():
             return _seg_minmax_bcast(vals, gid, num_groups, is_min, identity)

@@ -109,9 +109,12 @@ config.define("dense_agg_domain_max", 0, True,
               "max bounded group-key domain covered by a dense packed-gid "
               "aggregation capacity (0 = auto by backend)")
 config.define("segment_strategy", "auto", True,
-              "auto | mxu | scatter: auto picks the MXU-friendly scatter-free "
-              "strategies on TPU and plain scatters on CPU (where they are "
-              "orders of magnitude faster); mxu/scatter force one side")
+              "auto | mxu | scatter | pallas: auto picks the MXU-friendly "
+              "scatter-free strategies on TPU and plain scatters on CPU "
+              "(where they are orders of magnitude faster); mxu/scatter "
+              "force one side; pallas routes float segment sums through the "
+              "explicit Pallas kernel (interpret-mode on CPU) — flip this "
+              "on hardware to benchmark it")
 config.define("matmul_segsum_groups_max", 1024, True,
               "max group count for the one-hot-matmul segment-sum strategy")
 config.define("bcast_segreduce_groups_max", 64, True,

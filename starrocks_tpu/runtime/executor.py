@@ -200,15 +200,23 @@ class DeviceCache:
                 dataclasses.replace(f, name=f"{alias}.{c}", bounds=bounds))
             data.append(d)
             valid.append(v)
-        if reorder is None:
-            selv = np.arange(cap) < n
+        if placement is None and n == cap:
+            sel = None
         else:
-            shard_cap = cap // n_shards
-            selv = np.zeros(cap, dtype=bool)
-            for b in range(n_shards):
-                cnt = int(per_shard_rows[b])
-                selv[b * shard_cap : b * shard_cap + cnt] = True
-        sel = put(selv) if (placement is not None or n != cap) else None
+            # cached: building + transferring a capacity-sized mask per run
+            # costs ~50ms at 8M rows — invalidated with the columns on DML
+            sel_key = (handle.name, "__sel__", tag)
+            if sel_key not in self._cols:
+                if reorder is None:
+                    selv = np.arange(cap) < n
+                else:
+                    shard_cap = cap // n_shards
+                    selv = np.zeros(cap, dtype=bool)
+                    for b in range(n_shards):
+                        cnt = int(per_shard_rows[b])
+                        selv[b * shard_cap : b * shard_cap + cnt] = True
+                self._cols[sel_key] = (put(selv), None)
+            sel = self._cols[sel_key][0]
         return Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)
 
 

@@ -871,6 +871,7 @@ def estimate_rows(plan: LogicalPlan, catalog) -> float:
             # selective dimension join shrinks the probe for downstream ops.
             prod_l = prod_r = 1.0
             n_eq = n_res = 0
+            l_cols, r_cols = [], []
             for c in _conjuncts(plan.condition):
                 eq = None
                 if isinstance(c, Call) and c.fn == "eq" and len(c.args) == 2:
@@ -888,10 +889,23 @@ def estimate_rows(plan: LogicalPlan, catalog) -> float:
                     n_eq += 1
                     prod_l *= _key_ndv(plan.left, eq[0], l, catalog)
                     prod_r *= _key_ndv(plan.right, eq[1], r, catalog)
+                    l_cols.append(eq[0])
+                    r_cols.append(eq[1])
                 else:
                     n_res += 1
             if n_eq:
                 est = join_fan_rows(l, r, prod_l, prod_r, n_res)
+                # PK-FK override (see _pk_table_rows)
+                pk_cands = []
+                for rel, cols, this_r, other_r in (
+                        (plan.right, r_cols, r, l),
+                        (plan.left, l_cols, l, r)):
+                    tr = _pk_table_rows(rel, cols, catalog)
+                    if tr:
+                        pk_cands.append(
+                            other_r * this_r / tr * (0.25 ** n_res))
+                if pk_cands:
+                    est = max(min(pk_cands), 1.0)
                 if plan.kind == "left":
                     est = max(est, l)
                 return est
@@ -963,6 +977,32 @@ def join_fan_rows(l_rows: float, r_rows: float, prod_l: float, prod_r: float,
     fan = max(min(prod_l, max(l_rows, 1.0)),
               min(prod_r, max(r_rows, 1.0)), 1.0)
     return max(l_rows * r_rows / fan * (0.25 ** n_res), 1.0)
+
+
+def _pk_table_rows(rel, key_cols, catalog):
+    """If `key_cols` of `rel` cover a declared unique key of one base table,
+    return that table's TOTAL row count — `rel` is then the PK side of a
+    PK-FK join and each probe row matches at most |rel|/total rows. This is
+    the estimate the composite-NDV formula cannot recover (capping the FK
+    side's key-tuple NDV at its row count overstates it — lineitem's
+    (partkey, suppkey) tuples repeat ~7.5x — which understated
+    lineitem JOIN partsupp 7.5x and put the non-reducing partsupp join
+    first in Q9's DP order). Reference analog: FK-PK join estimation in
+    fe sql/optimizer/statistics/StatisticsCalculator.java."""
+    origins = [col_origin(rel, c) for c in key_cols]
+    if not origins or any(o is None for o in origins):
+        return None
+    tables = {t for t, _ in origins}
+    if len(tables) != 1:
+        return None
+    t = catalog.get_table(next(iter(tables)))
+    if t is None or not t.row_count:
+        return None
+    base_cols = {b for _, b in origins}
+    for uk in t.unique_keys:
+        if uk and set(uk) <= base_cols:
+            return float(t.row_count)
+    return None
 
 
 def _key_ndv(rel, name: str, est_rows: float, catalog) -> float:
@@ -1043,6 +1083,7 @@ def _dp_order(rels, conjuncts, catalog) -> LogicalPlan:
                     n_eq = 0
                     ready = []
                     has_eq = False
+                    a_ends, b_ends = [], []
                     for c, relmask, eq in infos:
                         if not (relmask and relmask & mask == relmask
                                 and relmask & amask and relmask & bmask):
@@ -1056,11 +1097,28 @@ def _dp_order(rels, conjuncts, catalog) -> LogicalPlan:
                                 ia, acol, ib, bcol = ib, bcol, ia, acol
                             prod_a *= max(leaf_ndv(ia, acol), 1.0)
                             prod_b *= max(leaf_ndv(ib, bcol), 1.0)
+                            a_ends.append((ia, acol))
+                            b_ends.append((ib, bcol))
                         else:
                             n_res += 1
                     if entry_has_eq and not ready:
                         continue  # cross joins only as a last resort
                     rows = join_fan_rows(ra, rb, prod_a, prod_b, n_res)
+                    # PK-FK override: when one side's eq cols cover a unique
+                    # key of a single leaf table, the join keeps the other
+                    # side's rows scaled by that side's retained fraction
+                    pk_cands = []
+                    for ends, this_r, other_r in ((b_ends, rb, ra),
+                                                  (a_ends, ra, rb)):
+                        if ends and len({lf for lf, _ in ends}) == 1:
+                            tr = _pk_table_rows(
+                                rels[ends[0][0]], [c for _, c in ends],
+                                catalog)
+                            if tr:
+                                pk_cands.append(
+                                    other_r * this_r / tr * (0.25 ** n_res))
+                    if pk_cands:
+                        rows = max(min(pk_cands), 1.0)
                     # build side (right) materializes a device-sorted table:
                     # a full-capacity argsort, single-threaded in XLA CPU and
                     # O(n log n) everywhere — bias hard toward small builds.

@@ -378,7 +378,7 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                     out = union_all(out, emit(child))
                 return out
             if isinstance(p, LAggregate):
-                c = maybe_compact(p.child, emit(p.child), str(ordinal(p)))
+                c0 = emit(p.child)
                 key = f"agg_{ordinal(p)}"
                 # a global (no-group-key) aggregation always yields one row;
                 # a 1024-slot capacity would pay a 1024-wide segment reduce
@@ -386,12 +386,22 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                 from ..ops.aggregate import bounded_domain
                 from ..runtime.config import config as _acfg
 
-                dom = bounded_domain(c, p.group_by)
+                dom = bounded_domain(c0, p.group_by)
                 if dom is not None and dom <= _dense_agg_domain_max(_acfg):
                     # dense bounded domain: capacity covers it outright, the
                     # sort-free packed-gid path applies at any cardinality
                     default = max(default, dom)
                 cap = caps.get(key, default)
+                # Compaction only pays when the aggregate must LEXSORT its
+                # input (cost scales with capacity). The no-group-key path
+                # and the packed-gid dense path are single fused passes over
+                # the chunk — compacting first would ADD a cumsum + one
+                # scatter per column for nothing.
+                sort_free = (not p.group_by) or (
+                    dom is not None and dom <= cap
+                    and not any(a.fn == "array_agg" for _, a in p.aggs))
+                c = c0 if sort_free else maybe_compact(
+                    p.child, c0, str(ordinal(p)))
                 kwargs = {}
                 if any(a.fn == "array_agg" for _, a in p.aggs):
                     akey = f"aggarr_{ordinal(p)}"

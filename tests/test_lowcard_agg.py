@@ -100,3 +100,22 @@ def test_pallas_segment_sum_matches_oracle():
         np.asarray(vals)[np.asarray(gid) == g].sum(axis=0) for g in range(G)
     ])
     np.testing.assert_allclose(np.asarray(ref), exp, rtol=1e-3, atol=1e-2)
+
+
+def test_pallas_strategy_end_to_end(cat):
+    """segment_strategy=pallas routes float segment sums through the Pallas
+    kernel (interpret mode on CPU) and the query still matches the default
+    strategy — the flag-flip correctness gate for real hardware."""
+    q = ("select l_returnflag, avg(l_discount) a, var_samp(l_discount) v "
+         "from lineitem group by l_returnflag order by 1")
+    base = Session(cat).sql(q).rows()
+    config.set("segment_strategy", "pallas")
+    try:
+        pal = Session(cat).sql(q).rows()
+    finally:
+        config.set("segment_strategy", "auto")
+    assert len(base) == len(pal)
+    for br, pr in zip(base, pal):
+        assert br[0] == pr[0]
+        for bv, pv in zip(br[1:], pr[1:]):
+            assert pv == pytest.approx(bv, rel=1e-5)

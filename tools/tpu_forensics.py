@@ -1,0 +1,203 @@
+"""Staged TPU-tunnel forensics (VERDICT r3 item 2).
+
+The axon PJRT plugin proxies device ops to a remote TPU terminal through a
+loopback relay (see /root/.axon_site/sitecustomize.py: JAX_PLATFORMS=axon,
+PALLAS_AXON_POOL_IPS=127.0.0.1, remote_compile=1). In rounds 1-3 the first
+device op hung indefinitely, so every benchmark fell back to CPU. This tool
+isolates WHICH layer wedges, each stage in its own subprocess with its own
+timeout + faulthandler stack dump:
+
+  relay-tcp      raw TCP connect to the relay port (no jax)
+  relay-http     HTTP GET / to the relay (is it an HTTP service at all?)
+  backend-init   import jax; jax.devices() — PJRT client init + enumeration
+  transfer       jax.device_put(np.arange(4)) + fetch — data plane
+  compile        jit(x+1)(x) — compile plane (remote_compile=1 → relay POST)
+  compile-local  same with PALLAS_AXON_REMOTE_COMPILE stripped — local compile
+
+Results land in TPU_PROBE.json (merged into BENCH_DETAIL.json by bench.py)
+so the round's failure signature is reproducible and diagnosable by the
+infra owner: run `python tools/tpu_forensics.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+RELAY_PORTS = (2024,)  # observed listening in the image (ss -tlnp)
+
+
+def _stage_subprocess(name, code, timeout_s, env_extra=None, results=None):
+    env = dict(os.environ)
+    if env_extra:
+        for k, v in env_extra.items():
+            if v is None:
+                env.pop(k, None)
+            else:
+                env[k] = v
+    wrapped = (
+        "import sys, faulthandler; faulthandler.dump_traceback_later("
+        f"{max(timeout_s - 5, 2)}, file=sys.stderr);\n" + code
+    )
+    t0 = time.time()
+    out = {"timeout_s": timeout_s}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", wrapped], capture_output=True,
+            timeout=timeout_s, text=True, env=env,
+        )
+        out.update(rc=r.returncode, stdout=r.stdout[-1500:],
+                   stderr=r.stderr[-2500:])
+        out["status"] = "ok" if r.returncode == 0 else "error"
+    except subprocess.TimeoutExpired as e:
+        se = e.stderr
+        if isinstance(se, bytes):
+            se = se.decode("utf-8", "replace")
+        so = e.stdout
+        if isinstance(so, bytes):
+            so = so.decode("utf-8", "replace")
+        out.update(status="timeout", stdout=(so or "")[-1500:],
+                   stderr=(se or "")[-2500:])
+    out["wall_s"] = round(time.time() - t0, 2)
+    if results is not None:
+        results[name] = out
+    return out
+
+
+def probe_relay(results):
+    for port in RELAY_PORTS:
+        key = f"relay-tcp:{port}"
+        t0 = time.time()
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            s.close()
+            results[key] = {"status": "ok", "wall_s": round(time.time() - t0, 3)}
+        except OSError as e:
+            results[key] = {"status": "error", "error": str(e)}
+        # speak minimal HTTP at it — remote_compile implies an HTTP surface
+        key = f"relay-http:{port}"
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            s.settimeout(5)
+            s.sendall(b"GET / HTTP/1.1\r\nHost: 127.0.0.1\r\n\r\n")
+            data = s.recv(512)
+            s.close()
+            results[key] = {
+                "status": "ok",
+                "first_bytes": data[:200].decode("utf-8", "replace"),
+            }
+        except OSError as e:
+            results[key] = {"status": "error", "error": str(e)}
+
+
+def deep_probe(results, hang_s=110, total_s=130):
+    """Run jax.devices() and, while it hangs, sample the child's thread
+    states (/proc wchan) — distinguishes a network wait from a retry loop.
+
+    Round-4 captured signature: hang is inside PJRT ``make_c_api_client``
+    (client INIT, before any device op); threads = main python in
+    hrtimer_nanosleep (a sleep-retry loop), tokio-rt-worker in ep_poll
+    (relay idle), axon-remote-loop in futex wait. I.e. the claim/bind
+    handshake with the pool never completes and the plugin retries
+    forever — matching the sitecustomize note about the bind loop
+    ("grant unclaimed past timeout — client lost"). Infra-side: the relay
+    accepts TCP but no grant ever arrives."""
+    import collections
+    import signal
+
+    code = ("import sys, faulthandler; faulthandler.dump_traceback_later("
+            f"{hang_s}, file=sys.stderr)\n"
+            "import jax; print([str(d) for d in jax.devices()], flush=True)")
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+    samples = []
+    t0 = time.time()
+    while time.time() - t0 < total_s:
+        time.sleep(5)
+        if p.poll() is not None:
+            break
+        try:
+            snap = []
+            for t in os.listdir(f"/proc/{p.pid}/task"):
+                try:
+                    wchan = open(f"/proc/{p.pid}/task/{t}/wchan").read().strip()
+                    name = open(f"/proc/{p.pid}/task/{t}/comm").read().strip()
+                    snap.append(f"{name}:{wchan}")
+                except OSError:
+                    pass
+            samples.append(snap)
+        except OSError:
+            break
+    hung = p.poll() is None
+    if hung:
+        p.send_signal(signal.SIGABRT)
+        time.sleep(2)
+        p.kill()
+    try:
+        out, err = p.communicate(timeout=10)
+    except subprocess.TimeoutExpired:
+        out, err = "", ""
+    hist = collections.Counter(x for s in samples for x in s)
+    results["deep-init"] = {
+        "status": "timeout" if hung else ("ok" if p.returncode == 0 else "error"),
+        "stdout": (out or "")[-500:],
+        "python_stack_at_timeout": (err or "")[-2000:],
+        "thread_wchan_histogram": dict(hist.most_common(10)),
+    }
+
+
+def main():
+    results: dict = {"env": {
+        k: v for k, v in os.environ.items()
+        if any(t in k for t in ("AXON", "TPU", "JAX", "PALLAS"))
+    }}
+    probe_relay(results)
+    _stage_subprocess(
+        "backend-init",
+        "import jax; ds = jax.devices(); print([str(d) for d in ds])",
+        60, results=results)
+    if results["backend-init"]["status"] == "timeout":
+        deep_probe(results)
+    if results["backend-init"]["status"] == "ok":
+        _stage_subprocess(
+            "transfer",
+            "import jax, numpy as np;"
+            "x = jax.device_put(np.arange(4));"
+            "print(np.asarray(x).tolist())",
+            90, results=results)
+        _stage_subprocess(
+            "compile",
+            "import jax, numpy as np;"
+            "f = jax.jit(lambda x: x + 1);"
+            "print(np.asarray(f(jax.device_put(np.arange(4)))).tolist())",
+            120, results=results)
+        if results.get("compile", {}).get("status") != "ok":
+            _stage_subprocess(
+                "compile-local",
+                "import jax, numpy as np;"
+                "f = jax.jit(lambda x: x + 1);"
+                "print(np.asarray(f(jax.device_put(np.arange(4)))).tolist())",
+                120, env_extra={"PALLAS_AXON_REMOTE_COMPILE": None},
+                results=results)
+    verdict = "wedged"
+    if results.get("compile", {}).get("status") == "ok":
+        verdict = "live"
+    elif results.get("backend-init", {}).get("status") != "ok":
+        verdict = "init-failure"
+    results["verdict"] = verdict
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "TPU_PROBE.json")
+    with open(path, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({k: v.get("status", "n/a") if isinstance(v, dict) else v
+                      for k, v in results.items() if k != "env"}))
+    return 0 if verdict == "live" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
