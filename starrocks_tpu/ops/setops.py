@@ -3,7 +3,8 @@
 Reference behavior: be/src/exec/union_node.h + pipeline union operators —
 concatenate child outputs positionally. On TPU: static concat of padded
 chunks; string dictionaries (trace-time constants) merge via constant remap
-gathers; numeric children must be pre-cast by the analyzer to a common type.
+gathers; mismatched numeric children coerce to their common supertype at
+trace time (_widen — the implicit set-op cast lattice).
 """
 
 from __future__ import annotations
@@ -14,14 +15,34 @@ from ..column.column import Chunk, Field, Schema
 from ..column.dict_encoding import StringDict
 
 
+def _widen(d, t, out):
+    """Re-represent column data of logical type `t` as logical type `out`
+    (the implicit set-op cast: int widening, decimal rescale, de-scale to
+    DOUBLE). Mirrors the reference's implicit cast on set operations
+    (fe sql/analyzer/SetOperationAnalyzer: children coerce to a common
+    type), applied trace-time because this engine types at trace."""
+    if t == out:
+        return d
+    if out.is_decimal:
+        d = jnp.asarray(d, jnp.int64)
+        scale = (out.scale or 0) - ((t.scale or 0) if t.is_decimal else 0)
+        return d * (10 ** scale)
+    if out.is_float and t.is_decimal:
+        return jnp.asarray(d, out.dtype) / (10 ** (t.scale or 0))
+    return jnp.asarray(d, out.dtype)
+
+
 def union_all(a: Chunk, b: Chunk) -> Chunk:
     """Concatenate two chunks positionally; output names follow `a`."""
+    from ..types import common_numeric_type
+
     assert len(a.schema) == len(b.schema), "UNION arity mismatch"
     fields, data, valid = [], [], []
     for i, (fa, fb) in enumerate(zip(a.schema.fields, b.schema.fields)):
         da, db = a.data[i], b.data[i]
         va, vb = a.valid[i], b.valid[i]
         dict_ = fa.dict
+        out_t = fa.type
         if fa.type.is_string or fb.type.is_string:
             assert fa.type.is_string and fb.type.is_string, "UNION type mismatch"
             if fa.dict is not None and fb.dict is not None and fa.dict is not fb.dict:
@@ -31,11 +52,10 @@ def union_all(a: Chunk, b: Chunk) -> Chunk:
                 da = jnp.asarray(ra)[jnp.clip(da, 0, na - 1)] if len(fa.dict) else da
                 db = jnp.asarray(rb)[jnp.clip(db, 0, nb - 1)] if len(fb.dict) else db
                 dict_ = merged
-        elif da.dtype != db.dtype:
-            raise AssertionError(
-                f"UNION column {i}: dtype {da.dtype} vs {db.dtype} — "
-                "analyzer must insert casts"
-            )
+        elif fa.type != fb.type or da.dtype != db.dtype:
+            out_t = common_numeric_type(fa.type, fb.type)
+            da = _widen(da, fa.type, out_t)
+            db = _widen(db, fb.type, out_t)
         data.append(jnp.concatenate([da, db]))
         if va is None and vb is None:
             valid.append(None)
@@ -43,7 +63,7 @@ def union_all(a: Chunk, b: Chunk) -> Chunk:
             va2 = jnp.ones((a.capacity,), jnp.bool_) if va is None else va
             vb2 = jnp.ones((b.capacity,), jnp.bool_) if vb is None else vb
             valid.append(jnp.concatenate([va2, vb2]))
-        fields.append(Field(fa.name, fa.type, True, dict_))
+        fields.append(Field(fa.name, out_t, True, dict_))
     sel = jnp.concatenate([a.sel_mask(), b.sel_mask()])
     return Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)
 

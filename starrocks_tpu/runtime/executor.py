@@ -492,7 +492,11 @@ class Executor:
                         for n, fn, a, *rest in p.funcs
                     ),
                 )
-            return p
+            # any other node (LUnion, LUnnest, ...): recurse structurally so
+            # markers under e.g. a UNION branch's HAVING still resolve
+            from ..sql.optimizer import _replace_children
+
+            return _replace_children(p, tuple(rec(c) for c in p.children))
 
         return rec(plan)
 

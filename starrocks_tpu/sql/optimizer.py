@@ -37,6 +37,7 @@ def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
     plan = pushdown_filters(plan)
     plan = rewrite_subqueries(plan, catalog)
     plan = pushdown_filters(plan)
+    plan = pushdown_semi_joins(plan, catalog)
     plan = reorder_joins(plan, catalog)
     plan = pushdown_filters(plan)
     plan = prune_columns(plan)
@@ -917,6 +918,47 @@ def estimate_rows(plan: LogicalPlan, catalog) -> float:
     if isinstance(plan, LUnion):
         return sum(estimate_rows(c, catalog) for c in plan.inputs)
     return 1000.0
+
+
+def pushdown_semi_joins(plan: LogicalPlan, catalog) -> LogicalPlan:
+    """Push SEMI/ANTI joins below inner joins toward the leaf their probe
+    keys come from: semi(A ⋈inner B, S on a-cols) == semi(A, S) ⋈inner B.
+    An IN/EXISTS filter then shrinks its source relation BEFORE the join
+    tree replays, instead of re-filtering the widest intermediate (TPC-H
+    Q18's o_orderkey IN (...) was probing a 6M-row 3-way join; pushed, it
+    filters 1.5M orders to the ~hundreds that qualify first). COST-GATED:
+    only fires when the semi's build side is estimated much smaller than
+    the target leaf — pushing a big build (Q21's EXISTS over 6M lineitem)
+    would move the expensive probe from a filtered intermediate to the full
+    leaf and double the runtime (measured 3.3s -> 6.5s ungated). Reference
+    analog: the CBO's semi-join reorder/pushdown transformations
+    (fe sql/optimizer/rule/transformation/SemiReorderRule.java)."""
+    new_children = tuple(pushdown_semi_joins(c, catalog)
+                         for c in plan.children)
+    plan = _replace_children(plan, new_children)
+    if (not isinstance(plan, LJoin) or plan.kind not in ("semi", "anti")
+            or plan.condition is None):
+        return plan
+    left = plan.left
+    if not (isinstance(left, LJoin) and left.kind == "inner"):
+        return plan
+    build_rows = estimate_rows(plan.right, catalog)
+    probe_cols = set()
+    for c in _conjuncts(plan.condition):
+        for col in expr_cols(c):
+            if col not in frozenset(plan.right.output_names()):
+                probe_cols.add(col)
+    for side in ("left", "right"):
+        child = getattr(left, side)
+        if probe_cols <= set(child.output_names()):
+            if build_rows * 4 > estimate_rows(child, catalog):
+                return plan  # build too big: filtering early wouldn't pay
+            pushed = pushdown_semi_joins(
+                LJoin(child, plan.right, plan.kind, plan.condition), catalog)
+            ll = pushed if side == "left" else left.left
+            rr = pushed if side == "right" else left.right
+            return LJoin(ll, rr, "inner", left.condition)
+    return plan
 
 
 def reorder_joins(plan: LogicalPlan, catalog) -> LogicalPlan:

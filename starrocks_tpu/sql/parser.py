@@ -620,6 +620,15 @@ class Parser:
         if t.kind == "kw" and t.value == "null":
             self.next()
             return None
+        if t.kind in ("kw", "ident") and t.value.lower() in (
+                "date", "timestamp"):
+            # typed literal: DATE 'YYYY-MM-DD' — the IN-list compiler coerces
+            # plain ISO strings against the tested column's temporal type
+            self.next()
+            s = self.next()
+            if s.kind != "string":
+                raise ParseError(f"{t.value.upper()} literal expects a string")
+            return s.value
         if t.kind == "op" and t.value == "-":
             self.next()
             v = self.parse_literal_value()

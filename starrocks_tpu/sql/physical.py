@@ -50,7 +50,10 @@ def _dense_agg_domain_max(cfg) -> int:
     v = cfg.get("dense_agg_domain_max")
     if v:
         return v
-    return (1 << 22) if jax.default_backend() == "cpu" else 4096
+    # CPU: must cover the TPC-H-scale dense PK domains (l_orderkey at SF1 is
+    # 6M) — a 6M-slot scatter-add is ~10ms there while the lexsort
+    # alternative is seconds (argsort is single-threaded in XLA CPU)
+    return (1 << 24) if jax.default_backend() == "cpu" else 4096
 
 
 # --- plan properties ---------------------------------------------------------
@@ -111,6 +114,12 @@ def dense_rf_range(plan_l, plan_r, probe_keys, build_keys, catalog,
         return None
     t = catalog.get_table(origin[0])
     if t is None:
+        return None
+    f = t.schema.field(origin[1]) if t.schema is not None else None
+    if f is None or f.type.is_string:
+        # dict-string stats bound RAW per-table codes; the join compares
+        # dictionary-ALIGNED codes, so a code-range membership test would
+        # silently drop rows whose merged code falls outside the raw range
         return None
     st = t.column_stats(origin[1])
     if st.min is None or st.max is None:
@@ -397,9 +406,11 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                 # and the packed-gid dense path are single fused passes over
                 # the chunk — compacting first would ADD a cumsum + one
                 # scatter per column for nothing.
-                sort_free = (not p.group_by) or (
-                    dom is not None and dom <= cap
-                    and not any(a.fn == "array_agg" for _, a in p.aggs))
+                # array_agg reads PHYSICAL slot positions (contiguity matters
+                # even with one global group) — it must see a compacted chunk
+                sort_free = (
+                    (not p.group_by) or (dom is not None and dom <= cap)
+                ) and not any(a.fn == "array_agg" for _, a in p.aggs)
                 c = c0 if sort_free else maybe_compact(
                     p.child, c0, str(ordinal(p)))
                 kwargs = {}

@@ -1874,3 +1874,954 @@ def test_q86(env):
         return lv[["total_sum", "i_category", "i_class", "lochierarchy",
                    "rank_within_parent"]]
     run(env, "q86", oracle, limit=None)
+
+
+# --- the year-over-year / cross-channel family (round 4) --------------------
+
+def test_q2(env):
+    def oracle(F):
+        ws = F["web_sales"][["ws_sold_date_sk", "ws_ext_sales_price"]].rename(
+            columns={"ws_sold_date_sk": "sk", "ws_ext_sales_price": "p"})
+        cs = F["catalog_sales"][
+            ["cs_sold_date_sk", "cs_ext_sales_price"]].rename(
+            columns={"cs_sold_date_sk": "sk", "cs_ext_sales_price": "p"})
+        u = pd.concat([ws, cs]).merge(
+            F["date_dim"], left_on="sk", right_on="d_date_sk")
+        piv = u.pivot_table(index="d_week_seq", columns="d_day_name",
+                            values="p", aggfunc="sum")
+        wk = F["date_dim"][["d_week_seq", "d_year"]].drop_duplicates()
+        days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+                "Friday", "Saturday"]
+        y = piv.reindex(columns=days).reset_index().merge(wk, on="d_week_seq")
+        a = y[y.d_year == 1999].copy()
+        b = y[y.d_year == 2000].copy()
+        b["join_seq"] = b.d_week_seq - 53
+        m = a.merge(b, left_on="d_week_seq", right_on="join_seq",
+                    suffixes=("_1", "_2"))
+        out = pd.DataFrame({"week1": m.d_week_seq_1})
+        for d in days:
+            out["r_" + d[:3].lower()] = m[d + "_1"] / m[d + "_2"]
+        return out.sort_values("week1")
+    run(env, "q2", oracle, limit=None)
+
+
+def _year_total(F, fact, cust_col, date_col, expr_fn, tag, years=None):
+    x = (F[fact]
+         .merge(F["customer"], left_on=cust_col, right_on="c_customer_sk")
+         .merge(F["date_dim"], left_on=date_col, right_on="d_date_sk"))
+    if years is not None:
+        x = x[x.d_year.isin(years)]
+    x = x.assign(val=expr_fn(x))
+    g = x.groupby(["c_customer_id", "c_first_name", "c_last_name", "d_year"],
+                  as_index=False)["val"].sum()
+    g["sale_type"] = tag
+    return g.rename(columns={"c_customer_id": "customer_id",
+                             "val": "year_total"})
+
+
+def _yoy_join(yt, chans, first=1999, sec=2000):
+    """Self-join year_total instances keyed by customer_id; returns dict of
+    per-(channel, year) frames indexed by customer_id."""
+    out = {}
+    for ch in chans:
+        for yr, nm in ((first, "first"), (sec, "sec")):
+            sub = yt[(yt.sale_type == ch) & (yt.d_year == yr)]
+            out[f"{ch}_{nm}"] = sub.set_index("customer_id")
+    return out
+
+
+def test_q4(env):
+    def oracle(F):
+        yt = pd.concat([
+            _year_total(F, "store_sales", "ss_customer_sk",
+                        "ss_sold_date_sk",
+                        lambda x: ((x.ss_ext_list_price
+                                    - x.ss_ext_wholesale_cost
+                                    - x.ss_ext_discount_amt)
+                                   + x.ss_ext_sales_price) / 2, "s"),
+            _year_total(F, "catalog_sales", "cs_bill_customer_sk",
+                        "cs_sold_date_sk",
+                        lambda x: ((x.cs_ext_list_price - x.cs_wholesale_cost
+                                    - x.cs_ext_discount_amt)
+                                   + x.cs_ext_sales_price) / 2, "c"),
+            _year_total(F, "web_sales", "ws_bill_customer_sk",
+                        "ws_sold_date_sk",
+                        lambda x: ((x.ws_ext_list_price
+                                    - x.ws_ext_wholesale_cost
+                                    - x.ws_ext_discount_amt)
+                                   + x.ws_ext_sales_price) / 2, "w"),
+        ])
+        t = _yoy_join(yt, "scw")
+        ids = (set(t["s_first"].index) & set(t["s_sec"].index)
+               & set(t["c_first"].index) & set(t["c_sec"].index)
+               & set(t["w_first"].index) & set(t["w_sec"].index))
+        rows = []
+        for cid in ids:
+            sf, ssec = t["s_first"].loc[cid], t["s_sec"].loc[cid]
+            cf, csec = t["c_first"].loc[cid], t["c_sec"].loc[cid]
+            wf, wsec = t["w_first"].loc[cid], t["w_sec"].loc[cid]
+            if not (sf.year_total > 0 and cf.year_total > 0
+                    and wf.year_total > 0):
+                continue
+            cr = csec.year_total / cf.year_total
+            sr = ssec.year_total / sf.year_total
+            wr = wsec.year_total / wf.year_total
+            if cr > sr and cr > wr:
+                rows.append((cid, ssec.c_first_name, ssec.c_last_name))
+        # ORDER BY customer_id is total (unique), LIMIT is deterministic
+        return pd.DataFrame(
+            rows, columns=["customer_id", "first", "last"]).sort_values(
+            "customer_id").head(100)
+    run(env, "q4", oracle, limit=None)
+
+
+def test_q11(env):
+    def oracle(F):
+        yt = pd.concat([
+            _year_total(F, "store_sales", "ss_customer_sk",
+                        "ss_sold_date_sk",
+                        lambda x: x.ss_ext_list_price - x.ss_ext_discount_amt,
+                        "s"),
+            _year_total(F, "web_sales", "ws_bill_customer_sk",
+                        "ws_sold_date_sk",
+                        lambda x: x.ws_ext_list_price - x.ws_ext_discount_amt,
+                        "w"),
+        ])
+        t = _yoy_join(yt, "sw")
+        ids = (set(t["s_first"].index) & set(t["s_sec"].index)
+               & set(t["w_first"].index) & set(t["w_sec"].index))
+        rows = []
+        for cid in ids:
+            sf, ssec = t["s_first"].loc[cid], t["s_sec"].loc[cid]
+            wf, wsec = t["w_first"].loc[cid], t["w_sec"].loc[cid]
+            if not (sf.year_total > 0 and wf.year_total > 0):
+                continue
+            if (wsec.year_total / wf.year_total
+                    > ssec.year_total / sf.year_total):
+                rows.append((cid, ssec.c_first_name, ssec.c_last_name))
+        cols = (["first", "last", "customer_id"] if "q11" == "q74"
+                else ["customer_id"])
+        return pd.DataFrame(
+            rows, columns=["customer_id", "first", "last"]).sort_values(
+            cols).head(100)
+    run(env, "q11", oracle, limit=None)
+
+
+def test_q74(env):
+    def oracle(F):
+        yt = pd.concat([
+            _year_total(F, "store_sales", "ss_customer_sk",
+                        "ss_sold_date_sk", lambda x: x.ss_net_paid, "s",
+                        years=(1999, 2000)),
+            _year_total(F, "web_sales", "ws_bill_customer_sk",
+                        "ws_sold_date_sk", lambda x: x.ws_net_paid, "w",
+                        years=(1999, 2000)),
+        ])
+        t = _yoy_join(yt, "sw")
+        ids = (set(t["s_first"].index) & set(t["s_sec"].index)
+               & set(t["w_first"].index) & set(t["w_sec"].index))
+        rows = []
+        for cid in ids:
+            sf, ssec = t["s_first"].loc[cid], t["s_sec"].loc[cid]
+            wf, wsec = t["w_first"].loc[cid], t["w_sec"].loc[cid]
+            if not (sf.year_total > 0 and wf.year_total > 0):
+                continue
+            if (wsec.year_total / wf.year_total
+                    > ssec.year_total / sf.year_total):
+                rows.append((cid, ssec.c_first_name, ssec.c_last_name))
+        cols = (["first", "last", "customer_id"] if "q74" == "q74"
+                else ["customer_id"])
+        return pd.DataFrame(
+            rows, columns=["customer_id", "first", "last"]).sort_values(
+            cols).head(100)
+    run(env, "q74", oracle, limit=None)
+
+
+def test_q97(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        dd = dd[(dd.d_month_seq >= 24) & (dd.d_month_seq <= 35)]
+        ss = F["store_sales"].merge(dd, left_on="ss_sold_date_sk",
+                                    right_on="d_date_sk")
+        ssci = ss[["ss_customer_sk", "ss_item_sk"]].drop_duplicates().rename(
+            columns={"ss_customer_sk": "customer_sk",
+                     "ss_item_sk": "item_sk"})
+        cs = F["catalog_sales"].merge(dd, left_on="cs_sold_date_sk",
+                                      right_on="d_date_sk")
+        csci = cs[["cs_bill_customer_sk", "cs_item_sk"]].drop_duplicates(
+            ).rename(columns={"cs_bill_customer_sk": "customer_sk",
+                              "cs_item_sk": "item_sk"})
+        m = ssci.merge(csci, on=["customer_sk", "item_sk"], how="outer",
+                       indicator=True)
+        return pd.DataFrame([{
+            "store_only": int((m._merge == "left_only").sum()),
+            "catalog_only": int((m._merge == "right_only").sum()),
+            "store_and_catalog": int((m._merge == "both").sum()),
+        }])
+    run(env, "q97", oracle, limit=None)
+
+
+def _rollup_channel(detail):
+    """ROLLUP (channel, id) over a detail frame with sales/returns/profit."""
+    return rollup_levels(
+        detail, ["channel", "id"],
+        lambda sub: {"sales": sub.sales.sum(),
+                     "returns_amt": sub.returns_amt.sum(),
+                     "profit": sub.profit.sum()})
+
+
+def test_q5(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        dd = dd[(dd.d_date_sk >= 2451100) & (dd.d_date_sk <= 2451114)]
+        ss = F["store_sales"]; sr = F["store_returns"]
+        s_part = pd.concat([
+            pd.DataFrame({"store_sk": ss.ss_store_sk,
+                          "date_sk": ss.ss_sold_date_sk,
+                          "sales_price": ss.ss_ext_sales_price,
+                          "profit": ss.ss_net_profit,
+                          "return_amt": 0.0, "net_loss": 0.0}),
+            pd.DataFrame({"store_sk": sr.sr_store_sk,
+                          "date_sk": sr.sr_returned_date_sk,
+                          "sales_price": 0.0, "profit": 0.0,
+                          "return_amt": sr.sr_return_amt,
+                          "net_loss": sr.sr_net_loss})])
+        s_part = (s_part.merge(dd, left_on="date_sk", right_on="d_date_sk")
+                  .merge(F["store"], left_on="store_sk",
+                         right_on="s_store_sk")
+                  .groupby("s_store_id", as_index=False)
+                  .agg(sales=("sales_price", "sum"),
+                       returns_amt=("return_amt", "sum"),
+                       profit=("profit", "sum"),
+                       profit_loss=("net_loss", "sum")))
+        cs = F["catalog_sales"]; cr = F["catalog_returns"]
+        c_part = pd.concat([
+            pd.DataFrame({"center_sk": cs.cs_call_center_sk,
+                          "date_sk": cs.cs_sold_date_sk,
+                          "sales_price": cs.cs_ext_sales_price,
+                          "profit": cs.cs_net_profit,
+                          "return_amt": 0.0, "net_loss": 0.0}),
+            pd.DataFrame({"center_sk": cr.cr_call_center_sk,
+                          "date_sk": cr.cr_returned_date_sk,
+                          "sales_price": 0.0, "profit": 0.0,
+                          "return_amt": cr.cr_return_amount,
+                          "net_loss": cr.cr_net_loss})])
+        c_part = (c_part.merge(dd, left_on="date_sk", right_on="d_date_sk")
+                  .merge(F["call_center"], left_on="center_sk",
+                         right_on="cc_call_center_sk")
+                  .groupby("cc_call_center_id", as_index=False)
+                  .agg(sales=("sales_price", "sum"),
+                       returns_amt=("return_amt", "sum"),
+                       profit=("profit", "sum"),
+                       profit_loss=("net_loss", "sum")))
+        wsl = F["web_sales"]; wrt = F["web_returns"]
+        wret = wrt.merge(wsl[["ws_item_sk", "ws_order_number",
+                              "ws_web_site_sk"]],
+                         left_on=["wr_item_sk", "wr_order_number"],
+                         right_on=["ws_item_sk", "ws_order_number"])
+        w_part = pd.concat([
+            pd.DataFrame({"site_sk": wsl.ws_web_site_sk,
+                          "date_sk": wsl.ws_sold_date_sk,
+                          "sales_price": wsl.ws_ext_sales_price,
+                          "profit": wsl.ws_net_profit,
+                          "return_amt": 0.0, "net_loss": 0.0}),
+            pd.DataFrame({"site_sk": wret.ws_web_site_sk,
+                          "date_sk": wret.wr_returned_date_sk,
+                          "sales_price": 0.0, "profit": 0.0,
+                          "return_amt": wret.wr_return_amt,
+                          "net_loss": wret.wr_net_loss})])
+        w_part = (w_part.merge(dd, left_on="date_sk", right_on="d_date_sk")
+                  .merge(F["web_site"], left_on="site_sk",
+                         right_on="web_site_sk")
+                  .groupby("web_site_id", as_index=False)
+                  .agg(sales=("sales_price", "sum"),
+                       returns_amt=("return_amt", "sum"),
+                       profit=("profit", "sum"),
+                       profit_loss=("net_loss", "sum")))
+        detail = pd.concat([
+            pd.DataFrame({"channel": "store channel", "id": s_part.s_store_id,
+                          "sales": s_part.sales,
+                          "returns_amt": s_part.returns_amt,
+                          "profit": s_part.profit - s_part.profit_loss}),
+            pd.DataFrame({"channel": "catalog channel",
+                          "id": c_part.cc_call_center_id,
+                          "sales": c_part.sales,
+                          "returns_amt": c_part.returns_amt,
+                          "profit": c_part.profit - c_part.profit_loss}),
+            pd.DataFrame({"channel": "web channel", "id": w_part.web_site_id,
+                          "sales": w_part.sales,
+                          "returns_amt": w_part.returns_amt,
+                          "profit": w_part.profit - w_part.profit_loss})])
+        out = _rollup_channel(detail)
+        return out[["channel", "id", "sales", "returns_amt", "profit"]]
+    run(env, "q5", oracle, limit=None)
+
+
+def test_q77(env):
+    def oracle(F):
+        lo, hi = 2451100, 2451129
+        dd = F["date_dim"]
+        dd = dd[(dd.d_date_sk >= lo) & (dd.d_date_sk <= hi)][["d_date_sk"]]
+        ss = (F["store_sales"]
+              .merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+              .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk")
+              .groupby("s_store_sk", as_index=False)
+              .agg(sales=("ss_ext_sales_price", "sum"),
+                   profit=("ss_net_profit", "sum")))
+        sr = (F["store_returns"]
+              .merge(dd, left_on="sr_returned_date_sk", right_on="d_date_sk")
+              .merge(F["store"], left_on="sr_store_sk", right_on="s_store_sk")
+              .groupby("s_store_sk", as_index=False)
+              .agg(returns_amt=("sr_return_amt", "sum"),
+                   profit_loss=("sr_net_loss", "sum")))
+        s = ss.merge(sr, on="s_store_sk", how="left")
+        cs = (F["catalog_sales"]
+              .merge(dd, left_on="cs_sold_date_sk", right_on="d_date_sk")
+              .groupby("cs_call_center_sk", as_index=False)
+              .agg(sales=("cs_ext_sales_price", "sum"),
+                   profit=("cs_net_profit", "sum")))
+        cr = (F["catalog_returns"]
+              .merge(dd, left_on="cr_returned_date_sk", right_on="d_date_sk")
+              .groupby("cr_call_center_sk", as_index=False)
+              .agg(returns_amt=("cr_return_amount", "sum"),
+                   profit_loss=("cr_net_loss", "sum")))
+        c = cs.merge(cr, left_on="cs_call_center_sk",
+                     right_on="cr_call_center_sk", how="left")
+        ws = (F["web_sales"]
+              .merge(dd, left_on="ws_sold_date_sk", right_on="d_date_sk")
+              .merge(F["web_page"], left_on="ws_web_page_sk",
+                     right_on="wp_web_page_sk")
+              .groupby("wp_web_page_sk", as_index=False)
+              .agg(sales=("ws_ext_sales_price", "sum"),
+                   profit=("ws_net_profit", "sum")))
+        wr = (F["web_returns"]
+              .merge(F["web_sales"][["ws_item_sk", "ws_order_number",
+                                     "ws_web_page_sk"]],
+                     left_on=["wr_item_sk", "wr_order_number"],
+                     right_on=["ws_item_sk", "ws_order_number"])
+              .merge(dd, left_on="wr_returned_date_sk", right_on="d_date_sk")
+              .merge(F["web_page"], left_on="ws_web_page_sk",
+                     right_on="wp_web_page_sk")
+              .groupby("wp_web_page_sk", as_index=False)
+              .agg(returns_amt=("wr_return_amt", "sum"),
+                   profit_loss=("wr_net_loss", "sum")))
+        w = ws.merge(wr, on="wp_web_page_sk", how="left")
+        def chan(df, name, idcol):
+            return pd.DataFrame({
+                "channel": name, "id": df[idcol], "sales": df.sales,
+                "returns_amt": df.returns_amt.fillna(0.0),
+                "profit": df.profit - df.profit_loss.fillna(0.0)})
+        detail = pd.concat([chan(s, "store channel", "s_store_sk"),
+                            chan(c, "catalog channel", "cs_call_center_sk"),
+                            chan(w, "web channel", "wp_web_page_sk")])
+        out = _rollup_channel(detail)
+        return out[["channel", "id", "sales", "returns_amt", "profit"]]
+    run(env, "q77", oracle, limit=None)
+
+
+def test_q80(env):
+    def oracle(F):
+        lo, hi = 2451100, 2451129
+        dd = F["date_dim"]
+        dd = dd[(dd.d_date_sk >= lo) & (dd.d_date_sk <= hi)][["d_date_sk"]]
+        it = F["item"][F["item"].i_current_price > 50][["i_item_sk"]]
+        pr = F["promotion"]
+        pr = pr[pr.p_channel_tv == "N"][["p_promo_sk"]]
+        def channel(sales, returns, skey, rkey, scol, rcol, idtab, idjoin,
+                    idcol, date_col, item_col, promo_col, sp, np_, ra, nl):
+            x = (sales.merge(returns, left_on=skey, right_on=rkey,
+                             how="left")
+                 .merge(dd, left_on=date_col, right_on="d_date_sk")
+                 .merge(idtab, left_on=idjoin[0], right_on=idjoin[1])
+                 .merge(it, left_on=item_col, right_on="i_item_sk")
+                 .merge(pr, left_on=promo_col, right_on="p_promo_sk"))
+            return (x.assign(
+                sales=x[sp], returns_amt=x[ra].fillna(0.0),
+                profit=x[np_] - x[nl].fillna(0.0))
+                .groupby(idcol, as_index=False)
+                .agg(sales=("sales", "sum"),
+                     returns_amt=("returns_amt", "sum"),
+                     profit=("profit", "sum"))
+                .rename(columns={idcol: "id"}))
+        s = channel(F["store_sales"], F["store_returns"],
+                    ["ss_item_sk", "ss_ticket_number"],
+                    ["sr_item_sk", "sr_ticket_number"], None, None,
+                    F["store"], ("ss_store_sk", "s_store_sk"), "s_store_id",
+                    "ss_sold_date_sk", "ss_item_sk", "ss_promo_sk",
+                    "ss_ext_sales_price", "ss_net_profit",
+                    "sr_return_amt", "sr_net_loss")
+        c = channel(F["catalog_sales"], F["catalog_returns"],
+                    ["cs_item_sk", "cs_order_number"],
+                    ["cr_item_sk", "cr_order_number"], None, None,
+                    F["call_center"],
+                    ("cs_call_center_sk", "cc_call_center_sk"),
+                    "cc_call_center_id",
+                    "cs_sold_date_sk", "cs_item_sk", "cs_promo_sk",
+                    "cs_ext_sales_price", "cs_net_profit",
+                    "cr_return_amount", "cr_net_loss")
+        w = channel(F["web_sales"], F["web_returns"],
+                    ["ws_item_sk", "ws_order_number"],
+                    ["wr_item_sk", "wr_order_number"], None, None,
+                    F["web_site"], ("ws_web_site_sk", "web_site_sk"),
+                    "web_site_id",
+                    "ws_sold_date_sk", "ws_item_sk", "ws_promo_sk",
+                    "ws_ext_sales_price", "ws_net_profit",
+                    "wr_return_amt", "wr_net_loss")
+        detail = pd.concat([s.assign(channel="store channel"),
+                            c.assign(channel="catalog channel"),
+                            w.assign(channel="web channel")])
+        out = _rollup_channel(detail)
+        return out[["channel", "id", "sales", "returns_amt", "profit"]]
+    run(env, "q80", oracle, limit=None)
+
+
+def test_q75(env):
+    def oracle(F):
+        it = F["item"]
+        it = it[it.i_category == "Electronics"]
+        def detail(sales, returns, skeys, rkeys, icol, dcol, qty, amt, rqty,
+                   ramt):
+            x = (sales.merge(it, left_on=icol, right_on="i_item_sk")
+                 .merge(F["date_dim"], left_on=dcol, right_on="d_date_sk")
+                 .merge(returns, left_on=skeys, right_on=rkeys, how="left"))
+            return pd.DataFrame({
+                "d_year": x.d_year, "i_brand_id": x.i_brand_id,
+                "i_class_id": x.i_class_id, "i_category_id": x.i_category_id,
+                "i_manufact_id": x.i_manufact_id,
+                "sales_cnt": x[qty] - x[rqty].fillna(0).astype(int),
+                "sales_amt": x[amt] - x[ramt].fillna(0.0)})
+        d = pd.concat([
+            detail(F["catalog_sales"], F["catalog_returns"],
+                   ["cs_order_number", "cs_item_sk"],
+                   ["cr_order_number", "cr_item_sk"], "cs_item_sk",
+                   "cs_sold_date_sk", "cs_quantity", "cs_ext_sales_price",
+                   "cr_return_quantity", "cr_return_amount"),
+            detail(F["store_sales"], F["store_returns"],
+                   ["ss_ticket_number", "ss_item_sk"],
+                   ["sr_ticket_number", "sr_item_sk"], "ss_item_sk",
+                   "ss_sold_date_sk", "ss_quantity", "ss_ext_sales_price",
+                   "sr_return_quantity", "sr_return_amt"),
+            detail(F["web_sales"], F["web_returns"],
+                   ["ws_order_number", "ws_item_sk"],
+                   ["wr_order_number", "wr_item_sk"], "ws_item_sk",
+                   "ws_sold_date_sk", "ws_quantity", "ws_ext_sales_price",
+                   "wr_return_quantity", "wr_return_amt"),
+        ]).drop_duplicates()  # UNION (not ALL)
+        g = d.groupby(["d_year", "i_brand_id", "i_class_id", "i_category_id",
+                       "i_manufact_id"], as_index=False).agg(
+            sales_cnt=("sales_cnt", "sum"), sales_amt=("sales_amt", "sum"))
+        cur = g[g.d_year == 2000]
+        prv = g[g.d_year == 1999]
+        m = cur.merge(prv, on=["i_brand_id", "i_class_id", "i_category_id",
+                               "i_manufact_id"], suffixes=("_c", "_p"))
+        m = m[m.sales_cnt_c / m.sales_cnt_p < 0.9]
+        out = pd.DataFrame({
+            "prev_year": m.d_year_p, "year": m.d_year_c,
+            "i_brand_id": m.i_brand_id, "i_class_id": m.i_class_id,
+            "i_category_id": m.i_category_id,
+            "i_manufact_id": m.i_manufact_id,
+            "prev_yr_cnt": m.sales_cnt_p, "curr_yr_cnt": m.sales_cnt_c,
+            "sales_cnt_diff": m.sales_cnt_c - m.sales_cnt_p,
+            "sales_amt_diff": m.sales_amt_c - m.sales_amt_p})
+        return out.sort_values(["sales_cnt_diff", "sales_amt_diff"]).head(100)
+    run(env, "q75", oracle, limit=None)
+
+
+def test_q78(env):
+    def oracle(F):
+        def chan(sales, returns, skeys, rkeys, rnull, dcol, ycol, icol, ccol,
+                 qty, wc, sp, pref):
+            x = (sales.merge(returns[list(rkeys)], left_on=list(skeys),
+                             right_on=list(rkeys), how="left")
+                 .merge(F["date_dim"], left_on=dcol, right_on="d_date_sk"))
+            x = x[x[rnull].isna()]
+            g = x.groupby(["d_year", icol, ccol], as_index=False).agg(
+                qty=(qty, "sum"), wc=(wc, "sum"), sp=(sp, "sum"))
+            return g.rename(columns={
+                "d_year": f"{pref}_sold_year", icol: f"{pref}_item_sk",
+                ccol: f"{pref}_customer_sk", "qty": f"{pref}_qty",
+                "wc": f"{pref}_wc", "sp": f"{pref}_sp"})
+        ws = chan(F["web_sales"], F["web_returns"],
+                  ("ws_order_number", "ws_item_sk"),
+                  ("wr_order_number", "wr_item_sk"), "wr_order_number",
+                  "ws_sold_date_sk", "d_year", "ws_item_sk",
+                  "ws_bill_customer_sk", "ws_quantity", "ws_wholesale_cost",
+                  "ws_sales_price", "ws")
+        cs = chan(F["catalog_sales"], F["catalog_returns"],
+                  ("cs_order_number", "cs_item_sk"),
+                  ("cr_order_number", "cr_item_sk"), "cr_order_number",
+                  "cs_sold_date_sk", "d_year", "cs_item_sk",
+                  "cs_bill_customer_sk", "cs_quantity", "cs_wholesale_cost",
+                  "cs_sales_price", "cs")
+        ss = chan(F["store_sales"], F["store_returns"],
+                  ("ss_ticket_number", "ss_item_sk"),
+                  ("sr_ticket_number", "sr_item_sk"), "sr_ticket_number",
+                  "ss_sold_date_sk", "d_year", "ss_item_sk",
+                  "ss_customer_sk", "ss_quantity", "ss_wholesale_cost",
+                  "ss_sales_price", "ss")
+        m = (ss.merge(ws, left_on=["ss_sold_year", "ss_item_sk",
+                                   "ss_customer_sk"],
+                      right_on=["ws_sold_year", "ws_item_sk",
+                                "ws_customer_sk"], how="left")
+             .merge(cs, left_on=["ss_sold_year", "ss_item_sk",
+                                 "ss_customer_sk"],
+                    right_on=["cs_sold_year", "cs_item_sk",
+                              "cs_customer_sk"], how="left"))
+        m = m[(m.ws_qty.fillna(0) > 0) | (m.cs_qty.fillna(0) > 0)]
+        m = m[m.ss_sold_year == 2000]
+        out = pd.DataFrame({
+            "customer": m.ss_customer_sk, "item": m.ss_item_sk,
+            "ss_qty": m.ss_qty,
+            "ratio": m.ss_qty / (m.ws_qty.fillna(0) + m.cs_qty.fillna(0)),
+            "other_chan_qty": (m.ws_qty.fillna(0)
+                               + m.cs_qty.fillna(0)).astype(int),
+            "other_chan_wholesale": m.ws_wc.fillna(0) + m.cs_wc.fillna(0),
+            "other_chan_sales_price": m.ws_sp.fillna(0) + m.cs_sp.fillna(0)})
+        return out.sort_values(["customer", "item"]).head(100)
+    run(env, "q78", oracle, limit=None)
+
+
+def test_q8(env):
+    def oracle(F):
+        ca = F["customer_address"]; c = F["customer"]
+        lit = {"AL", "IL", "MI", "TN", "CA", "NY"}
+        m = c.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        cnt = m[m.c_preferred_cust_flag == "Y"].groupby("ca_state").size()
+        good = (set(ca.ca_state.unique()) & lit
+                & set(cnt[cnt > 40].index))
+        dd = F["date_dim"]
+        x = (F["store_sales"]
+             .merge(dd[(dd.d_qoy == 2) & (dd.d_year == 1999)],
+                    left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(F["store"][F["store"].s_state.isin(good)],
+                    left_on="ss_store_sk", right_on="s_store_sk"))
+        return (x.groupby("s_store_name", as_index=False)["ss_net_profit"]
+                .sum().sort_values("s_store_name"))
+    run(env, "q8", oracle, limit=None)
+
+
+def test_q49(env):
+    def oracle(F):
+        def chan(name, sales, returns, skeys, rkeys, dcol, qty, rqty, amt,
+                 ramt, profit):
+            x = (sales.merge(returns, left_on=list(skeys),
+                             right_on=list(rkeys), how="left")
+                 .merge(F["date_dim"], left_on=dcol, right_on="d_date_sk"))
+            x = x[(x[ramt] > 100) & (x[profit] > 1) & (x[amt] > 0)
+                  & (x[qty] > 0) & (x.d_year == 2000)]
+            g = x.groupby(skeys[1] if "item" in skeys[1] else skeys[1],
+                          as_index=False).agg(
+                rq=(rqty, lambda s: s.fillna(0).sum()),
+                q=(qty, "sum"),
+                ra=(ramt, lambda s: s.fillna(0).sum()),
+                a=(amt, "sum"))
+            g["return_ratio"] = g.rq / g.q
+            g["currency_ratio"] = g.ra / g.a
+            g["return_rank"] = g.return_ratio.rank(method="min").astype(int)
+            g["currency_rank"] = g.currency_ratio.rank(
+                method="min").astype(int)
+            g = g[(g.return_rank <= 10) | (g.currency_rank <= 10)]
+            out = pd.DataFrame({
+                "channel": name, "item": g.iloc[:, 0],
+                "return_ratio": g.return_ratio,
+                "return_rank": g.return_rank,
+                "currency_rank": g.currency_rank})
+            return out
+        w = chan("web", F["web_sales"], F["web_returns"],
+                 ("ws_order_number", "ws_item_sk"),
+                 ("wr_order_number", "wr_item_sk"), "ws_sold_date_sk",
+                 "ws_quantity", "wr_return_quantity", "ws_net_paid",
+                 "wr_return_amt", "ws_net_profit")
+        c = chan("catalog", F["catalog_sales"], F["catalog_returns"],
+                 ("cs_order_number", "cs_item_sk"),
+                 ("cr_order_number", "cr_item_sk"), "cs_sold_date_sk",
+                 "cs_quantity", "cr_return_quantity", "cs_ext_sales_price",
+                 "cr_return_amount", "cs_net_profit")
+        s = chan("store", F["store_sales"], F["store_returns"],
+                 ("ss_ticket_number", "ss_item_sk"),
+                 ("sr_ticket_number", "sr_item_sk"), "ss_sold_date_sk",
+                 "ss_quantity", "sr_return_quantity", "ss_net_paid",
+                 "sr_return_amt", "ss_net_profit")
+        return pd.concat([w, c, s]).drop_duplicates()
+    run(env, "q49", oracle, limit=None)
+
+
+def test_q54(env):
+    def oracle(F):
+        it = F["item"]
+        it = it[(it.i_category == "Music") & (it.i_class == "class01")]
+        dd = F["date_dim"]
+        sel = dd[(dd.d_moy == 3) & (dd.d_year == 2000)]
+        u = pd.concat([
+            F["catalog_sales"][["cs_sold_date_sk", "cs_bill_customer_sk",
+                                "cs_item_sk"]].rename(columns={
+                "cs_sold_date_sk": "sold_date_sk",
+                "cs_bill_customer_sk": "customer_sk",
+                "cs_item_sk": "item_sk"}),
+            F["web_sales"][["ws_sold_date_sk", "ws_bill_customer_sk",
+                            "ws_item_sk"]].rename(columns={
+                "ws_sold_date_sk": "sold_date_sk",
+                "ws_bill_customer_sk": "customer_sk",
+                "ws_item_sk": "item_sk"})])
+        mc = (u.merge(sel, left_on="sold_date_sk", right_on="d_date_sk")
+              .merge(it, left_on="item_sk", right_on="i_item_sk")
+              .merge(F["customer"], left_on="customer_sk",
+                     right_on="c_customer_sk"))
+        mc = mc[["c_customer_sk", "c_current_addr_sk"]].drop_duplicates()
+        ms = int(sel.d_month_seq.iloc[0])
+        dr = dd[(dd.d_month_seq >= ms + 1) & (dd.d_month_seq <= ms + 3)]
+        rev = (mc.merge(F["store_sales"], left_on="c_customer_sk",
+                        right_on="ss_customer_sk")
+               .merge(F["customer_address"], left_on="c_current_addr_sk",
+                      right_on="ca_address_sk")
+               .merge(F["store"], left_on=["ca_county", "ca_state"],
+                      right_on=["s_county", "s_state"])
+               .merge(dr, left_on="ss_sold_date_sk", right_on="d_date_sk"))
+        g = rev.groupby("c_customer_sk")["ss_ext_sales_price"].sum()
+        seg = (g / 50).astype(int)
+        out = seg.value_counts().rename_axis("segment").reset_index(
+            name="num_customers")
+        out["segment_base"] = out.segment * 50
+        return out.sort_values(["segment", "num_customers"])
+    run(env, "q54", oracle, limit=None)
+
+
+def test_q56(env):
+    def oracle(F):
+        it = F["item"]
+        ids = it[it.i_color.isin(["blue", "khaki", "plum"])].i_item_id
+        itx = it[it.i_item_id.isin(set(ids))]
+        dd = F["date_dim"]
+        dd = dd[(dd.d_year == 2000) & (dd.d_moy == 2)]
+        ca = F["customer_address"]
+        ca = ca[ca.ca_gmt_offset == -5]
+        def chan(fact, icol, dcol, acol, amt):
+            x = (F[fact].merge(itx, left_on=icol, right_on="i_item_sk")
+                 .merge(dd, left_on=dcol, right_on="d_date_sk")
+                 .merge(ca, left_on=acol, right_on="ca_address_sk"))
+            return x.groupby("i_item_id", as_index=False)[amt].sum().rename(
+                columns={amt: "total_sales"})
+        u = pd.concat([
+            chan("store_sales", "ss_item_sk", "ss_sold_date_sk",
+                 "ss_addr_sk", "ss_ext_sales_price"),
+            chan("catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+                 "cs_bill_addr_sk", "cs_ext_sales_price"),
+            chan("web_sales", "ws_item_sk", "ws_sold_date_sk",
+                 "ws_bill_addr_sk", "ws_ext_sales_price")])
+        g = u.groupby("i_item_id", as_index=False).total_sales.sum()
+        return g.sort_values(["total_sales", "i_item_id"]).head(100)
+    run(env, "q56", oracle, limit=None)
+
+
+def test_q57(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        x = (F["catalog_sales"]
+             .merge(F["item"], left_on="cs_item_sk", right_on="i_item_sk")
+             .merge(dd, left_on="cs_sold_date_sk", right_on="d_date_sk")
+             .merge(F["call_center"], left_on="cs_call_center_sk",
+                    right_on="cc_call_center_sk"))
+        x = x[(x.d_year == 1999) | ((x.d_year == 1998) & (x.d_moy == 12))
+              | ((x.d_year == 2000) & (x.d_moy == 1))]
+        v1 = x.groupby(["i_category", "i_brand", "cc_name", "d_year",
+                        "d_moy"], as_index=False)["cs_sales_price"].sum(
+            ).rename(columns={"cs_sales_price": "sum_sales"})
+        v1["avg_monthly_sales"] = v1.groupby(
+            ["i_category", "i_brand", "cc_name", "d_year"]
+        ).sum_sales.transform("mean")
+        v1 = v1.sort_values(["d_year", "d_moy"])
+        v1["rn"] = v1.groupby(["i_category", "i_brand", "cc_name"]
+                              ).cumcount() + 1
+        lag = v1.copy(); lag["rn"] = lag.rn + 1
+        lead = v1.copy(); lead["rn"] = lead.rn - 1
+        m = (v1.merge(lag, on=["i_category", "i_brand", "cc_name", "rn"],
+                      suffixes=("", "_lag"))
+             .merge(lead, on=["i_category", "i_brand", "cc_name", "rn"],
+                    suffixes=("", "_lead")))
+        m = m[(m.d_year == 1999) & (m.avg_monthly_sales > 0)]
+        m = m[abs(m.sum_sales - m.avg_monthly_sales)
+              / m.avg_monthly_sales > 0.1]
+        return pd.DataFrame({
+            "i_category": m.i_category, "i_brand": m.i_brand,
+            "cc_name": m.cc_name, "d_year": m.d_year, "d_moy": m.d_moy,
+            "avg_monthly_sales": m.avg_monthly_sales,
+            "sum_sales": m.sum_sales, "psum": m.sum_sales_lag,
+            "nsum": m.sum_sales_lead})
+    run(env, "q57", oracle, limit=None)
+
+
+def test_q14(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        win = dd[(dd.d_year >= 1999) & (dd.d_year <= 2001)]
+        it = F["item"]
+        def bcc(fact, icol, dcol):
+            x = (F[fact].merge(it, left_on=icol, right_on="i_item_sk")
+                 .merge(win, left_on=dcol, right_on="d_date_sk"))
+            return set(map(tuple, x[["i_brand_id", "i_class_id",
+                                     "i_category_id"]].values))
+        common = (bcc("store_sales", "ss_item_sk", "ss_sold_date_sk")
+                  & bcc("catalog_sales", "cs_item_sk", "cs_sold_date_sk")
+                  & bcc("web_sales", "ws_item_sk", "ws_sold_date_sk"))
+        cross = set(it[[tuple(r) in common for r in
+                        it[["i_brand_id", "i_class_id", "i_category_id"]
+                           ].values]].i_item_sk)
+        vals = []
+        for fact, icol, dcol, q, lp in (
+                ("store_sales", "ss_item_sk", "ss_sold_date_sk",
+                 "ss_quantity", "ss_list_price"),
+                ("catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+                 "cs_quantity", "cs_list_price"),
+                ("web_sales", "ws_item_sk", "ws_sold_date_sk",
+                 "ws_quantity", "ws_list_price")):
+            x = F[fact].merge(win, left_on=dcol, right_on="d_date_sk")
+            vals.append(x[q] * x[lp])
+        avg_sales = pd.concat(vals).mean()
+        sel = dd[(dd.d_year == 2001) & (dd.d_moy == 11)]
+        frames = []
+        for name, fact, icol, dcol, q, lp in (
+                ("store", "store_sales", "ss_item_sk", "ss_sold_date_sk",
+                 "ss_quantity", "ss_list_price"),
+                ("catalog", "catalog_sales", "cs_item_sk",
+                 "cs_sold_date_sk", "cs_quantity", "cs_list_price"),
+                ("web", "web_sales", "ws_item_sk", "ws_sold_date_sk",
+                 "ws_quantity", "ws_list_price")):
+            x = (F[fact][F[fact][icol].isin(cross)]
+                 .merge(it, left_on=icol, right_on="i_item_sk")
+                 .merge(sel, left_on=dcol, right_on="d_date_sk"))
+            x = x.assign(v=x[q] * x[lp])
+            g = x.groupby(["i_brand_id", "i_class_id", "i_category_id"],
+                          as_index=False).agg(sales=("v", "sum"),
+                                              number_sales=("v", "size"))
+            g = g[g.sales > avg_sales]
+            g["channel"] = name
+            frames.append(g)
+        detail = pd.concat(frames)
+        out = rollup_levels(
+            detail, ["channel", "i_brand_id", "i_class_id", "i_category_id"],
+            lambda sub: {"sales": sub.sales.sum(),
+                         "number_sales": sub.number_sales.sum()})
+        return out[["channel", "i_brand_id", "i_class_id", "i_category_id",
+                    "sales", "number_sales"]]
+    run(env, "q14", oracle, limit=None)
+
+
+def test_q23(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        win = dd[dd.d_year.isin([1999, 2000])]
+        freq = (F["store_sales"]
+                .merge(win, left_on="ss_sold_date_sk", right_on="d_date_sk")
+                .merge(F["item"], left_on="ss_item_sk", right_on="i_item_sk")
+                .groupby("i_item_sk").size())
+        freq_items = set(freq[freq > 4].index)
+        spend = (F["store_sales"]
+                 .merge(F["customer"], left_on="ss_customer_sk",
+                        right_on="c_customer_sk")
+                 .merge(win, left_on="ss_sold_date_sk", right_on="d_date_sk"))
+        spend = spend.assign(v=spend.ss_quantity * spend.ss_sales_price)
+        csales = spend.groupby("c_customer_sk").v.sum()
+        cmax = csales.max()
+        all_spend = F["store_sales"].merge(
+            F["customer"], left_on="ss_customer_sk",
+            right_on="c_customer_sk")
+        all_spend = all_spend.assign(
+            v=all_spend.ss_quantity * all_spend.ss_sales_price)
+        best = all_spend.groupby("c_customer_sk").v.sum()
+        best_customers = set(best[best > 0.5 * cmax].index)
+        sel = dd[(dd.d_year == 2000) & (dd.d_moy == 3)]
+        total = 0.0
+        for fact, dcol, icol, ccol, q, lp in (
+                ("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                 "cs_bill_customer_sk", "cs_quantity", "cs_list_price"),
+                ("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                 "ws_bill_customer_sk", "ws_quantity", "ws_list_price")):
+            x = F[fact].merge(sel, left_on=dcol, right_on="d_date_sk")
+            x = x[x[icol].isin(freq_items) & x[ccol].isin(best_customers)]
+            total += (x[q] * x[lp]).sum()
+        return pd.DataFrame([{"total_sales": total}])
+    run(env, "q23", oracle, limit=None)
+
+
+def test_q24(env):
+    def oracle(F):
+        st = F["store"]
+        st = st[(st.s_number_employees >= 200)
+                & (st.s_number_employees <= 290)]
+        x = (F["store_sales"]
+             .merge(F["store_returns"],
+                    left_on=["ss_ticket_number", "ss_item_sk"],
+                    right_on=["sr_ticket_number", "sr_item_sk"])
+             .merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(F["item"], left_on="ss_item_sk", right_on="i_item_sk")
+             .merge(F["customer"], left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+             .merge(F["customer_address"], left_on="c_current_addr_sk",
+                    right_on="ca_address_sk"))
+        x = x[x.s_state == x.ca_state]
+        ssales = x.groupby(["c_last_name", "c_first_name", "s_store_name",
+                            "i_color"], as_index=False)["ss_net_paid"].sum(
+            ).rename(columns={"ss_net_paid": "netpaid"})
+        thresh = 0.05 * ssales.netpaid.mean()
+        pink = ssales[ssales.i_color == "pink"]
+        g = pink.groupby(["c_last_name", "c_first_name", "s_store_name"],
+                         as_index=False).netpaid.sum()
+        return g[g.netpaid > thresh].rename(columns={"netpaid": "paid"})
+    run(env, "q24", oracle, limit=None)
+
+
+def test_q64(env):
+    def oracle(F):
+        cr = F["catalog_returns"]
+        m = F["catalog_sales"].merge(
+            cr, left_on=["cs_item_sk", "cs_order_number"],
+            right_on=["cr_item_sk", "cr_order_number"])
+        m = m.assign(refund=m.cr_refunded_cash + m.cr_net_loss)
+        g = m.groupby("cs_item_sk", as_index=False).agg(
+            sale=("cs_ext_list_price", "sum"), refund=("refund", "sum"))
+        cs_ui = set(g[g.sale > 2 * g.refund].cs_item_sk)
+        it = F["item"]
+        it = it[it.i_color.isin(["green", "red", "blue", "pink", "white",
+                                 "black"])
+                & (it.i_current_price >= 1) & (it.i_current_price <= 100)]
+        x = (F["store_sales"]
+             .merge(F["store_returns"],
+                    left_on=["ss_item_sk", "ss_ticket_number"],
+                    right_on=["sr_item_sk", "sr_ticket_number"])
+             .merge(F["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(it, left_on="ss_item_sk", right_on="i_item_sk"))
+        x = x[x.ss_item_sk.isin(cs_ui)]
+        cs = x.groupby(["i_product_name", "i_item_sk", "s_store_name",
+                        "d_year"], as_index=False).agg(
+            cnt=("ss_item_sk", "size"), s1=("ss_wholesale_cost", "sum"),
+            s2=("ss_list_price", "sum"), s3=("ss_coupon_amt", "sum"))
+        a = cs[cs.d_year == 1999]
+        b = cs[cs.d_year == 2000]
+        m2 = a.merge(b, on=["i_item_sk", "s_store_name"],
+                     suffixes=("_1", "_2"))
+        m2 = m2[m2.cnt_2 <= m2.cnt_1]
+        return pd.DataFrame({
+            "product_name": m2.i_product_name_1, "store_name": m2.s_store_name,
+            "year1": m2.d_year_1, "year2": m2.d_year_2,
+            "cnt1": m2.cnt_1, "cnt2": m2.cnt_2,
+            "s11": m2.s1_1, "s21": m2.s2_1, "s31": m2.s3_1,
+            "s12": m2.s1_2, "s22": m2.s2_2, "s32": m2.s3_2})
+    run(env, "q64", oracle, limit=None)
+
+
+def test_q70(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        dd = dd[(dd.d_month_seq >= 24) & (dd.d_month_seq <= 35)]
+        x = (F["store_sales"]
+             .merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(F["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+        per_state = x.groupby("s_state").ss_net_profit.sum()
+        ranked = per_state.rank()  # rank within partition of itself == 1
+        good = set(per_state.index)  # ranking <= 5 always true per-state
+        x = x[x.s_state.isin(good)]
+        out = rollup_levels(
+            x, ["s_state", "s_county"],
+            lambda sub: {"total_sum": sub.ss_net_profit.sum()})
+        out["lochierarchy"] = out.s_state.isna().astype(int) \
+            + out.s_county.isna().astype(int)
+        return out[["total_sum", "s_state", "s_county", "lochierarchy"]]
+    run(env, "q70", oracle, limit=None)
+
+
+def test_q72(env):
+    def oracle(F):
+        cd = F["customer_demographics"]
+        cd = cd[cd.cd_marital_status == "D"]
+        hd = F["household_demographics"]
+        hd = hd[hd.hd_buy_potential == ">10000"]
+        dd = F["date_dim"]
+        x = (F["catalog_sales"]
+             .merge(F["inventory"], left_on="cs_item_sk",
+                    right_on="inv_item_sk")
+             .merge(F["warehouse"], left_on="inv_warehouse_sk",
+                    right_on="w_warehouse_sk")
+             .merge(F["item"], left_on="cs_item_sk", right_on="i_item_sk")
+             .merge(cd, left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+             .merge(hd, left_on="cs_bill_hdemo_sk", right_on="hd_demo_sk")
+             .merge(dd.add_suffix("_1"), left_on="cs_sold_date_sk",
+                    right_on="d_date_sk_1")
+             .merge(dd.add_suffix("_2"), left_on="inv_date_sk",
+                    right_on="d_date_sk_2")
+             .merge(dd.add_suffix("_3"), left_on="cs_ship_date_sk",
+                    right_on="d_date_sk_3")
+             .merge(F["promotion"], left_on="cs_promo_sk",
+                    right_on="p_promo_sk", how="left")
+             .merge(F["catalog_returns"],
+                    left_on=["cs_item_sk", "cs_order_number"],
+                    right_on=["cr_item_sk", "cr_order_number"], how="left"))
+        x = x[(x.d_week_seq_1 == x.d_week_seq_2)
+              & (x.inv_quantity_on_hand < x.cs_quantity)
+              & (x.d_date_sk_3 > x.d_date_sk_1 + 5)
+              & (x.d_year_1 == 1999)]
+        g = x.groupby(["i_item_desc", "w_warehouse_name", "d_week_seq_1"],
+                      as_index=False).agg(
+            no_promo=("p_promo_sk", lambda s: int(s.isna().sum())),
+            promo=("p_promo_sk", lambda s: int(s.notna().sum())),
+            total_cnt=("p_promo_sk", "size"))
+        g = g.sort_values(
+            ["total_cnt", "i_item_desc", "w_warehouse_name", "d_week_seq_1"],
+            ascending=[False, True, True, True]).head(100)
+        return g
+    run(env, "q72", oracle, limit=None)
+
+
+def test_q83(env):
+    def oracle(F):
+        dd = F["date_dim"]
+        dates = pd.to_datetime(["2000-06-30", "2000-09-27", "2000-11-17"])
+        weeks = set(dd[dd.d_date.isin(dates)].d_week_seq)
+        sks = set(dd[dd.d_week_seq.isin(weeks)].d_date_sk)
+        def items(fact, icol, dcol, qty):
+            x = F[fact][F[fact][dcol].isin(sks)].merge(
+                F["item"], left_on=icol, right_on="i_item_sk")
+            return x.groupby("i_item_id")[qty].sum()
+        sr = items("store_returns", "sr_item_sk", "sr_returned_date_sk",
+                   "sr_return_quantity")
+        cr = items("catalog_returns", "cr_item_sk", "cr_returned_date_sk",
+                   "cr_return_quantity")
+        wr = items("web_returns", "wr_item_sk", "wr_returned_date_sk",
+                   "wr_return_quantity")
+        ids = set(sr.index) & set(cr.index) & set(wr.index)
+        rows = []
+        for i in sorted(ids):
+            s, c, w = sr[i], cr[i], wr[i]
+            tot = s + c + w
+            rows.append((i, s, s / tot / 3.0 * 100, c, c / tot / 3.0 * 100,
+                         w, w / tot / 3.0 * 100, tot / 3.0))
+        return pd.DataFrame(rows, columns=[
+            "item_id", "sr_item_qty", "sr_dev", "cr_item_qty", "cr_dev",
+            "wr_item_qty", "wr_dev", "average"])
+    run(env, "q83", oracle, limit=None)
+
+
+def test_q95(env):
+    def oracle(F):
+        ws = F["web_sales"]
+        multi = (ws.groupby("ws_order_number").ws_warehouse_sk.nunique())
+        ws_wh = set(multi[multi > 1].index)
+        dd = F["date_dim"]
+        dd = dd[(dd.d_date >= pd.Timestamp("2000-02-01"))
+                & (dd.d_date <= pd.Timestamp("2000-04-01"))]
+        ca = F["customer_address"]
+        wsite = F["web_site"]
+        x = (ws.merge(dd, left_on="ws_ship_date_sk", right_on="d_date_sk")
+             .merge(ca[ca.ca_state == "IL"], left_on="ws_bill_addr_sk",
+                    right_on="ca_address_sk")
+             .merge(wsite[wsite.web_company_name == "pri0"],
+                    left_on="ws_web_site_sk", right_on="web_site_sk"))
+        returned = set(F["web_returns"].wr_order_number) & ws_wh
+        x = x[x.ws_order_number.isin(ws_wh)
+              & x.ws_order_number.isin(returned)]
+        return pd.DataFrame([{
+            "order_count": x.ws_order_number.nunique(),
+            "total_shipping_cost": x.ws_ext_list_price.sum(),
+            "total_net_profit": x.ws_net_profit.sum()}])
+    run(env, "q95", oracle, limit=None)

@@ -1611,3 +1611,963 @@ GROUP BY ROLLUP (i_category, i_class)
 ORDER BY lochierarchy DESC, i_category, i_class
 LIMIT 100
 """
+
+QUERIES["q2"] = """
+WITH wscs AS (
+  SELECT ws_sold_date_sk AS sold_date_sk, ws_ext_sales_price AS sales_price
+  FROM web_sales
+  UNION ALL
+  SELECT cs_sold_date_sk, cs_ext_sales_price FROM catalog_sales),
+wswscs AS (
+  SELECT d_week_seq,
+    sum(CASE WHEN d_day_name = 'Sunday' THEN sales_price ELSE NULL END)
+      AS sun_sales,
+    sum(CASE WHEN d_day_name = 'Monday' THEN sales_price ELSE NULL END)
+      AS mon_sales,
+    sum(CASE WHEN d_day_name = 'Tuesday' THEN sales_price ELSE NULL END)
+      AS tue_sales,
+    sum(CASE WHEN d_day_name = 'Wednesday' THEN sales_price ELSE NULL END)
+      AS wed_sales,
+    sum(CASE WHEN d_day_name = 'Thursday' THEN sales_price ELSE NULL END)
+      AS thu_sales,
+    sum(CASE WHEN d_day_name = 'Friday' THEN sales_price ELSE NULL END)
+      AS fri_sales,
+    sum(CASE WHEN d_day_name = 'Saturday' THEN sales_price ELSE NULL END)
+      AS sat_sales
+  FROM wscs, date_dim WHERE d_date_sk = sold_date_sk GROUP BY d_week_seq),
+wk AS (SELECT DISTINCT d_week_seq, d_year FROM date_dim)
+SELECT y.d_week_seq AS week1,
+       y.sun_sales / z.sun_sales AS r_sun, y.mon_sales / z.mon_sales AS r_mon,
+       y.tue_sales / z.tue_sales AS r_tue, y.wed_sales / z.wed_sales AS r_wed,
+       y.thu_sales / z.thu_sales AS r_thu, y.fri_sales / z.fri_sales AS r_fri,
+       y.sat_sales / z.sat_sales AS r_sat
+FROM wswscs y, wk wky, wswscs z, wk wkz
+WHERE y.d_week_seq = wky.d_week_seq AND wky.d_year = 1999
+  AND z.d_week_seq = wkz.d_week_seq AND wkz.d_year = 2000
+  AND y.d_week_seq = z.d_week_seq - 53
+ORDER BY y.d_week_seq
+"""
+
+# q4/q11/q74: the year_total family (3/2/2-channel year-over-year customer
+# growth, 6/4/4-way CTE self joins). catalog_sales has no cs_ext_wholesale_cost
+# in the generated subset; cs_wholesale_cost substitutes (same type).
+QUERIES["q4"] = """
+WITH year_total AS (
+  SELECT c_customer_id AS customer_id, c_first_name, c_last_name, d_year,
+         sum(((ss_ext_list_price - ss_ext_wholesale_cost
+               - ss_ext_discount_amt) + ss_ext_sales_price) / 2)
+           AS year_total,
+         's' AS sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         sum(((cs_ext_list_price - cs_wholesale_cost - cs_ext_discount_amt)
+              + cs_ext_sales_price) / 2),
+         'c'
+  FROM customer, catalog_sales, date_dim
+  WHERE c_customer_sk = cs_bill_customer_sk AND cs_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         sum(((ws_ext_list_price - ws_ext_wholesale_cost
+               - ws_ext_discount_amt) + ws_ext_sales_price) / 2),
+         'w'
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.c_first_name,
+       t_s_secyear.c_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_c_firstyear, year_total t_c_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_c_firstyear.sale_type = 'c'
+  AND t_w_firstyear.sale_type = 'w' AND t_s_secyear.sale_type = 's'
+  AND t_c_secyear.sale_type = 'c' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.d_year = 1999 AND t_s_secyear.d_year = 2000
+  AND t_c_firstyear.d_year = 1999 AND t_c_secyear.d_year = 2000
+  AND t_w_firstyear.d_year = 1999 AND t_w_secyear.d_year = 2000
+  AND t_s_firstyear.year_total > 0 AND t_c_firstyear.year_total > 0
+  AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_c_firstyear.year_total > 0
+           THEN t_c_secyear.year_total / t_c_firstyear.year_total
+           ELSE NULL END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total / t_s_firstyear.year_total
+             ELSE NULL END
+  AND CASE WHEN t_c_firstyear.year_total > 0
+           THEN t_c_secyear.year_total / t_c_firstyear.year_total
+           ELSE NULL END
+      > CASE WHEN t_w_firstyear.year_total > 0
+             THEN t_w_secyear.year_total / t_w_firstyear.year_total
+             ELSE NULL END
+ORDER BY t_s_secyear.customer_id, t_s_secyear.c_first_name,
+         t_s_secyear.c_last_name
+LIMIT 100
+"""
+
+QUERIES["q11"] = """
+WITH year_total AS (
+  SELECT c_customer_id AS customer_id, c_first_name, c_last_name, d_year,
+         sum(ss_ext_list_price - ss_ext_discount_amt) AS year_total,
+         's' AS sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         sum(ws_ext_list_price - ws_ext_discount_amt), 'w'
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.c_first_name,
+       t_s_secyear.c_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.d_year = 1999 AND t_s_secyear.d_year = 2000
+  AND t_w_firstyear.d_year = 1999 AND t_w_secyear.d_year = 2000
+  AND t_s_firstyear.year_total > 0 AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_w_firstyear.year_total > 0
+           THEN t_w_secyear.year_total / t_w_firstyear.year_total
+           ELSE 0.0 END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total / t_s_firstyear.year_total
+             ELSE 0.0 END
+ORDER BY t_s_secyear.customer_id, t_s_secyear.c_first_name,
+         t_s_secyear.c_last_name
+LIMIT 100
+"""
+
+QUERIES["q74"] = """
+WITH year_total AS (
+  SELECT c_customer_id AS customer_id, c_first_name, c_last_name, d_year,
+         sum(ss_net_paid) AS year_total, 's' AS sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year IN (1999, 2000)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         sum(ws_net_paid), 'w'
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year IN (1999, 2000)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.c_first_name,
+       t_s_secyear.c_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.d_year = 1999 AND t_s_secyear.d_year = 2000
+  AND t_w_firstyear.d_year = 1999 AND t_w_secyear.d_year = 2000
+  AND t_s_firstyear.year_total > 0 AND t_w_firstyear.year_total > 0
+  AND t_w_secyear.year_total / t_w_firstyear.year_total
+      > t_s_secyear.year_total / t_s_firstyear.year_total
+ORDER BY t_s_secyear.c_first_name, t_s_secyear.c_last_name,
+         t_s_secyear.customer_id
+LIMIT 100
+"""
+
+QUERIES["q97"] = """
+WITH ssci AS (
+  SELECT ss_customer_sk AS customer_sk, ss_item_sk AS item_sk
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 24 AND 35
+  GROUP BY ss_customer_sk, ss_item_sk),
+csci AS (
+  SELECT cs_bill_customer_sk AS customer_sk, cs_item_sk AS item_sk
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 24 AND 35
+  GROUP BY cs_bill_customer_sk, cs_item_sk)
+SELECT sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NULL THEN 1 ELSE 0 END)
+         AS store_only,
+       sum(CASE WHEN ssci.customer_sk IS NULL
+                 AND csci.customer_sk IS NOT NULL THEN 1 ELSE 0 END)
+         AS catalog_only,
+       sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NOT NULL THEN 1 ELSE 0 END)
+         AS store_and_catalog
+FROM ssci FULL OUTER JOIN csci
+  ON ssci.customer_sk = csci.customer_sk AND ssci.item_sk = csci.item_sk
+"""
+
+# q5/q77/q80: per-channel sales+returns rollups. The generated subset has no
+# cp_catalog_page_sk on catalog_returns, so the catalog channel ids are call
+# centers; web returns reach their site/page through the sales-side join
+# (wr_order_number+wr_item_sk), as in the official wsr definition.
+QUERIES["q5"] = """
+WITH ssr AS (
+  SELECT s_store_id AS id, sum(sales_price) AS sales,
+         sum(return_amt) AS returns_amt, sum(profit) AS profit,
+         sum(net_loss) AS profit_loss
+  FROM (SELECT ss_store_sk AS store_sk, ss_sold_date_sk AS date_sk,
+               ss_ext_sales_price AS sales_price, ss_net_profit AS profit,
+               0.0 AS return_amt, 0.0 AS net_loss
+        FROM store_sales
+        UNION ALL
+        SELECT sr_store_sk, sr_returned_date_sk, 0.0, 0.0,
+               sr_return_amt, sr_net_loss
+        FROM store_returns) salesreturns, date_dim, store
+  WHERE date_sk = d_date_sk AND d_date_sk BETWEEN 2451100 AND 2451114
+    AND store_sk = s_store_sk
+  GROUP BY s_store_id),
+csr AS (
+  SELECT cc_call_center_id AS id, sum(sales_price) AS sales,
+         sum(return_amt) AS returns_amt, sum(profit) AS profit,
+         sum(net_loss) AS profit_loss
+  FROM (SELECT cs_call_center_sk AS center_sk, cs_sold_date_sk AS date_sk,
+               cs_ext_sales_price AS sales_price, cs_net_profit AS profit,
+               0.0 AS return_amt, 0.0 AS net_loss
+        FROM catalog_sales
+        UNION ALL
+        SELECT cr_call_center_sk, cr_returned_date_sk, 0.0, 0.0,
+               cr_return_amount, cr_net_loss
+        FROM catalog_returns) salesreturns, date_dim, call_center
+  WHERE date_sk = d_date_sk AND d_date_sk BETWEEN 2451100 AND 2451114
+    AND center_sk = cc_call_center_sk
+  GROUP BY cc_call_center_id),
+wsr AS (
+  SELECT web_site_id AS id, sum(sales_price) AS sales,
+         sum(return_amt) AS returns_amt, sum(profit) AS profit,
+         sum(net_loss) AS profit_loss
+  FROM (SELECT ws_web_site_sk AS site_sk, ws_sold_date_sk AS date_sk,
+               ws_ext_sales_price AS sales_price, ws_net_profit AS profit,
+               0.0 AS return_amt, 0.0 AS net_loss
+        FROM web_sales
+        UNION ALL
+        SELECT ws_web_site_sk, wr_returned_date_sk, 0.0, 0.0,
+               wr_return_amt, wr_net_loss
+        FROM web_returns, web_sales
+        WHERE wr_item_sk = ws_item_sk AND wr_order_number = ws_order_number
+       ) salesreturns, date_dim, web_site
+  WHERE date_sk = d_date_sk AND d_date_sk BETWEEN 2451100 AND 2451114
+    AND site_sk = web_site_sk
+  GROUP BY web_site_id)
+SELECT channel, id, sum(sales) AS sales, sum(returns_amt) AS returns_amt,
+       sum(profit) AS profit
+FROM (SELECT 'store channel' AS channel, id, sales, returns_amt,
+             profit - profit_loss AS profit FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', id, sales, returns_amt,
+             profit - profit_loss FROM csr
+      UNION ALL
+      SELECT 'web channel', id, sales, returns_amt,
+             profit - profit_loss FROM wsr) x
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel, id
+"""
+
+QUERIES["q77"] = """
+WITH ss AS (
+  SELECT s_store_sk, sum(ss_ext_sales_price) AS sales,
+         sum(ss_net_profit) AS profit
+  FROM store_sales, date_dim, store
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date_sk BETWEEN 2451100 AND 2451129
+    AND ss_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+sr AS (
+  SELECT s_store_sk, sum(sr_return_amt) AS returns_amt,
+         sum(sr_net_loss) AS profit_loss
+  FROM store_returns, date_dim, store
+  WHERE sr_returned_date_sk = d_date_sk
+    AND d_date_sk BETWEEN 2451100 AND 2451129
+    AND sr_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+cs AS (
+  SELECT cs_call_center_sk, sum(cs_ext_sales_price) AS sales,
+         sum(cs_net_profit) AS profit
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date_sk BETWEEN 2451100 AND 2451129
+  GROUP BY cs_call_center_sk),
+cr AS (
+  SELECT cr_call_center_sk, sum(cr_return_amount) AS returns_amt,
+         sum(cr_net_loss) AS profit_loss
+  FROM catalog_returns, date_dim
+  WHERE cr_returned_date_sk = d_date_sk
+    AND d_date_sk BETWEEN 2451100 AND 2451129
+  GROUP BY cr_call_center_sk),
+ws AS (
+  SELECT wp_web_page_sk, sum(ws_ext_sales_price) AS sales,
+         sum(ws_net_profit) AS profit
+  FROM web_sales, date_dim, web_page
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date_sk BETWEEN 2451100 AND 2451129
+    AND ws_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk),
+wr AS (
+  SELECT wp_web_page_sk, sum(wr_return_amt) AS returns_amt,
+         sum(wr_net_loss) AS profit_loss
+  FROM web_returns, web_sales, date_dim, web_page
+  WHERE wr_item_sk = ws_item_sk AND wr_order_number = ws_order_number
+    AND wr_returned_date_sk = d_date_sk
+    AND d_date_sk BETWEEN 2451100 AND 2451129
+    AND ws_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk)
+SELECT channel, id, sum(sales) AS sales, sum(returns_amt) AS returns_amt,
+       sum(profit) AS profit
+FROM (SELECT 'store channel' AS channel, ss.s_store_sk AS id, sales,
+             coalesce(returns_amt, 0.0) AS returns_amt,
+             profit - coalesce(profit_loss, 0.0) AS profit
+      FROM ss LEFT JOIN sr ON ss.s_store_sk = sr.s_store_sk
+      UNION ALL
+      SELECT 'catalog channel', cs.cs_call_center_sk, sales,
+             coalesce(returns_amt, 0.0),
+             profit - coalesce(profit_loss, 0.0)
+      FROM cs LEFT JOIN cr ON cs.cs_call_center_sk = cr.cr_call_center_sk
+      UNION ALL
+      SELECT 'web channel', ws.wp_web_page_sk, sales,
+             coalesce(returns_amt, 0.0),
+             profit - coalesce(profit_loss, 0.0)
+      FROM ws LEFT JOIN wr ON ws.wp_web_page_sk = wr.wp_web_page_sk) x
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel, id
+"""
+
+QUERIES["q80"] = """
+WITH ssr AS (
+  SELECT s_store_id AS id,
+         sum(ss_ext_sales_price) AS sales,
+         sum(coalesce(sr_return_amt, 0.0)) AS returns_amt,
+         sum(ss_net_profit - coalesce(sr_net_loss, 0.0)) AS profit
+  FROM store_sales
+  LEFT JOIN store_returns ON ss_item_sk = sr_item_sk
+                          AND ss_ticket_number = sr_ticket_number
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  JOIN store ON ss_store_sk = s_store_sk
+  JOIN item ON ss_item_sk = i_item_sk
+  JOIN promotion ON ss_promo_sk = p_promo_sk
+  WHERE d_date_sk BETWEEN 2451100 AND 2451129
+    AND i_current_price > 50 AND p_channel_tv = 'N'
+  GROUP BY s_store_id),
+csr AS (
+  SELECT cc_call_center_id AS id,
+         sum(cs_ext_sales_price) AS sales,
+         sum(coalesce(cr_return_amount, 0.0)) AS returns_amt,
+         sum(cs_net_profit - coalesce(cr_net_loss, 0.0)) AS profit
+  FROM catalog_sales
+  LEFT JOIN catalog_returns ON cs_item_sk = cr_item_sk
+                            AND cs_order_number = cr_order_number
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+  JOIN call_center ON cs_call_center_sk = cc_call_center_sk
+  JOIN item ON cs_item_sk = i_item_sk
+  JOIN promotion ON cs_promo_sk = p_promo_sk
+  WHERE d_date_sk BETWEEN 2451100 AND 2451129
+    AND i_current_price > 50 AND p_channel_tv = 'N'
+  GROUP BY cc_call_center_id),
+wsr AS (
+  SELECT web_site_id AS id,
+         sum(ws_ext_sales_price) AS sales,
+         sum(coalesce(wr_return_amt, 0.0)) AS returns_amt,
+         sum(ws_net_profit - coalesce(wr_net_loss, 0.0)) AS profit
+  FROM web_sales
+  LEFT JOIN web_returns ON ws_item_sk = wr_item_sk
+                        AND ws_order_number = wr_order_number
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+  JOIN web_site ON ws_web_site_sk = web_site_sk
+  JOIN item ON ws_item_sk = i_item_sk
+  JOIN promotion ON ws_promo_sk = p_promo_sk
+  WHERE d_date_sk BETWEEN 2451100 AND 2451129
+    AND i_current_price > 50 AND p_channel_tv = 'N'
+  GROUP BY web_site_id)
+SELECT channel, id, sum(sales) AS sales, sum(returns_amt) AS returns_amt,
+       sum(profit) AS profit
+FROM (SELECT 'store channel' AS channel, id, sales, returns_amt, profit
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', id, sales, returns_amt, profit FROM csr
+      UNION ALL
+      SELECT 'web channel', id, sales, returns_amt, profit FROM wsr) x
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel, id
+"""
+
+QUERIES["q75"] = """
+WITH all_sales AS (
+  SELECT d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+         sum(sales_cnt) AS sales_cnt, sum(sales_amt) AS sales_amt
+  FROM (SELECT d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+               cs_quantity - coalesce(cr_return_quantity, 0) AS sales_cnt,
+               cs_ext_sales_price - coalesce(cr_return_amount, 0.0)
+                 AS sales_amt
+        FROM catalog_sales
+        JOIN item ON i_item_sk = cs_item_sk
+        JOIN date_dim ON d_date_sk = cs_sold_date_sk
+        LEFT JOIN catalog_returns ON cs_order_number = cr_order_number
+                                  AND cs_item_sk = cr_item_sk
+        WHERE i_category = 'Electronics'
+        UNION
+        SELECT d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+               ss_quantity - coalesce(sr_return_quantity, 0),
+               ss_ext_sales_price - coalesce(sr_return_amt, 0.0)
+        FROM store_sales
+        JOIN item ON i_item_sk = ss_item_sk
+        JOIN date_dim ON d_date_sk = ss_sold_date_sk
+        LEFT JOIN store_returns ON ss_ticket_number = sr_ticket_number
+                                AND ss_item_sk = sr_item_sk
+        WHERE i_category = 'Electronics'
+        UNION
+        SELECT d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+               ws_quantity - coalesce(wr_return_quantity, 0),
+               ws_ext_sales_price - coalesce(wr_return_amt, 0.0)
+        FROM web_sales
+        JOIN item ON i_item_sk = ws_item_sk
+        JOIN date_dim ON d_date_sk = ws_sold_date_sk
+        LEFT JOIN web_returns ON ws_order_number = wr_order_number
+                              AND ws_item_sk = wr_item_sk
+        WHERE i_category = 'Electronics') sales_detail
+  GROUP BY d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id)
+SELECT prev_yr.d_year AS prev_year, curr_yr.d_year AS year,
+       curr_yr.i_brand_id, curr_yr.i_class_id, curr_yr.i_category_id,
+       curr_yr.i_manufact_id, prev_yr.sales_cnt AS prev_yr_cnt,
+       curr_yr.sales_cnt AS curr_yr_cnt,
+       curr_yr.sales_cnt - prev_yr.sales_cnt AS sales_cnt_diff,
+       curr_yr.sales_amt - prev_yr.sales_amt AS sales_amt_diff
+FROM all_sales curr_yr, all_sales prev_yr
+WHERE curr_yr.i_brand_id = prev_yr.i_brand_id
+  AND curr_yr.i_class_id = prev_yr.i_class_id
+  AND curr_yr.i_category_id = prev_yr.i_category_id
+  AND curr_yr.i_manufact_id = prev_yr.i_manufact_id
+  AND curr_yr.d_year = 2000 AND prev_yr.d_year = 1999
+  AND cast(curr_yr.sales_cnt AS double) / cast(prev_yr.sales_cnt AS double)
+      < 0.9
+ORDER BY sales_cnt_diff, sales_amt_diff
+LIMIT 100
+"""
+
+QUERIES["q78"] = """
+WITH ws AS (
+  SELECT d_year AS ws_sold_year, ws_item_sk,
+         ws_bill_customer_sk AS ws_customer_sk,
+         sum(ws_quantity) AS ws_qty, sum(ws_wholesale_cost) AS ws_wc,
+         sum(ws_sales_price) AS ws_sp
+  FROM web_sales
+  LEFT JOIN web_returns ON wr_order_number = ws_order_number
+                        AND ws_item_sk = wr_item_sk
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+  WHERE wr_order_number IS NULL
+  GROUP BY d_year, ws_item_sk, ws_bill_customer_sk),
+cs AS (
+  SELECT d_year AS cs_sold_year, cs_item_sk,
+         cs_bill_customer_sk AS cs_customer_sk,
+         sum(cs_quantity) AS cs_qty, sum(cs_wholesale_cost) AS cs_wc,
+         sum(cs_sales_price) AS cs_sp
+  FROM catalog_sales
+  LEFT JOIN catalog_returns ON cr_order_number = cs_order_number
+                            AND cs_item_sk = cr_item_sk
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+  WHERE cr_order_number IS NULL
+  GROUP BY d_year, cs_item_sk, cs_bill_customer_sk),
+ss AS (
+  SELECT d_year AS ss_sold_year, ss_item_sk,
+         ss_customer_sk,
+         sum(ss_quantity) AS ss_qty, sum(ss_wholesale_cost) AS ss_wc,
+         sum(ss_sales_price) AS ss_sp
+  FROM store_sales
+  LEFT JOIN store_returns ON sr_ticket_number = ss_ticket_number
+                          AND ss_item_sk = sr_item_sk
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  WHERE sr_ticket_number IS NULL
+  GROUP BY d_year, ss_item_sk, ss_customer_sk)
+SELECT ss.ss_customer_sk, ss.ss_item_sk, ss_qty,
+       ss_qty / (coalesce(ws_qty, 0) + coalesce(cs_qty, 0)) AS ratio,
+       coalesce(ws_qty, 0) + coalesce(cs_qty, 0) AS other_chan_qty,
+       coalesce(ws_wc, 0.0) + coalesce(cs_wc, 0.0) AS other_chan_wholesale,
+       coalesce(ws_sp, 0.0) + coalesce(cs_sp, 0.0) AS other_chan_sales_price
+FROM ss
+LEFT JOIN ws ON ws.ws_sold_year = ss.ss_sold_year
+             AND ws.ws_item_sk = ss.ss_item_sk
+             AND ws.ws_customer_sk = ss.ss_customer_sk
+LEFT JOIN cs ON cs.cs_sold_year = ss.ss_sold_year
+             AND cs.cs_item_sk = ss.ss_item_sk
+             AND cs.cs_customer_sk = ss.ss_customer_sk
+WHERE (coalesce(ws_qty, 0) > 0 OR coalesce(cs_qty, 0) > 0)
+  AND ss.ss_sold_year = 2000
+ORDER BY ss.ss_customer_sk, ss.ss_item_sk
+LIMIT 100
+"""
+
+# q8: store has no s_zip in the generated subset — the zip-prefix
+# neighborhood match becomes a state match (same shape: literal list
+# INTERSECT states with enough preferred customers, joined to stores).
+QUERIES["q8"] = """
+WITH qualified_states AS (
+  SELECT ca_state FROM customer_address
+  WHERE ca_state IN ('AL', 'IL', 'MI', 'TN', 'CA', 'NY')
+  INTERSECT
+  SELECT ca_state FROM
+   (SELECT ca_state, count(*) AS cnt
+    FROM customer_address, customer
+    WHERE ca_address_sk = c_current_addr_sk
+      AND c_preferred_cust_flag = 'Y'
+    GROUP BY ca_state HAVING count(*) > 40) a)
+SELECT s_store_name, sum(ss_net_profit) AS profit
+FROM store_sales, date_dim, store, qualified_states
+WHERE ss_sold_date_sk = d_date_sk AND d_qoy = 2 AND d_year = 1999
+  AND ss_store_sk = s_store_sk AND s_state = ca_state
+GROUP BY s_store_name
+ORDER BY s_store_name
+"""
+
+QUERIES["q49"] = """
+SELECT channel, item, return_ratio, return_rank, currency_rank FROM
+ (SELECT 'web' AS channel, web.item, web.return_ratio, web.return_rank,
+         web.currency_rank
+  FROM (SELECT item, return_ratio, currency_ratio,
+               rank() OVER (ORDER BY return_ratio) AS return_rank,
+               rank() OVER (ORDER BY currency_ratio) AS currency_rank
+        FROM (SELECT ws_item_sk AS item,
+                     cast(sum(coalesce(wr_return_quantity, 0)) AS double)
+                     / cast(sum(coalesce(ws_quantity, 0)) AS double)
+                       AS return_ratio,
+                     cast(sum(coalesce(wr_return_amt, 0.0)) AS double)
+                     / cast(sum(coalesce(ws_net_paid, 0.0)) AS double)
+                       AS currency_ratio
+              FROM web_sales
+              LEFT JOIN web_returns ON ws_order_number = wr_order_number
+                                    AND ws_item_sk = wr_item_sk, date_dim
+              WHERE wr_return_amt > 100 AND ws_net_profit > 1
+                AND ws_net_paid > 0 AND ws_quantity > 0
+                AND ws_sold_date_sk = d_date_sk AND d_year = 2000
+              GROUP BY ws_item_sk) in_web) web
+  WHERE web.return_rank <= 10 OR web.currency_rank <= 10
+  UNION
+  SELECT 'catalog', c.item, c.return_ratio, c.return_rank, c.currency_rank
+  FROM (SELECT item, return_ratio, currency_ratio,
+               rank() OVER (ORDER BY return_ratio) AS return_rank,
+               rank() OVER (ORDER BY currency_ratio) AS currency_rank
+        FROM (SELECT cs_item_sk AS item,
+                     cast(sum(coalesce(cr_return_quantity, 0)) AS double)
+                     / cast(sum(coalesce(cs_quantity, 0)) AS double)
+                       AS return_ratio,
+                     cast(sum(coalesce(cr_return_amount, 0.0)) AS double)
+                     / cast(sum(coalesce(cs_ext_sales_price, 0.0)) AS double)
+                       AS currency_ratio
+              FROM catalog_sales
+              LEFT JOIN catalog_returns ON cs_order_number = cr_order_number
+                                        AND cs_item_sk = cr_item_sk, date_dim
+              WHERE cr_return_amount > 100 AND cs_net_profit > 1
+                AND cs_ext_sales_price > 0 AND cs_quantity > 0
+                AND cs_sold_date_sk = d_date_sk AND d_year = 2000
+              GROUP BY cs_item_sk) in_cat) c
+  WHERE c.return_rank <= 10 OR c.currency_rank <= 10
+  UNION
+  SELECT 'store', s.item, s.return_ratio, s.return_rank, s.currency_rank
+  FROM (SELECT item, return_ratio, currency_ratio,
+               rank() OVER (ORDER BY return_ratio) AS return_rank,
+               rank() OVER (ORDER BY currency_ratio) AS currency_rank
+        FROM (SELECT ss_item_sk AS item,
+                     cast(sum(coalesce(sr_return_quantity, 0)) AS double)
+                     / cast(sum(coalesce(ss_quantity, 0)) AS double)
+                       AS return_ratio,
+                     cast(sum(coalesce(sr_return_amt, 0.0)) AS double)
+                     / cast(sum(coalesce(ss_net_paid, 0.0)) AS double)
+                       AS currency_ratio
+              FROM store_sales
+              LEFT JOIN store_returns ON ss_ticket_number = sr_ticket_number
+                                      AND ss_item_sk = sr_item_sk, date_dim
+              WHERE sr_return_amt > 100 AND ss_net_profit > 1
+                AND ss_net_paid > 0 AND ss_quantity > 0
+                AND ss_sold_date_sk = d_date_sk AND d_year = 2000
+              GROUP BY ss_item_sk) in_store) s
+  WHERE s.return_rank <= 10 OR s.currency_rank <= 10) x
+ORDER BY channel, return_rank, currency_rank, item
+"""
+
+QUERIES["q54"] = """
+WITH my_customers AS (
+  SELECT DISTINCT c_customer_sk, c_current_addr_sk
+  FROM (SELECT cs_sold_date_sk AS sold_date_sk,
+               cs_bill_customer_sk AS customer_sk, cs_item_sk AS item_sk
+        FROM catalog_sales
+        UNION ALL
+        SELECT ws_sold_date_sk, ws_bill_customer_sk, ws_item_sk
+        FROM web_sales) cs_or_ws_sales, item, date_dim, customer
+  WHERE sold_date_sk = d_date_sk AND item_sk = i_item_sk
+    AND i_category = 'Music' AND i_class = 'class01'
+    AND c_customer_sk = cs_or_ws_sales.customer_sk
+    AND d_moy = 3 AND d_year = 2000),
+my_revenue AS (
+  SELECT c_customer_sk, sum(ss_ext_sales_price) AS revenue
+  FROM my_customers, store_sales, customer_address, store, date_dim
+  WHERE c_customer_sk = ss_customer_sk
+    AND c_current_addr_sk = ca_address_sk
+    AND ca_county = s_county AND ca_state = s_state
+    AND ss_sold_date_sk = d_date_sk
+    AND d_month_seq BETWEEN
+        (SELECT DISTINCT d_month_seq + 1 FROM date_dim
+         WHERE d_year = 2000 AND d_moy = 3)
+        AND
+        (SELECT DISTINCT d_month_seq + 3 FROM date_dim
+         WHERE d_year = 2000 AND d_moy = 3)
+  GROUP BY c_customer_sk),
+segments AS (
+  SELECT cast((revenue / 50) AS int) AS segment FROM my_revenue)
+SELECT segment, count(*) AS num_customers, segment * 50 AS segment_base
+FROM segments
+GROUP BY segment
+ORDER BY segment, num_customers
+"""
+
+QUERIES["q56"] = """
+WITH ss AS (
+  SELECT i_item_id, sum(ss_ext_sales_price) AS total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('blue', 'khaki', 'plum'))
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 2
+    AND ss_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+cs AS (
+  SELECT i_item_id, sum(cs_ext_sales_price) AS total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('blue', 'khaki', 'plum'))
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 2
+    AND cs_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+ws AS (
+  SELECT i_item_id, sum(ws_ext_sales_price) AS total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('blue', 'khaki', 'plum'))
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 2
+    AND ws_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id)
+SELECT i_item_id, sum(total_sales) AS total_sales
+FROM (SELECT i_item_id, total_sales FROM ss
+      UNION ALL
+      SELECT i_item_id, total_sales FROM cs
+      UNION ALL
+      SELECT i_item_id, total_sales FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY total_sales, i_item_id
+LIMIT 100
+"""
+
+QUERIES["q57"] = """
+WITH v1 AS (
+  SELECT i_category, i_brand, cc_name, d_year, d_moy,
+         sum(cs_sales_price) AS sum_sales,
+         avg(sum(cs_sales_price)) OVER (PARTITION BY i_category, i_brand,
+                                        cc_name, d_year)
+           AS avg_monthly_sales,
+         rank() OVER (PARTITION BY i_category, i_brand, cc_name
+                      ORDER BY d_year, d_moy) AS rn
+  FROM item, catalog_sales, date_dim, call_center
+  WHERE cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND cc_call_center_sk = cs_call_center_sk
+    AND (d_year = 1999 OR (d_year = 1998 AND d_moy = 12)
+         OR (d_year = 2000 AND d_moy = 1))
+  GROUP BY i_category, i_brand, cc_name, d_year, d_moy)
+SELECT v1.i_category, v1.i_brand, v1.cc_name, v1.d_year, v1.d_moy,
+       v1.avg_monthly_sales, v1.sum_sales,
+       v1_lag.sum_sales AS psum, v1_lead.sum_sales AS nsum
+FROM v1, v1 v1_lag, v1 v1_lead
+WHERE v1.i_category = v1_lag.i_category
+  AND v1.i_category = v1_lead.i_category
+  AND v1.i_brand = v1_lag.i_brand AND v1.i_brand = v1_lead.i_brand
+  AND v1.cc_name = v1_lag.cc_name AND v1.cc_name = v1_lead.cc_name
+  AND v1.rn = v1_lag.rn + 1 AND v1.rn = v1_lead.rn - 1
+  AND v1.d_year = 1999
+  AND v1.avg_monthly_sales > 0
+  AND abs(v1.sum_sales - v1.avg_monthly_sales) / v1.avg_monthly_sales > 0.1
+ORDER BY v1.i_category, v1.i_brand, v1.cc_name, v1.d_moy
+"""
+
+QUERIES["q14"] = """
+WITH cross_items AS (
+  SELECT i_item_sk AS ss_item_sk
+  FROM item,
+   (SELECT iss.i_brand_id AS brand_id, iss.i_class_id AS class_id,
+           iss.i_category_id AS category_id
+    FROM store_sales, item iss, date_dim d1
+    WHERE ss_item_sk = iss.i_item_sk AND ss_sold_date_sk = d1.d_date_sk
+      AND d1.d_year BETWEEN 1999 AND 2001
+    INTERSECT
+    SELECT ics.i_brand_id, ics.i_class_id, ics.i_category_id
+    FROM catalog_sales, item ics, date_dim d2
+    WHERE cs_item_sk = ics.i_item_sk AND cs_sold_date_sk = d2.d_date_sk
+      AND d2.d_year BETWEEN 1999 AND 2001
+    INTERSECT
+    SELECT iws.i_brand_id, iws.i_class_id, iws.i_category_id
+    FROM web_sales, item iws, date_dim d3
+    WHERE ws_item_sk = iws.i_item_sk AND ws_sold_date_sk = d3.d_date_sk
+      AND d3.d_year BETWEEN 1999 AND 2001) x
+  WHERE i_brand_id = brand_id AND i_class_id = class_id
+    AND i_category_id = category_id),
+avg_sales AS (
+  SELECT avg(quantity * list_price) AS average_sales
+  FROM (SELECT ss_quantity AS quantity, ss_list_price AS list_price
+        FROM store_sales, date_dim
+        WHERE ss_sold_date_sk = d_date_sk AND d_year BETWEEN 1999 AND 2001
+        UNION ALL
+        SELECT cs_quantity, cs_list_price
+        FROM catalog_sales, date_dim
+        WHERE cs_sold_date_sk = d_date_sk AND d_year BETWEEN 1999 AND 2001
+        UNION ALL
+        SELECT ws_quantity, ws_list_price
+        FROM web_sales, date_dim
+        WHERE ws_sold_date_sk = d_date_sk AND d_year BETWEEN 1999 AND 2001) x)
+SELECT channel, i_brand_id, i_class_id, i_category_id, sum(sales) AS sales,
+       sum(number_sales) AS number_sales
+FROM (SELECT 'store' AS channel, i_brand_id, i_class_id, i_category_id,
+             sum(ss_quantity * ss_list_price) AS sales,
+             count(*) AS number_sales
+      FROM store_sales, item, date_dim
+      WHERE ss_item_sk IN (SELECT ss_item_sk FROM cross_items)
+        AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+        AND d_year = 2001 AND d_moy = 11
+      GROUP BY i_brand_id, i_class_id, i_category_id
+      HAVING sum(ss_quantity * ss_list_price)
+             > (SELECT average_sales FROM avg_sales)
+      UNION ALL
+      SELECT 'catalog', i_brand_id, i_class_id, i_category_id,
+             sum(cs_quantity * cs_list_price), count(*)
+      FROM catalog_sales, item, date_dim
+      WHERE cs_item_sk IN (SELECT ss_item_sk FROM cross_items)
+        AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+        AND d_year = 2001 AND d_moy = 11
+      GROUP BY i_brand_id, i_class_id, i_category_id
+      HAVING sum(cs_quantity * cs_list_price)
+             > (SELECT average_sales FROM avg_sales)
+      UNION ALL
+      SELECT 'web', i_brand_id, i_class_id, i_category_id,
+             sum(ws_quantity * ws_list_price), count(*)
+      FROM web_sales, item, date_dim
+      WHERE ws_item_sk IN (SELECT ss_item_sk FROM cross_items)
+        AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+        AND d_year = 2001 AND d_moy = 11
+      GROUP BY i_brand_id, i_class_id, i_category_id
+      HAVING sum(ws_quantity * ws_list_price)
+             > (SELECT average_sales FROM avg_sales)) y
+GROUP BY ROLLUP (channel, i_brand_id, i_class_id, i_category_id)
+ORDER BY channel, i_brand_id, i_class_id, i_category_id
+"""
+
+# q23: thresholds adapted to the synthetic sf=0.01 domains (items bought
+# >4 times over the window; customers above 50% of the max store spend).
+QUERIES["q23"] = """
+WITH frequent_ss_items AS (
+  SELECT i_item_sk AS item_sk, count(*) AS cnt
+  FROM store_sales, date_dim, item
+  WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+    AND d_year IN (1999, 2000)
+  GROUP BY i_item_sk
+  HAVING count(*) > 4),
+max_store_sales AS (
+  SELECT max(csales) AS tpcds_cmax
+  FROM (SELECT c_customer_sk,
+               sum(ss_quantity * ss_sales_price) AS csales
+        FROM store_sales, customer, date_dim
+        WHERE ss_customer_sk = c_customer_sk AND ss_sold_date_sk = d_date_sk
+          AND d_year IN (1999, 2000)
+        GROUP BY c_customer_sk) a),
+best_ss_customer AS (
+  SELECT c_customer_sk
+  FROM store_sales, customer
+  WHERE ss_customer_sk = c_customer_sk
+  GROUP BY c_customer_sk
+  HAVING sum(ss_quantity * ss_sales_price)
+         > 0.5 * (SELECT tpcds_cmax FROM max_store_sales))
+SELECT sum(sales) AS total_sales
+FROM (SELECT cs_quantity * cs_list_price AS sales
+      FROM catalog_sales, date_dim
+      WHERE d_year = 2000 AND d_moy = 3 AND cs_sold_date_sk = d_date_sk
+        AND cs_item_sk IN (SELECT item_sk FROM frequent_ss_items)
+        AND cs_bill_customer_sk IN
+            (SELECT c_customer_sk FROM best_ss_customer)
+      UNION ALL
+      SELECT ws_quantity * ws_list_price
+      FROM web_sales, date_dim
+      WHERE d_year = 2000 AND d_moy = 3 AND ws_sold_date_sk = d_date_sk
+        AND ws_item_sk IN (SELECT item_sk FROM frequent_ss_items)
+        AND ws_bill_customer_sk IN
+            (SELECT c_customer_sk FROM best_ss_customer)) x
+"""
+
+# q24: store has no s_zip/s_market_id and customer no c_birth_country in
+# the generated subset — the same-neighborhood match rides s_state=ca_state
+# and the market filter becomes s_number_employees; shape (returns-joined
+# store sales, CTE reused in a scalar HAVING threshold) is preserved.
+QUERIES["q24"] = """
+WITH ssales AS (
+  SELECT c_last_name, c_first_name, s_store_name, i_color,
+         sum(ss_net_paid) AS netpaid
+  FROM store_sales, store_returns, store, item, customer, customer_address
+  WHERE ss_ticket_number = sr_ticket_number AND ss_item_sk = sr_item_sk
+    AND ss_customer_sk = c_customer_sk AND ss_item_sk = i_item_sk
+    AND ss_store_sk = s_store_sk AND c_current_addr_sk = ca_address_sk
+    AND s_state = ca_state AND s_number_employees BETWEEN 200 AND 290
+  GROUP BY c_last_name, c_first_name, s_store_name, i_color)
+SELECT c_last_name, c_first_name, s_store_name, sum(netpaid) AS paid
+FROM ssales
+WHERE i_color = 'pink'
+GROUP BY c_last_name, c_first_name, s_store_name
+HAVING sum(netpaid) > (SELECT 0.05 * avg(netpaid) FROM ssales)
+ORDER BY c_last_name, c_first_name, s_store_name
+"""
+
+# q64: customer first-sales/first-shipto dates and demographics joins are
+# absent from the generated subset; the core shape — returns-qualified
+# catalog items (cs_ui), the per-(item, store, year) cross_sales rollup,
+# and the year-over-year self join — is preserved.
+QUERIES["q64"] = """
+WITH cs_ui AS (
+  SELECT cs_item_sk,
+         sum(cs_ext_list_price) AS sale,
+         sum(cr_refunded_cash + cr_net_loss) AS refund
+  FROM catalog_sales, catalog_returns
+  WHERE cs_item_sk = cr_item_sk AND cs_order_number = cr_order_number
+  GROUP BY cs_item_sk
+  HAVING sum(cs_ext_list_price)
+         > 2 * sum(cr_refunded_cash + cr_net_loss)),
+cross_sales AS (
+  SELECT i_product_name AS product_name, i_item_sk AS item_sk,
+         s_store_name AS store_name, d1.d_year AS syear,
+         count(*) AS cnt, sum(ss_wholesale_cost) AS s1,
+         sum(ss_list_price) AS s2, sum(ss_coupon_amt) AS s3
+  FROM store_sales, store_returns, cs_ui, date_dim d1, store, item
+  WHERE ss_store_sk = s_store_sk AND ss_sold_date_sk = d1.d_date_sk
+    AND ss_item_sk = i_item_sk AND ss_item_sk = sr_item_sk
+    AND ss_ticket_number = sr_ticket_number AND ss_item_sk = cs_ui.cs_item_sk
+    AND i_color IN ('green', 'red', 'blue', 'pink', 'white', 'black')
+    AND i_current_price BETWEEN 1 AND 100
+  GROUP BY i_product_name, i_item_sk, s_store_name, d1.d_year)
+SELECT cs1.product_name, cs1.store_name, cs1.syear AS year1,
+       cs2.syear AS year2, cs1.cnt AS cnt1, cs2.cnt AS cnt2,
+       cs1.s1 AS s11, cs1.s2 AS s21, cs1.s3 AS s31,
+       cs2.s1 AS s12, cs2.s2 AS s22, cs2.s3 AS s32
+FROM cross_sales cs1, cross_sales cs2
+WHERE cs1.item_sk = cs2.item_sk AND cs1.syear = 1999
+  AND cs2.syear = 2000 AND cs2.cnt <= cs1.cnt
+  AND cs1.store_name = cs2.store_name
+ORDER BY cs1.product_name, cs1.store_name, cs2.cnt
+"""
+
+QUERIES["q70"] = """
+SELECT sum(ss_net_profit) AS total_sum, s_state, s_county,
+       grouping(s_state) + grouping(s_county) AS lochierarchy
+FROM store_sales, date_dim d1, store
+WHERE d1.d_month_seq BETWEEN 24 AND 35
+  AND d1.d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+  AND s_state IN
+      (SELECT s_state
+       FROM (SELECT s_state, rank() OVER (PARTITION BY s_state
+                                          ORDER BY sum(ss_net_profit) DESC)
+                      AS ranking
+             FROM store_sales, store, date_dim
+             WHERE d_month_seq BETWEEN 24 AND 35
+               AND d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+             GROUP BY s_state) tmp1
+       WHERE ranking <= 5)
+GROUP BY ROLLUP (s_state, s_county)
+ORDER BY lochierarchy DESC, s_state, s_county
+"""
+
+# q72: d3.d_date > d1.d_date + 5 rides the day-indexed date_sk arithmetic
+# (d_date_sk IS the day number in the generated calendar).
+QUERIES["q72"] = """
+SELECT i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(CASE WHEN p_promo_sk IS NULL THEN 1 ELSE 0 END) AS no_promo,
+       sum(CASE WHEN p_promo_sk IS NOT NULL THEN 1 ELSE 0 END) AS promo,
+       count(*) AS total_cnt
+FROM catalog_sales
+JOIN inventory ON cs_item_sk = inv_item_sk
+JOIN warehouse ON w_warehouse_sk = inv_warehouse_sk
+JOIN item ON i_item_sk = cs_item_sk
+JOIN customer_demographics ON cs_bill_cdemo_sk = cd_demo_sk
+JOIN household_demographics ON cs_bill_hdemo_sk = hd_demo_sk
+JOIN date_dim d1 ON cs_sold_date_sk = d1.d_date_sk
+JOIN date_dim d2 ON inv_date_sk = d2.d_date_sk
+JOIN date_dim d3 ON cs_ship_date_sk = d3.d_date_sk
+LEFT JOIN promotion ON cs_promo_sk = p_promo_sk
+LEFT JOIN catalog_returns ON cr_item_sk = cs_item_sk
+                          AND cr_order_number = cs_order_number
+WHERE d1.d_week_seq = d2.d_week_seq AND inv_quantity_on_hand < cs_quantity
+  AND d3.d_date_sk > d1.d_date_sk + 5 AND hd_buy_potential = '>10000'
+  AND d1.d_year = 1999 AND cd_marital_status = 'D'
+GROUP BY i_item_desc, w_warehouse_name, d1.d_week_seq
+ORDER BY total_cnt DESC, i_item_desc, w_warehouse_name, d1.d_week_seq
+LIMIT 100
+"""
+
+QUERIES["q83"] = """
+WITH date_set AS (
+  SELECT d_date_sk FROM date_dim
+  WHERE d_week_seq IN (SELECT d_week_seq FROM date_dim
+                       WHERE d_date IN (date '2000-06-30',
+                                        date '2000-09-27',
+                                        date '2000-11-17'))),
+sr_items AS (
+  SELECT i_item_id AS item_id, sum(sr_return_quantity) AS sr_item_qty
+  FROM store_returns, item, date_set
+  WHERE sr_item_sk = i_item_sk AND sr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id),
+cr_items AS (
+  SELECT i_item_id AS item_id, sum(cr_return_quantity) AS cr_item_qty
+  FROM catalog_returns, item, date_set
+  WHERE cr_item_sk = i_item_sk AND cr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id),
+wr_items AS (
+  SELECT i_item_id AS item_id, sum(wr_return_quantity) AS wr_item_qty
+  FROM web_returns, item, date_set
+  WHERE wr_item_sk = i_item_sk AND wr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id)
+SELECT sr_items.item_id, sr_item_qty,
+       sr_item_qty * 1.0 / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0
+         * 100 AS sr_dev,
+       cr_item_qty,
+       cr_item_qty * 1.0 / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0
+         * 100 AS cr_dev,
+       wr_item_qty,
+       wr_item_qty * 1.0 / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0
+         * 100 AS wr_dev,
+       (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 AS average
+FROM sr_items, cr_items, wr_items
+WHERE sr_items.item_id = cr_items.item_id
+  AND sr_items.item_id = wr_items.item_id
+ORDER BY sr_items.item_id, sr_item_qty
+"""
+
+# q95: web_sales has no ws_ship_addr_sk / ws_ext_ship_cost in the generated
+# subset — ws_bill_addr_sk and ws_ext_list_price substitute (same types).
+QUERIES["q95"] = """
+WITH ws_wh AS (
+  SELECT ws1.ws_order_number
+  FROM web_sales ws1, web_sales ws2
+  WHERE ws1.ws_order_number = ws2.ws_order_number
+    AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+SELECT count(DISTINCT ws1.ws_order_number) AS order_count,
+       sum(ws_ext_list_price) AS total_shipping_cost,
+       sum(ws_net_profit) AS total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE ws1.ws_ship_date_sk = d_date_sk
+  AND d_date BETWEEN date '2000-02-01' AND date '2000-04-01'
+  AND ws1.ws_bill_addr_sk = ca_address_sk AND ca_state = 'IL'
+  AND ws1.ws_web_site_sk = web_site_sk AND web_company_name = 'pri0'
+  AND ws1.ws_order_number IN (SELECT ws_order_number FROM ws_wh)
+  AND ws1.ws_order_number IN (SELECT wr_order_number
+                              FROM web_returns, ws_wh
+                              WHERE wr_order_number = ws_wh.ws_order_number)
+"""
