@@ -76,6 +76,27 @@ class HostTable:
                 if vl is not None:
                     valids[name] = vl
                 continue
+            if t is not None and (t.is_hll or t.is_bitmap):
+                # sketch planes: fixed-width int8 rows from bytes/int lists
+                w = t.wide_width
+                arr = np.zeros((len(vals), w), dtype=np.int8)
+                nulls = np.zeros((len(vals),), dtype=bool)
+                for i, v in enumerate(vals):
+                    if v is None:
+                        nulls[i] = True
+                        continue
+                    b = np.frombuffer(bytes(v), dtype=np.int8) \
+                        if isinstance(v, (bytes, bytearray)) \
+                        else np.asarray(v, dtype=np.int8)
+                    if len(b) != w:
+                        raise ValueError(
+                            f"{t!r} value width {len(b)} != {w}")
+                    arr[i] = b
+                fields.append(Field(name, t, nullable))
+                arrays[name] = arr
+                if nulls.any():
+                    valids[name] = ~nulls
+                continue
             nulls = None
             if isinstance(vals, list) and any(v is None for v in vals):
                 nulls = np.array([v is None for v in vals])
@@ -157,6 +178,25 @@ class HostTable:
                     t = LogicalType(TypeKind.DECIMAL, at.precision, scale)
                     fields.append(Field(col_name, t, True))
                     arrays[col_name] = ints
+            elif pa.types.is_binary(at) or pa.types.is_large_binary(at) \
+                    or pa.types.is_fixed_size_binary(at):
+                # sketch planes (HLL/BITMAP) persisted as binary; width from
+                # the data, logical type restored by the storage _conform
+                vals = col.to_pylist()
+                w = max((len(b) for b in vals if b is not None), default=1)
+                mat = np.zeros((len(vals), w), dtype=np.int8)
+                missing = np.zeros((len(vals),), dtype=bool)
+                for i, b in enumerate(vals):
+                    if b is None:
+                        missing[i] = True
+                    else:
+                        mat[i] = np.frombuffer(b, dtype=np.int8)
+                fields.append(Field(
+                    col_name, LogicalType(TypeKind.BITMAP, w * 8), True))
+                arrays[col_name] = mat
+                if missing.any():
+                    valids[col_name] = ~missing
+                nulls = None  # handled here
             elif pa.types.is_date(at):
                 days = col.cast(pa.int32()).to_numpy(zero_copy_only=False)
                 fields.append(Field(col_name, LogicalType(TypeKind.DATE), True))
@@ -233,6 +273,11 @@ class HostTable:
                     ctx = decimal.Context(prec=60)
                     row.append(decimal.Decimal(
                         _dec128_to_int(a[r])).scaleb(-f.type.scale, ctx))
+                elif f.type.is_hll or f.type.is_bitmap:
+                    # opaque binary render (like the reference's HLL/BITMAP
+                    # columns; apply hll_cardinality / bitmap_to_string for
+                    # readable output)
+                    row.append(np.asarray(a[r], dtype=np.int8).tobytes())
                 elif f.type.is_decimal:
                     row.append(int(a[r]) / (10 ** f.type.scale))
                 elif f.type.kind is TypeKind.DATE:
@@ -267,6 +312,9 @@ class HostTable:
                 s = pd.Series(a.astype("datetime64[D]"))
             elif f.type.kind is TypeKind.DATETIME:
                 s = pd.Series(a.astype("datetime64[us]"))
+            elif f.type.is_hll or f.type.is_bitmap:
+                s = pd.Series([r.tobytes()
+                               for r in np.asarray(a, dtype=np.int8)])
             else:
                 s = pd.Series(a)
             if v is not None:

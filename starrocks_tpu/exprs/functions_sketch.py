@@ -1,0 +1,119 @@
+"""Scalar HLL / BITMAP builtins (reference: be/src/exprs/hyperloglog_functions.cpp
+and be/src/exprs/bitmap_functions.cpp, re-designed over the dense device
+layouts of ops/sketch.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..ops import sketch
+from .compile import EVal, _and_valid, function
+
+
+def _require(cond: bool, msg: str):
+    if not cond:
+        raise TypeError(msg)
+
+
+@function("hll_cardinality")
+def _f_hll_cardinality(cc, a: EVal) -> EVal:
+    _require(a.type.is_hll, f"hll_cardinality expects HLL, got {a.type!r}")
+    return EVal(sketch.hll_estimate(a.data), a.valid, T.BIGINT)
+
+
+@function("hll_empty")
+def _f_hll_empty(cc) -> EVal:
+    from ..runtime.config import config
+
+    p = config.get("hll_precision")
+    cap = cc.chunk.capacity
+    return EVal(jnp.zeros((cap, 1 << p), jnp.int8), None, T.HLL(p))
+
+
+@function("hll_hash")
+def _f_hll_hash(cc, a: EVal) -> EVal:
+    """Single-value sketch per row (the HLL column ingestion builtin)."""
+    from ..runtime.config import config
+    from ..ops.aggregate import _hash_input_i64
+
+    p = config.get("hll_precision")
+    m = 1 << p
+    cap = cc.chunk.capacity
+    valid = jnp.ones((cap,), jnp.bool_) if a.valid is None else a.valid
+    idx, rho = sketch.hll_rows(
+        jnp.broadcast_to(_hash_input_i64(a), (cap,)), valid, p)
+    regs = jnp.where(
+        jnp.arange(m, dtype=jnp.int32)[None, :] == idx[:, None],
+        jnp.asarray(rho, jnp.int32)[:, None], 0)
+    return EVal(jnp.asarray(regs, jnp.int8), None, T.HLL(p))
+
+
+@function("to_bitmap")
+def _f_to_bitmap(cc, a: EVal) -> EVal:
+    from ..runtime.config import config
+
+    nbits = config.get("bitmap_default_domain")
+    if a.bounds is not None and a.bounds[1] is not None \
+            and 0 <= a.bounds[1] < (1 << 24):
+        nbits = int(a.bounds[1]) + 1
+    cap = cc.chunk.capacity
+    valid = jnp.ones((cap,), jnp.bool_) if a.valid is None else a.valid
+    v = jnp.broadcast_to(jnp.asarray(a.data, jnp.int64), (cap,))
+    return EVal(sketch.bitmap_from_values(v, valid, nbits), None,
+                T.BITMAP(nbits))
+
+
+def _bitmap_pair(a: EVal, b: EVal, fn: str):
+    _require(a.type.is_bitmap and b.type.is_bitmap,
+             f"{fn} expects BITMAP arguments")
+    _require(a.type.precision == b.type.precision,
+             f"{fn}: bitmap domains differ "
+             f"({a.type.precision} vs {b.type.precision})")
+
+
+@function("bitmap_and")
+def _f_bitmap_and(cc, a: EVal, b: EVal) -> EVal:
+    _bitmap_pair(a, b, "bitmap_and")
+    return EVal(sketch.bitmap_binary(a.data, b.data, "and"),
+                _and_valid(a.valid, b.valid), a.type)
+
+
+@function("bitmap_or")
+def _f_bitmap_or(cc, a: EVal, b: EVal) -> EVal:
+    _bitmap_pair(a, b, "bitmap_or")
+    return EVal(sketch.bitmap_binary(a.data, b.data, "or"),
+                _and_valid(a.valid, b.valid), a.type)
+
+
+@function("bitmap_xor")
+def _f_bitmap_xor(cc, a: EVal, b: EVal) -> EVal:
+    _bitmap_pair(a, b, "bitmap_xor")
+    return EVal(sketch.bitmap_binary(a.data, b.data, "xor"),
+                _and_valid(a.valid, b.valid), a.type)
+
+
+@function("bitmap_andnot")
+def _f_bitmap_andnot(cc, a: EVal, b: EVal) -> EVal:
+    _bitmap_pair(a, b, "bitmap_andnot")
+    return EVal(sketch.bitmap_binary(a.data, b.data, "andnot"),
+                _and_valid(a.valid, b.valid), a.type)
+
+
+@function("bitmap_count")
+def _f_bitmap_count(cc, a: EVal) -> EVal:
+    _require(a.type.is_bitmap, f"bitmap_count expects BITMAP, got {a.type!r}")
+    cnt = sketch.bitmap_count(a.data)
+    if a.valid is not None:  # NULL bitmap counts 0, like the reference
+        cnt = jnp.where(a.valid, cnt, 0)
+    return EVal(cnt, None, T.BIGINT)
+
+
+@function("bitmap_contains")
+def _f_bitmap_contains(cc, a: EVal, v: EVal) -> EVal:
+    _require(a.type.is_bitmap,
+             f"bitmap_contains expects BITMAP, got {a.type!r}")
+    cap = cc.chunk.capacity
+    vals = jnp.broadcast_to(jnp.asarray(v.data, jnp.int64), (cap,))
+    return EVal(sketch.bitmap_contains(a.data, vals),
+                _and_valid(a.valid, v.valid), T.BOOLEAN)

@@ -79,7 +79,16 @@ def _minmax_identity(t: T.LogicalType, is_min: bool):
 _VAR_FNS = {"var_pop", "var_samp", "stddev_pop", "stddev_samp"}
 _COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
 # need the full value multiset -> cannot be split into partial/final
-_HOLISTIC_FNS = {"percentile_cont", "percentile_disc", "array_agg"}
+_HOLISTIC_FNS = {"percentile_cont", "percentile_disc", "array_agg",
+                 # sketch aggregates run COMPLETE (the distributed planner
+                 # gathers rows); a PARTIAL/FINAL register-merge split is a
+                 # natural later step — registers are themselves mergeable
+                 "approx_count_distinct", "hll_sketch", "hll_union",
+                 "hll_union_agg", "bitmap_agg", "bitmap_union",
+                 "bitmap_union_count", "intersect_count"}
+_SKETCH_FNS = {"approx_count_distinct", "hll_sketch", "hll_union",
+               "hll_union_agg", "bitmap_agg", "bitmap_union",
+               "bitmap_union_count", "intersect_count"}
 
 
 def decomposable(aggs: tuple) -> bool:
@@ -210,6 +219,102 @@ def _lowcard_key_columns(infos, total: int, num_groups: int):
         cols.append((k, jnp.asarray(code + lo, k.type.dtype), valid))
     return cols
 
+
+
+def _hash_input_i64(a: EVal):
+    """Distinct-preserving int64 view of a column for sketch hashing
+    (dict codes ARE value ids; floats hash their bit patterns)."""
+    if a.type.is_wide:
+        raise NotImplementedError(f"cannot sketch {a.type!r} values")
+    if a.type.is_float:
+        return jax.lax.bitcast_convert_type(
+            jnp.asarray(a.data, jnp.float64), jnp.int64)
+    return jnp.asarray(a.data, jnp.int64)
+
+
+def _emit_sketch_agg(cc, name, agg, cap, live_rows, reorder, gid,
+                     num_groups):
+    """HLL / BITMAP aggregate column (ops/sketch.py kernels)."""
+    from . import sketch
+    from ..runtime.config import config as _cfg
+
+    from ..exprs.ir import Call as _Call
+
+    fn = agg.fn
+    arg = agg.arg
+    if (fn in ("bitmap_agg", "bitmap_union", "bitmap_union_count")
+            and isinstance(arg, _Call) and arg.fn == "to_bitmap"):
+        # bitmap_union(to_bitmap(x)): skip the per-row plane materialization
+        # and scatter x's values directly (the fused presence path)
+        arg = arg.args[0]
+    a = cc.eval(arg)
+    m = live_rows if a.valid is None else (
+        live_rows & reorder(jnp.broadcast_to(a.valid, (cap,))))
+
+    if fn in ("approx_count_distinct", "hll_sketch"):
+        p = _cfg.get("hll_precision")
+        vals = reorder(jnp.broadcast_to(_hash_input_i64(a), (cap,)))
+        regs = sketch.hll_registers_from_values(vals, m, gid, num_groups, p)
+        if fn == "approx_count_distinct":
+            return (Field(name, T.BIGINT, False),
+                    sketch.hll_estimate(regs), None)
+        return Field(name, T.HLL(p), False), regs, None
+
+    if fn in ("hll_union", "hll_union_agg"):
+        if not a.type.is_hll:
+            raise TypeError(f"{fn} expects an HLL column, got {a.type!r}")
+        d = jnp.where(m[:, None], reorder(jnp.asarray(a.data)), 0)
+        regs = sketch.hll_union_registers(d, gid, num_groups)
+        if fn == "hll_union_agg":
+            return (Field(name, T.BIGINT, False),
+                    sketch.hll_estimate(regs), None)
+        return Field(name, a.type, False), regs, None
+
+    if fn in ("bitmap_agg", "bitmap_union", "bitmap_union_count"):
+        if a.type.is_bitmap:  # union of stored bitmaps: plane merge
+            if fn == "bitmap_agg":
+                raise TypeError("bitmap_agg expects integer values")
+            d = jnp.where(m[:, None], reorder(jnp.asarray(a.data)), 0)
+            planes = sketch.bitmap_union_planes(d, gid, num_groups)
+            nb = a.type
+        else:  # integer values: one fused presence scatter
+            if not a.type.is_integer:
+                raise TypeError(
+                    f"{fn} expects BITMAP or integer values, got {a.type!r}")
+            nbits = _cfg.get("bitmap_default_domain")
+            if a.bounds is not None and a.bounds[1] is not None \
+                    and 0 <= a.bounds[1] < (1 << 24):
+                nbits = int(a.bounds[1]) + 1
+            vals = reorder(jnp.broadcast_to(
+                jnp.asarray(a.data, jnp.int64), (cap,)))
+            planes = sketch.bitmap_union_from_values(
+                vals, m, gid, num_groups, nbits)
+            nb = T.BITMAP(nbits)
+        if fn == "bitmap_union_count":
+            return (Field(name, T.BIGINT, False),
+                    sketch.bitmap_count(planes), None)
+        return Field(name, nb, False), planes, None
+
+    if fn == "intersect_count":
+        if not a.type.is_bitmap:
+            raise TypeError(
+                f"intersect_count expects a BITMAP column, got {a.type!r}")
+        dim_e, *lits = agg.extra
+        d = jnp.where(m[:, None], reorder(jnp.asarray(a.data)), 0)
+        acc = None
+        for lit in lits:
+            eqv = cc.call("eq", cc.eval(dim_e), cc.eval(lit))
+            sel = jnp.broadcast_to(jnp.asarray(eqv.data, jnp.bool_), (cap,))
+            if eqv.valid is not None:
+                sel = sel & jnp.broadcast_to(eqv.valid, (cap,))
+            mi = m & reorder(sel)
+            planes = sketch.bitmap_union_planes(
+                jnp.where(mi[:, None], d, 0), gid, num_groups)
+            acc = planes if acc is None else sketch.bitmap_binary(
+                acc, planes, "and")
+        return Field(name, T.BIGINT, False), sketch.bitmap_count(acc), None
+
+    raise NotImplementedError(fn)
 
 
 def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
@@ -361,6 +466,17 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
                 out_fields.append(Field(name, T.DOUBLE, True))
                 out_data.append(res)
                 out_valid.append(ok)
+            continue
+
+        if agg.fn in _SKETCH_FNS:
+            if mode != COMPLETE:
+                raise NotImplementedError(
+                    f"{agg.fn} cannot be split into partial/final")
+            f, d, v = _emit_sketch_agg(cc, name, agg, cap, live_rows,
+                                       reorder, gid, num_groups)
+            out_fields.append(f)
+            out_data.append(d)
+            out_valid.append(v)
             continue
 
         if agg.fn in _HOLISTIC_FNS and agg.fn != "array_agg":

@@ -1,0 +1,183 @@
+"""HLL and BITMAP sketch kernels (device-side, scatter-light).
+
+HLL (reference: be/src/types/hll.h, the HLL_UNION_AGG path in
+be/src/exprs/agg/hll_union_count.h): re-designed for fixed shapes — a sketch
+IS a dense [2^p] int8 register vector, so a column of sketches is a rank-2
+array, per-group union is a segment-max, and merging two sketches is an
+elementwise max. No varint/sparse encodings: the TPU wants one layout.
+
+BITMAP (reference: be/src/types/bitmap_value.h — Roaring bitmaps):
+re-designed as dense int8 bit planes over a BOUNDED domain [0, nbits)
+declared in the type. Unions become segment reductions over bit planes,
+intersections elementwise ANDs, cardinality a popcount LUT. Unbounded
+64-bit domains are out of scope by design — the reference reaches them
+with Roaring containers, this engine with exact distinct counting.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import mix64
+
+
+def _clz64(w):
+    """Count leading zeros of uint64 (w == 0 -> 64). Exact integer binary
+    descent — float tricks mis-round near power-of-two boundaries."""
+    w = jnp.asarray(w, jnp.uint64)
+    msb = jnp.zeros(w.shape, jnp.int32)
+    for s in (32, 16, 8, 4, 2, 1):
+        y = w >> jnp.uint64(s)
+        take = y != 0
+        msb = jnp.where(take, msb + s, msb)
+        w = jnp.where(take, y, w)
+    return jnp.where(w == 0, 64, 63 - msb)
+
+
+def hll_rows(values, valid, p: int):
+    """Per-row (register_index int32, rho int8) for 64-bit hashed values.
+    Dead/NULL rows get rho 0 (the empty-register identity)."""
+    h = mix64(values)
+    idx = jnp.asarray(h >> jnp.uint64(64 - p), jnp.int32)
+    rest = h << jnp.uint64(p)
+    rho = jnp.minimum(_clz64(rest) + 1, 64 - p + 1)
+    rho = jnp.where(valid, rho, 0)
+    return idx, jnp.asarray(rho, jnp.int8)
+
+
+def hll_registers_from_values(values, valid, gid, num_groups: int, p: int):
+    """[G, 2^p] int8 registers: the union sketch of each group's values.
+    gid must map dead rows OUT of [0, num_groups)."""
+    m = 1 << p
+    idx, rho = hll_rows(values, valid, p)
+    g = jnp.clip(jnp.asarray(gid, jnp.int32), 0, num_groups)
+    flat = g * m + idx  # spill group num_groups absorbs dead rows
+    regs = jax.ops.segment_max(
+        jnp.asarray(rho, jnp.int32), flat, num_segments=(num_groups + 1) * m)
+    regs = jnp.maximum(regs, 0)  # empty segments come back as dtype-min
+    return jnp.asarray(regs.reshape(num_groups + 1, m)[:num_groups], jnp.int8)
+
+
+def hll_union_registers(regs, gid, num_groups: int):
+    """Union stored sketches per group: segment-max over [N, m] registers."""
+    g = jnp.clip(jnp.asarray(gid, jnp.int32), 0, num_groups)
+    out = jax.ops.segment_max(
+        jnp.asarray(regs, jnp.int32), g, num_segments=num_groups + 1)
+    return jnp.asarray(jnp.maximum(out[:num_groups], 0), jnp.int8)
+
+
+def hll_estimate(regs):
+    """Cardinality estimate from [..., m] registers: classic HLL with the
+    small-range linear-counting correction (Flajolet et al.)."""
+    regs = jnp.asarray(regs, jnp.int32)
+    m = regs.shape[-1]
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    inv = jnp.sum(jnp.exp2(-jnp.asarray(regs, jnp.float64)), axis=-1)
+    raw = alpha * m * m / inv
+    zeros = jnp.sum(regs == 0, axis=-1)
+    lc = m * jnp.log(m / jnp.maximum(zeros, 1).astype(jnp.float64))
+    est = jnp.where((raw <= 2.5 * m) & (zeros > 0), lc, raw)
+    return jnp.asarray(jnp.round(est), jnp.int64)
+
+
+# --- bitmap ------------------------------------------------------------------
+
+
+_POPCNT8 = jnp.asarray([bin(i).count("1") for i in range(256)], jnp.int32)
+
+
+def _bytes_u(b):
+    """int8 planes as [0, 255] int32 (two's complement unwrap)."""
+    return jnp.asarray(b, jnp.int32) & 0xFF
+
+
+def bitmap_from_values(values, valid, nbits: int):
+    """Per-row single-bit bitmap [N, ceil(nbits/8)] int8 (to_bitmap).
+    Out-of-domain / NULL values produce the empty bitmap."""
+    w8 = (nbits + 7) // 8
+    v = jnp.asarray(values, jnp.int64)
+    ok = valid & (v >= 0) & (v < nbits)
+    byte = jnp.asarray(jnp.where(ok, v >> 3, -1), jnp.int32)
+    bit = jnp.asarray(v & 7, jnp.int32)
+    planes = jnp.where(
+        jnp.arange(w8, dtype=jnp.int32)[None, :] == byte[:, None],
+        (1 << bit)[:, None], 0)
+    return jnp.asarray(planes, jnp.int8)
+
+
+def bitmap_union_from_values(values, valid, gid, num_groups: int,
+                             nbits: int):
+    """[G, w8] union bitmap per group, straight from integer values — one
+    presence scatter, no per-row bitmap materialization (the fused
+    bitmap_union(to_bitmap(x)) / bitmap_agg(x) path)."""
+    v = jnp.asarray(values, jnp.int64)
+    ok = valid & (v >= 0) & (v < nbits)
+    g = jnp.clip(jnp.asarray(gid, jnp.int32), 0, num_groups)
+    g = jnp.where(ok, g, num_groups)
+    flat = g * nbits + jnp.asarray(jnp.where(ok, v, 0), jnp.int32)
+    pres = jnp.zeros(((num_groups + 1) * nbits,), jnp.int8)
+    pres = pres.at[flat].max(jnp.int8(1), mode="drop")
+    return _pack_bits(pres.reshape(num_groups + 1, nbits)[:num_groups])
+
+
+def _pack_bits(bits):
+    """[..., nbits] 0/1 -> [..., ceil(nbits/8)] int8 planes."""
+    nbits = bits.shape[-1]
+    w8 = (nbits + 7) // 8
+    pad = w8 * 8 - nbits
+    if pad:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros(bits.shape[:-1] + (pad,), bits.dtype)], axis=-1)
+    b = jnp.asarray(bits.reshape(bits.shape[:-1] + (w8, 8)), jnp.int32)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))
+    return jnp.asarray(jnp.sum(b * weights, axis=-1), jnp.int8)
+
+
+def _unpack_bits(planes):
+    """[..., w8] int8 -> [..., w8 * 8] 0/1 int8."""
+    u = _bytes_u(planes)[..., None]
+    bits = (u >> jnp.arange(8, dtype=jnp.int32)) & 1
+    return jnp.asarray(bits.reshape(planes.shape[:-1] + (-1,)), jnp.int8)
+
+
+def bitmap_union_planes(planes, gid, num_groups: int):
+    """Union stored bitmaps per group. OR == per-bit max: unpack to bit
+    planes, segment-max, repack."""
+    bits = _unpack_bits(planes)
+    g = jnp.clip(jnp.asarray(gid, jnp.int32), 0, num_groups)
+    merged = jax.ops.segment_max(
+        jnp.asarray(bits, jnp.int32), g, num_segments=num_groups + 1)
+    return _pack_bits(jnp.maximum(merged[:num_groups], 0))
+
+
+def bitmap_count(planes):
+    """Per-row cardinality of [..., w8] planes."""
+    return jnp.asarray(
+        jnp.sum(_POPCNT8[_bytes_u(planes)], axis=-1), jnp.int64)
+
+
+def bitmap_binary(a, b, op: str):
+    au, bu = _bytes_u(a), _bytes_u(b)
+    if op == "and":
+        out = au & bu
+    elif op == "or":
+        out = au | bu
+    elif op == "xor":
+        out = au ^ bu
+    elif op == "andnot":
+        out = au & ~bu
+    else:
+        raise ValueError(op)
+    return jnp.asarray(out, jnp.int8)
+
+
+def bitmap_contains(planes, values):
+    v = jnp.asarray(values, jnp.int64)
+    w8 = planes.shape[-1]
+    byte_ix = jnp.clip(jnp.asarray(v >> 3, jnp.int32), 0, w8 - 1)
+    byte = jnp.take_along_axis(_bytes_u(planes), byte_ix[:, None],
+                               axis=-1)[:, 0]
+    hit = (byte >> jnp.asarray(v & 7, jnp.int32)) & 1
+    in_range = (v >= 0) & (v < w8 * 8)
+    return (hit == 1) & in_range

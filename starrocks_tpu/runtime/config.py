@@ -94,6 +94,16 @@ config.define("compaction_trigger_rowsets", 8, True,
               "compact a stored table when its rowset count reaches this "
               "(0 disables auto-compaction)")
 config.define("enable_runtime_filters", True, True, "build-side min/max filters applied to join probes")
+config.define("hll_precision", 12, True,
+              "HLL register-count exponent for approx_count_distinct / "
+              "hll_sketch (2^p int8 registers; relative error ~1.04/2^(p/2))")
+config.define("bitmap_default_domain", 65536, True,
+              "bitmap_agg value-domain size when catalog bounds are absent "
+              "(values outside [0, domain) are dropped like the reference's "
+              "non-uint32 to_bitmap inputs)")
+config.define("enable_mv_rewrite", True, True,
+              "transparently rewrite queries onto FRESH matching "
+              "materialized views (SPJG containment; sql/mv_rewrite.py)")
 config.define("enable_lowcard_agg", True, True,
               "sort-free packed-code aggregation for dictionary-bounded group keys")
 config.define("enable_scatter_free_segments", True, True,

@@ -49,6 +49,23 @@ def make_handler(session: Session, lock: threading.Lock):
             else:
                 self._send(404, json.dumps({"error": "not found"}))
 
+        def _auth_user(self):
+            """HTTP Basic auth against the shared auth manager (reference:
+            the FE http server's BaseAction auth). No header = root, which
+            only authenticates while root's password is empty."""
+            import base64
+
+            auth = session.auth()
+            hdr = self.headers.get("Authorization", "")
+            user, pw = "root", ""
+            if hdr.startswith("Basic "):
+                try:
+                    user, _, pw = base64.b64decode(
+                        hdr[6:]).decode().partition(":")
+                except Exception:
+                    return None
+            return user if auth.verify_plain(user, pw) else None
+
         def do_POST(self):
             if self.path != "/query":
                 self._send(404, json.dumps({"error": "not found"}))
@@ -60,10 +77,22 @@ def make_handler(session: Session, lock: threading.Lock):
             except Exception as e:
                 self._send(400, json.dumps({"error": f"bad request: {e}"}))
                 return
+            user = self._auth_user()
+            if user is None:
+                self.send_response(401)
+                self.send_header("WWW-Authenticate",
+                                 'Basic realm="starrocks_tpu"')
+                self.end_headers()
+                return
             t0 = time.time()
             try:
                 with lock:
-                    res = session.sql(sql)
+                    prev = session.current_user
+                    session.current_user = user
+                    try:
+                        res = session.sql(sql)
+                    finally:
+                        session.current_user = prev
                 if res is None:
                     body = {"ok": True}
                 elif isinstance(res, (list, str, int)):

@@ -9,18 +9,24 @@ with result-set encoding per be/src/data_sink/result/mysql_result_writer.h:48.
 
 Implemented subset (enough for the `mysql` CLI, Connector-family drivers and
 pymysql to connect and query):
-- protocol 10 initial handshake + HandshakeResponse41 (auth is accepted for
-  any user — AUTH/RBAC is a separate subsystem);
+- protocol 10 initial handshake + HandshakeResponse41 with REAL
+  mysql_native_password verification against the auth manager
+  (runtime/auth.py; per-connection random salt, AuthSwitchRequest for
+  clients that opened with another plugin; wrong password -> ERR 1045);
 - command phase: COM_QUERY (text resultset), COM_PING, COM_INIT_DB,
-  COM_QUIT, COM_FIELD_LIST (deprecated no-op), everything else -> ERR;
+  COM_QUIT, COM_FIELD_LIST (deprecated no-op);
+- prepared statements: COM_STMT_PREPARE / EXECUTE / CLOSE / RESET with
+  BINARY protocol result rows (qe/ConnectProcessor.java:563 analog);
+  parameters substitute by lexer-located '?' markers, so string escaping
+  is exact;
 - Protocol::ColumnDefinition41 column metadata with engine->MySQL type
   mapping, lenenc text rows, EOF framing (CLIENT_DEPRECATE_EOF not
   advertised, so old and new clients both parse us);
-- multi-statement off, prepared statements not implemented (COM_STMT_* ->
-  ERR 1295).
+- multi-statement off.
 
 One Session per server; queries serialize on a lock (single-controller
-engine), same as the HTTP service.
+engine), same as the HTTP service; the connection's authenticated user is
+installed on the session under that lock (privilege checks are per-user).
 """
 
 from __future__ import annotations
@@ -134,9 +140,8 @@ class _Conn:
         self.seq = (self.seq + 1) & 0xFF
 
     # --- composite packets ---
-    def send_handshake(self, thread_id: int):
+    def send_handshake(self, thread_id: int, salt: bytes):
         self.seq = 0
-        salt = b"01234567890123456789"  # auth unused; fixed salt is fine
         p = (
             b"\x0a"  # protocol version 10
             + b"8.0.33-starrocks-tpu\x00"
@@ -234,16 +239,63 @@ class MySQLServer:
         self.server.server_close()
 
     # --- connection lifecycle -------------------------------------------------
-    def _serve(self, sock: socket.socket):
-        conn = _Conn(sock)
-        conn.send_handshake(next(self._thread_ids))
+    def _authenticate(self, conn: _Conn, salt: bytes):
+        """Parse HandshakeResponse41 and verify mysql_native_password.
+        Returns the authenticated user name or None (ERR already sent)."""
         resp = conn.read_packet()
-        if resp is None:
-            return
-        # HandshakeResponse41: accept anyone (no AUTH subsystem yet); a
-        # COM_INIT_DB-style default database in the response is ignored —
-        # there is a single catalog.
+        if resp is None or len(resp) < 32:
+            return None
+        caps = struct.unpack_from("<I", resp, 0)[0]
+        pos = 4 + 4 + 1 + 23  # caps, max packet, charset, filler
+        end = resp.index(b"\x00", pos)
+        user = resp[pos:end].decode("utf-8", "replace")
+        pos = end + 1
+        if caps & 0x0020_0000:  # CLIENT_PLUGIN_AUTH_LENENC_CLIENT_DATA
+            n = resp[pos]
+            pos += 1
+            token = resp[pos:pos + n]
+            pos += n
+        elif caps & CLIENT_SECURE_CONNECTION:
+            n = resp[pos]
+            pos += 1
+            token = resp[pos:pos + n]
+            pos += n
+        else:  # NUL-terminated
+            end = resp.index(b"\x00", pos)
+            token = resp[pos:end]
+            pos = end + 1
+        plugin = None
+        if caps & CLIENT_CONNECT_WITH_DB and b"\x00" in resp[pos:]:
+            pos = resp.index(b"\x00", pos) + 1  # skip database name
+        if caps & CLIENT_PLUGIN_AUTH and b"\x00" in resp[pos:]:
+            end = resp.index(b"\x00", pos)
+            plugin = resp[pos:end].decode("ascii", "replace")
+        if plugin is not None and plugin != "mysql_native_password":
+            # AuthSwitchRequest: the client re-scrambles with our plugin
+            conn.send_packet(b"\xfe" + b"mysql_native_password\x00"
+                             + salt + b"\x00")
+            token = conn.read_packet()
+            if token is None:
+                return None
+        auth = self.session.auth()
+        if not auth.verify(user, salt, bytes(token)):
+            conn.send_err(
+                1045, f"Access denied for user '{user}'", b"28000")
+            return None
         conn.send_ok()
+        return user
+
+    def _serve(self, sock: socket.socket):
+        from .auth import AuthManager
+
+        conn = _Conn(sock)
+        salt = AuthManager.new_salt()
+        conn.send_handshake(next(self._thread_ids), salt)
+        user = self._authenticate(conn, salt)
+        if user is None:
+            return
+        stmts: dict = {}  # stmt_id -> (sql_text, param_positions)
+        stmt_ids = iter(range(1, 1 << 30))
         while True:
             conn.seq = 0
             pkt = conn.read_packet()
@@ -263,26 +315,50 @@ class MySQLServer:
                 conn.send_eof()
                 continue
             if cmd == 0x03:  # COM_QUERY
-                self._query(conn, arg.decode("utf-8", "replace"))
+                self._query(conn, arg.decode("utf-8", "replace"), user)
+                continue
+            if cmd == 0x16:  # COM_STMT_PREPARE
+                self._stmt_prepare(conn, arg.decode("utf-8", "replace"),
+                                   stmts, stmt_ids)
+                continue
+            if cmd == 0x17:  # COM_STMT_EXECUTE
+                self._stmt_execute(conn, arg, stmts, user)
+                continue
+            if cmd == 0x19:  # COM_STMT_CLOSE (no response)
+                if len(arg) >= 4:
+                    stmts.pop(struct.unpack_from("<I", arg, 0)[0], None)
+                continue
+            if cmd == 0x1A:  # COM_STMT_RESET
+                conn.send_ok()
                 continue
             conn.send_err(1295, f"command {cmd:#x} not supported")
 
-    def _query(self, conn: _Conn, sql: str):
+    def _run_as(self, sql: str, user: str):
+        with self.lock:
+            prev = self.session.current_user
+            self.session.current_user = user
+            try:
+                return self.session.sql(sql)
+            finally:
+                self.session.current_user = prev
+
+    def _query(self, conn: _Conn, sql: str, user: str):
         sql = sql.strip().rstrip(";")
         # connector session boilerplate: accept silently
         low = sql.lower()
         if low.startswith(("set ", "commit", "rollback", "start transaction",
                            "use ")) and not low.startswith("set global"):
             try:
-                with self.lock:
-                    self.session.sql(sql)
+                self._run_as(sql, user)
             except Exception:
                 pass  # unknown session vars from connectors are non-fatal
             conn.send_ok()
             return
         try:
-            with self.lock:
-                res = self.session.sql(sql)
+            res = self._run_as(sql, user)
+        except PermissionError as e:
+            conn.send_err(1142, str(e), b"42000")
+            return
         except Exception as e:  # noqa: BLE001 — every engine error -> ERR
             conn.send_err(1064, f"{type(e).__name__}: {e}", b"42000")
             return
@@ -315,6 +391,205 @@ class MySQLServer:
         for row in table.to_pylist():
             conn.send_packet(b"".join(_cell(v) for v in row))
         conn.send_eof()
+
+
+    # --- prepared statements --------------------------------------------------
+    def _stmt_prepare(self, conn: _Conn, sql: str, stmts: dict, stmt_ids):
+        from ..sql.lexer import tokenize
+
+        try:
+            marks = [t.pos for t in tokenize(sql)
+                     if t.kind == "op" and t.value == "?"]
+        except Exception as e:  # noqa: BLE001
+            conn.send_err(1064, f"{type(e).__name__}: {e}", b"42000")
+            return
+        sid = next(stmt_ids)
+        stmts[sid] = [sql, marks, None]  # [text, positions, cached types]
+        # COM_STMT_PREPARE_OK: columns=0 (sent at execute — planning is
+        # deferred), params as counted
+        conn.send_packet(
+            b"\x00" + struct.pack("<I", sid) + struct.pack("<H", 0)
+            + struct.pack("<H", len(marks)) + b"\x00"
+            + struct.pack("<H", 0))
+        for _ in marks:  # parameter definitions (untyped placeholders)
+            conn.send_column_def("?", T.VARCHAR)
+        if marks:
+            conn.send_eof()
+
+    def _stmt_execute(self, conn: _Conn, arg: bytes, stmts: dict, user: str):
+        if len(arg) < 9:
+            conn.send_err(1064, "malformed COM_STMT_EXECUTE")
+            return
+        sid = struct.unpack_from("<I", arg, 0)[0]
+        entry = stmts.get(sid)
+        if entry is None:
+            conn.send_err(1243, f"unknown prepared statement {sid}")
+            return
+        sql, marks, cached_types = entry
+        pos = 9  # stmt_id(4) flags(1) iteration_count(4)
+        try:
+            params, types = self._decode_params(
+                arg, pos, len(marks), cached_types)
+            entry[2] = types  # drivers send types only on the first execute
+        except Exception as e:  # noqa: BLE001
+            conn.send_err(1064, f"bad parameter block: {e}")
+            return
+        final = self._splice(sql, marks, params)
+        try:
+            res = self._run_as(final, user)
+        except PermissionError as e:
+            conn.send_err(1142, str(e), b"42000")
+            return
+        except Exception as e:  # noqa: BLE001
+            conn.send_err(1064, f"{type(e).__name__}: {e}", b"42000")
+            return
+        if res is None or isinstance(res, (str, int, list)):
+            conn.send_ok(info=b"" if res is None else str(res).encode())
+            return
+        table = res.table
+        fields = list(table.schema)
+        conn.send_packet(lenenc_int(len(fields)))
+        for f in fields:
+            conn.send_column_def(f.name, f.type)
+        conn.send_eof()
+        for row in table.to_pylist():
+            conn.send_packet(_binary_row(row, fields))
+        conn.send_eof()
+
+    @staticmethod
+    def _decode_params(arg: bytes, pos: int, nparams: int, cached_types):
+        """Binary parameter block -> (values, types). Types arrive only with
+        new_params_bound_flag=1 (the first execute); later executes reuse
+        the statement's cached types per the protocol."""
+        if nparams == 0:
+            return [], None
+        nul_len = (nparams + 7) // 8
+        nulmap = arg[pos:pos + nul_len]
+        pos += nul_len
+        bound = arg[pos]
+        pos += 1
+        if bound:
+            types = [arg[pos + 2 * i] for i in range(nparams)]
+            pos += 2 * nparams
+        elif cached_types is not None:
+            types = cached_types
+        else:
+            raise ValueError("no parameter types bound")
+        out = []
+        for i, t in enumerate(types):
+            if nulmap[i // 8] & (1 << (i % 8)):
+                out.append(None)
+                continue
+            if t == MYSQL_TYPE_LONGLONG:
+                out.append(struct.unpack_from("<q", arg, pos)[0])
+                pos += 8
+            elif t == MYSQL_TYPE_LONG:
+                out.append(struct.unpack_from("<i", arg, pos)[0])
+                pos += 4
+            elif t == 2:  # SHORT
+                out.append(struct.unpack_from("<h", arg, pos)[0])
+                pos += 2
+            elif t == MYSQL_TYPE_TINY:
+                out.append(struct.unpack_from("<b", arg, pos)[0])
+                pos += 1
+            elif t == MYSQL_TYPE_DOUBLE:
+                out.append(struct.unpack_from("<d", arg, pos)[0])
+                pos += 8
+            elif t == 4:  # FLOAT
+                out.append(struct.unpack_from("<f", arg, pos)[0])
+                pos += 4
+            elif t in (MYSQL_TYPE_DATE, MYSQL_TYPE_DATETIME, 7):
+                # length-prefixed y/m/d[/h/m/s[/us]]; length 0 = zero date
+                n = arg[pos]
+                pos += 1
+                if n == 0:
+                    out.append("0000-00-00")
+                    continue
+                y = struct.unpack_from("<H", arg, pos)[0]
+                mo, d = arg[pos + 2], arg[pos + 3]
+                s = f"{y:04d}-{mo:02d}-{d:02d}"
+                if n >= 7:
+                    s += (f" {arg[pos + 4]:02d}:{arg[pos + 5]:02d}"
+                          f":{arg[pos + 6]:02d}")
+                out.append(s)
+                pos += n
+            elif t == 11:  # TIME: length-prefixed sign/days/h/m/s[/us]
+                n = arg[pos]
+                pos += 1
+                if n == 0:
+                    out.append("00:00:00")
+                    continue
+                hh = arg[pos + 5] + 24 * struct.unpack_from(
+                    "<I", arg, pos + 1)[0]
+                out.append(f"{hh:02d}:{arg[pos + 6]:02d}:{arg[pos + 7]:02d}")
+                pos += n
+            else:  # VAR_STRING / STRING / BLOB / DECIMAL...: lenenc bytes
+                n = arg[pos]
+                pos += 1
+                if n == 0xFC:
+                    n = struct.unpack_from("<H", arg, pos)[0]
+                    pos += 2
+                elif n == 0xFD:
+                    n = struct.unpack(
+                        "<I", arg[pos:pos + 3] + b"\x00")[0]
+                    pos += 3
+                out.append(arg[pos:pos + n].decode("utf-8", "replace"))
+                pos += n
+        return out, types
+
+    @staticmethod
+    def _splice(sql: str, marks, params) -> str:
+        """Substitute literals at the lexer-located '?' positions (exact:
+        markers inside strings/comments were never tokenized as ops)."""
+        out, last = [], 0
+        for mpos, v in zip(marks, params):
+            out.append(sql[last:mpos])
+            if v is None:
+                out.append("NULL")
+            elif isinstance(v, (int, float)):
+                out.append(repr(v))
+            else:
+                out.append("'" + str(v).replace("'", "''") + "'")
+            last = mpos + 1
+        out.append(sql[last:])
+        return "".join(out)
+
+
+def _binary_row(row, fields) -> bytes:
+    """Binary-protocol resultset row (used for prepared statements)."""
+    n = len(fields)
+    nulmap = bytearray((n + 7 + 2) // 8)
+    vals = []
+    for i, (v, f) in enumerate(zip(row, fields)):
+        if v is None:
+            nulmap[(i + 2) // 8] |= 1 << ((i + 2) % 8)
+            continue
+        k = f.type.kind
+        if k is T.TypeKind.BOOLEAN:
+            vals.append(struct.pack("<b", int(v)))
+        elif k in (T.TypeKind.TINYINT, T.TypeKind.SMALLINT, T.TypeKind.INT):
+            vals.append(struct.pack("<i", int(v)))
+        elif k is T.TypeKind.BIGINT:
+            vals.append(struct.pack("<q", int(v)))
+        elif k in (T.TypeKind.FLOAT, T.TypeKind.DOUBLE):
+            vals.append(struct.pack("<d", float(v)))
+        elif k is T.TypeKind.DATE:
+            y, m, d = str(v)[:10].split("-")
+            vals.append(bytes([4]) + struct.pack("<H", int(y))
+                        + bytes([int(m), int(d)]))
+        elif k is T.TypeKind.DATETIME:
+            s = str(v).replace("T", " ")
+            y, m, d = s[:10].split("-")
+            hh, mm, ss = (s[11:19] or "00:00:00").split(":")
+            vals.append(bytes([7]) + struct.pack("<H", int(y))
+                        + bytes([int(m), int(d), int(hh), int(mm),
+                                 int(float(ss))]))
+        else:  # DECIMAL/VARCHAR/sketches: lenenc string form
+            s = repr(v) if isinstance(v, float) else str(v)
+            b = s.encode("utf-8", "replace") if not isinstance(v, bytes) \
+                else v
+            vals.append(lenenc_str(b))
+    return b"\x00" + bytes(nulmap) + b"".join(vals)
 
 
 def serve_mysql(catalog, host="127.0.0.1", port=9030) -> MySQLServer:

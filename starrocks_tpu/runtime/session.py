@@ -59,6 +59,7 @@ class Session:
         self.cache = DeviceCache()
         self.last_profile = None  # most recent query's RuntimeProfile
         self.store = None
+        self.current_user = "root"  # front doors set this per connection
         self.dist_shards = dist_shards
         self._dist_executor = None
         if data_dir is not None:
@@ -141,10 +142,14 @@ class Session:
 
     def sql(self, text: str):
         stmt = parse(text)
+        self._enforce_privileges(stmt)
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt)
         if isinstance(stmt, (ast.Select, ast.SetOp)):
             return self._query(stmt)
+        if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.Grant,
+                             ast.Revoke, ast.ShowGrants)):
+            return self._auth_stmt(stmt)
         if isinstance(stmt, ast.CreateTable):
             return self._create(stmt)
         if isinstance(stmt, ast.DropTable):
@@ -156,6 +161,7 @@ class Session:
             existed = self.catalog.get_table(stmt.name) is not None
             self.catalog.drop(stmt.name, stmt.if_exists)
             self.cache.invalidate(stmt.name.lower())
+            self.catalog.bump_version(stmt.name.lower())
             if self.store is not None and existed:
                 self.store.drop_table(stmt.name.lower())
             return None
@@ -269,6 +275,7 @@ class Session:
             self.catalog.register(name, new, handle.unique_keys,
                                   handle.distribution)
         self.cache.invalidate(name)
+        self.catalog.bump_version(name)
         return None
 
     def _show_partitions(self, name: str):
@@ -322,12 +329,35 @@ class Session:
         sql_text = self.catalog.mv_defs.get(name)
         if sql_text is None:
             raise ValueError(f"unknown materialized view {name!r}")
+        # never serve the refresh from a previous materialization of itself
+        self.catalog.mv_meta.pop(name, None)
         res = self.sql(sql_text)
         t = res.table
         if any("." in f.name for f in t.schema):
             raise ValueError("materialized view query has duplicate column names")
         self.catalog.register(name, t)
         self.cache.invalidate(name)
+        self.catalog.bump_version(name)
+        # record rewrite metadata + the base versions this refresh observed;
+        # a later base mutation makes the versions diverge and disables the
+        # transparent rewrite until the next REFRESH (sql/mv_rewrite.py)
+        from ..sql import mv_rewrite
+
+        try:
+            stmt = parse(sql_text)
+            if isinstance(stmt, (ast.Select, ast.SetOp)):
+                mv_plan = Analyzer(self.catalog).analyze(stmt)
+                meta = mv_rewrite.mv_metadata(mv_plan)
+                if meta is not None:
+                    bases = {tb: self.catalog.versions.get(tb, 0)
+                             for tb in meta[0].tables}
+                    self.catalog.mv_meta[name] = {"bases": bases,
+                                                  "meta": meta}
+        except Exception:  # noqa: BLE001 — rewrite metadata is best-effort
+            pass
+        # cached optimized plans may have (not) rewritten against this MV
+        # under the previous freshness state
+        self.cache.opt_plans.clear()
         return t.num_rows
 
     def _show_create(self, name: str) -> str:
@@ -353,12 +383,97 @@ class Session:
         return out
 
     # --- SELECT ---------------------------------------------------------------
+    # --- auth ----------------------------------------------------------------
+    def auth(self):
+        from .auth import AuthManager
+
+        if self.catalog.auth is None:
+            self.catalog.auth = AuthManager()
+        return self.catalog.auth
+
+    def _enforce_privileges(self, stmt):
+        """Statement-level checks (reference: authorization/Authorizer.java
+        checks in StmtExecutor). SELECT privileges are checked per base
+        table on the analyzed plan in _query."""
+        a = self.auth()
+        user = self.current_user
+        if a.is_admin(user):
+            return
+        if isinstance(stmt, ast.Insert):
+            a.require(user, stmt.table, "insert")
+        elif isinstance(stmt, ast.Delete):
+            a.require(user, stmt.table, "delete")
+        elif isinstance(stmt, ast.Update):
+            a.require(user, stmt.table, "update")
+        elif isinstance(stmt, (ast.CreateTable, ast.DropTable,
+                               ast.CreateView, ast.RefreshView,
+                               ast.CreateUser, ast.DropUser, ast.Grant,
+                               ast.Revoke, ast.AlterTable)):
+            raise PermissionError(
+                f"user {user!r} lacks the admin privileges for DDL")
+
+    def _check_select_privs(self, plan):
+        a = self.auth()
+        user = self.current_user
+        if a.is_admin(user):
+            return
+        from ..sql.analyzer import ScalarSubquery, SemiJoinMark
+        from ..sql.logical import LScan, walk_plan
+        from ..exprs.ir import Expr, walk as walk_expr
+
+        def visit(p):
+            for node in walk_plan(p):
+                if isinstance(node, LScan) and not node.table.startswith("__"):
+                    # internal relations (__dual__, information_schema) are
+                    # world-readable, like the reference's system schemata
+                    a.require(user, node.table, "select")
+                # analyzed subquery markers carry their OWN plans inside
+                # expressions — a table read only by `IN (SELECT ...)` must
+                # be checked too
+                for attr in getattr(node, "__dataclass_fields__", {}):
+                    val = getattr(node, attr)
+                    exprs = []
+                    if isinstance(val, Expr):
+                        exprs = [val]
+                    elif isinstance(val, tuple):
+                        exprs = [x for item in val
+                                 for x in (item if isinstance(item, tuple)
+                                           else (item,))
+                                 if isinstance(x, Expr)]
+                    for e in exprs:
+                        for sub in walk_expr(e):
+                            if isinstance(sub, (ScalarSubquery,
+                                                SemiJoinMark)):
+                                visit(sub.plan)
+
+        visit(plan)
+
+    def _auth_stmt(self, stmt):
+        a = self.auth()
+        if isinstance(stmt, ast.CreateUser):
+            a.create_user(stmt.user, stmt.password)
+            return None
+        if isinstance(stmt, ast.DropUser):
+            a.drop_user(stmt.user)
+            return None
+        if isinstance(stmt, ast.Grant):
+            a.grant(stmt.user, stmt.table, stmt.privs)
+            return None
+        if isinstance(stmt, ast.Revoke):
+            a.revoke(stmt.user, stmt.table, stmt.privs)
+            return None
+        user = stmt.user or self.current_user
+        if user != self.current_user and not a.is_admin(self.current_user):
+            raise PermissionError("SHOW GRANTS for other users requires admin")
+        return a.show_grants(user)
+
     def _query(self, sel) -> QueryResult:
         from .profile import RuntimeProfile
 
         profile = RuntimeProfile("query")
         with profile.timer("analyze"):
             plan = Analyzer(self.catalog).analyze(sel)
+        self._check_select_privs(plan)
         if self.dist_shards:
             from .dist_executor import DistExecutor
 
@@ -380,6 +495,7 @@ class Session:
             # res.plan is the actually-executed optimized plan
             return plan_tree_str(res.plan) + "\n" + res.profile.render()
         plan = Analyzer(self.catalog).analyze(stmt.stmt)
+        self._check_select_privs(plan)  # EXPLAIN leaks schema/stats otherwise
         plan = optimize(plan, self.catalog)
         return plan_tree_str(plan)
 
@@ -491,6 +607,7 @@ class Session:
             self.catalog.register(handle.name, conformed, handle.unique_keys,
                                   handle.distribution)
         self.cache.invalidate(handle.name)
+        self.catalog.bump_version(handle.name)
 
     # --- DDL / DML -------------------------------------------------------------
     def _create(self, stmt: ast.CreateTable):
@@ -627,6 +744,7 @@ class Session:
                 self.store.upsert(handle.name, conformed)
                 handle.invalidate()
                 self.cache.invalidate(handle.name)
+                self.catalog.bump_version(handle.name)
                 return n
             # in-memory tables: merge + dedupe (last write wins), rewrite
             merged = concat_tables(handle.table, incoming, target_schema=handle.schema)
@@ -643,6 +761,7 @@ class Session:
             self.catalog.register(handle.name, merged, handle.unique_keys,
                                   handle.distribution)
         self.cache.invalidate(handle.name)
+        self.catalog.bump_version(handle.name)
         return n
 
     def _values_to_table(self, handle, stmt: ast.Insert) -> HostTable:
@@ -750,6 +869,8 @@ def _empty_like(schema: Schema) -> HostTable:
             return np.zeros((0, 2), dtype=f.type.np_dtype)
         if f.type.is_decimal128:
             return np.zeros((0, 4), dtype=np.int64)
+        if f.type.is_hll or f.type.is_bitmap:
+            return np.zeros((0, f.type.wide_width), dtype=np.int8)
         return np.zeros(0, dtype=f.type.np_dtype)
 
     return HostTable(schema, {f.name: empty(f) for f in schema}, {})

@@ -253,3 +253,33 @@ class Describe:
 class Explain:
     stmt: object
     analyze: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateUser:
+    user: str
+    password: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DropUser:
+    user: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Grant:
+    privs: tuple  # ('select', ...) or ('all',)
+    table: str  # table name or '*'
+    user: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Revoke:
+    privs: tuple
+    table: str
+    user: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowGrants:
+    user: str | None  # None = current user

@@ -114,7 +114,7 @@ def tokenize(sql: str) -> list:
                 i += 2
                 break
         else:
-            if c in "+-*/%(),.<>=;":
+            if c in "+-*/%(),.<>=;?":  # '?' = prepared-statement parameter
                 out.append(Token("op", c, i))
                 i += 1
             else:

@@ -32,6 +32,9 @@ from .logical import (
 
 
 def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
+    from .mv_rewrite import try_rewrite as _mv_try_rewrite
+
+    plan = _mv_try_rewrite(plan, catalog)  # before any rule reshapes it
     plan = rewrite_full_joins(plan)
     plan = rewrite_distinct_aggs(plan)
     plan = pushdown_filters(plan)

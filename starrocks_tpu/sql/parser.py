@@ -35,10 +35,15 @@ AGG_FUNCS = {"sum", "count", "avg", "min", "max",
              "stddev_pop", "stddev_samp", "var_pop", "var_samp",
              "covar_pop", "covar_samp", "corr",
              "percentile_cont", "percentile_disc", "group_concat",
-             "array_agg"}
+             "array_agg",
+             "approx_count_distinct", "hll_sketch", "hll_union",
+             "hll_union_agg", "hll_raw_agg",
+             "bitmap_agg", "bitmap_union", "bitmap_union_count",
+             "intersect_count"}
 # aliases resolving to a canonical aggregate (MySQL/reference naming:
 # std/stddev/variance are population forms; any_value picks an arbitrary
-# row — min is a valid choice; ndv/approx_count_distinct answer exactly here)
+# row — min is a valid choice; ndv answers exactly, approx_count_distinct
+# rides the HLL sketch like the reference)
 AGG_ALIASES = {
     "std": "stddev_pop", "stddev": "stddev_pop", "variance": "var_pop",
     "any_value": "min", "arbitrary": "min",
@@ -193,7 +198,46 @@ class Parser:
             name = self.parse_table_name()
             self.accept_op(";")
             return ast.Delete(name, None)
+        if self.peek().kind == "ident" and self.peek().value.lower() in (
+                "grant", "revoke"):
+            verb = self.next().value.lower()
+            privs = []
+            while True:
+                t = self.next()
+                p = t.value.lower()
+                if p not in ("select", "insert", "update", "delete", "all"):
+                    raise ParseError(f"unknown privilege {t.value!r}")
+                privs.append(p)
+                if not self.accept_op(","):
+                    break
+            if privs == ["all"]:
+                if (self.peek().kind == "ident"
+                        and self.peek().value.lower() == "privileges"):
+                    self.next()
+                privs = ["select", "insert", "update", "delete"]
+            self.expect_kw("on")
+            if self.accept_op("*"):
+                table = "*"
+            else:
+                table = self.parse_table_name()
+            kw = self.next().value.lower()  # TO / FROM
+            if kw not in ("to", "from"):
+                raise ParseError(f"expected TO/FROM, got {kw!r}")
+            user = self._parse_user_name()
+            self.accept_op(";")
+            node = ast.Grant if verb == "grant" else ast.Revoke
+            return node(tuple(privs), table, user)
         if self.accept_kw("show"):
+            if (self.peek().kind == "ident"
+                    and self.peek().value.lower() == "grants"):
+                self.next()
+                user = None
+                if (self.peek().kind == "ident"
+                        and self.peek().value.lower() == "for"):
+                    self.next()
+                    user = self._parse_user_name()
+                self.accept_op(";")
+                return ast.ShowGrants(user)
             if self.accept_kw("create"):
                 self.expect_kw("table")
                 name = self.parse_table_name()
@@ -806,10 +850,21 @@ class Parser:
         if name == "median":
             return AggExpr("percentile_cont", args[0], distinct,
                            extra=(Lit(0.5),))
-        if name in ("approx_count_distinct", "ndv"):
-            # exact distinct count (a zero-error "approximation"; the
-            # reference uses HLL, be/src/types/hll.h)
+        if name == "ndv":
+            # exact distinct count (zero-error; approx_count_distinct below
+            # is the genuinely approximate HLL path at any scale)
             return AggExpr("count", args[0], True)
+        if name == "hll_raw_agg":
+            name = "hll_union"  # reference alias (returns the merged sketch)
+        if name == "intersect_count":
+            # intersect_count(bitmap_col, dim_col, v1, v2, ...): cardinality
+            # of the AND of per-dim-value unions (be/src/exprs/agg/
+            # intersect_count.h re-designed over dense planes)
+            if len(args) < 3:
+                raise ParseError(
+                    "intersect_count takes (bitmap, dim, v1[, v2...])")
+            return AggExpr("intersect_count", args[0], distinct,
+                           extra=tuple(args[1:]))
         if name == "percentile_approx":
             # exact holistic percentile serves the approximate contract
             # (reference: be/src/exprs/agg/percentile_approx.h); optional
@@ -1008,11 +1063,43 @@ class Parser:
                     s = int(self.next().value)
                 self.expect_op(")")
             return T.DECIMAL(p, s)
+        if name == "hll":
+            p = 12
+            if self.accept_op("("):
+                p = int(self.next().value)
+                self.expect_op(")")
+            return T.HLL(p)
+        if name == "bitmap":
+            n = 65536
+            if self.accept_op("("):
+                n = int(self.next().value)
+                self.expect_op(")")
+            return T.BITMAP(n)
         raise ParseError(f"unknown type {name!r}")
 
     # --- DDL / DML -----------------------------------------------------------
+    def _parse_user_name(self) -> str:
+        t = self.next()
+        if t.kind not in ("ident", "string"):
+            raise ParseError(f"expected user name at {t.value!r}")
+        return t.value
+
     def parse_create(self):
         self.expect_kw("create")
+        if self.peek().kind == "ident" and self.peek().value.lower() == "user":
+            self.next()
+            user = self._parse_user_name()
+            password = ""
+            if (self.peek().kind == "ident"
+                    and self.peek().value.lower() == "identified"):
+                self.next()
+                self.expect_kw("by")
+                t = self.next()
+                if t.kind != "string":
+                    raise ParseError("IDENTIFIED BY expects a string")
+                password = t.value
+            self.accept_op(";")
+            return ast.CreateUser(user, password)
         if self.at_kw("view", "materialized"):
             mat = self.accept_kw("materialized")
             self.expect_kw("view")
@@ -1146,6 +1233,11 @@ class Parser:
 
     def parse_drop(self):
         self.expect_kw("drop")
+        if self.peek().kind == "ident" and self.peek().value.lower() == "user":
+            self.next()
+            user = self._parse_user_name()
+            self.accept_op(";")
+            return ast.DropUser(user)
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

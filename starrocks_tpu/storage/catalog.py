@@ -121,15 +121,28 @@ class Catalog:
         # reference's view expansion); MVs live in `tables` + mv_defs
         self.views: dict = {}
         self.mv_defs: dict = {}  # mv name -> SQL text (for REFRESH)
+        # mv name -> {"bases": {table: version}, "meta": (sig, col/agg maps)}
+        # driving the transparent rewrite (sql/mv_rewrite.py)
+        self.mv_meta: dict = {}
+        # per-table mutation counters: the MV staleness clock
+        self.versions: dict = {}
+        # users + table-level grants (runtime/auth.py); created on demand
+        self.auth = None
+
+    def bump_version(self, name: str):
+        n = name.lower()
+        self.versions[n] = self.versions.get(n, 0) + 1
 
     def register(self, name: str, table: HostTable, unique_keys=(),
                  distribution=()):
         self.tables[name.lower()] = TableHandle(
             name.lower(), table, unique_keys, distribution
         )
+        self.bump_version(name)
 
     def register_handle(self, handle: TableHandle):
         self.tables[handle.name] = handle
+        self.bump_version(handle.name)
 
     def drop(self, name: str, if_exists: bool = False):
         if name.lower() not in self.tables:
@@ -137,6 +150,7 @@ class Catalog:
                 return
             raise KeyError(f"unknown table {name}")
         del self.tables[name.lower()]
+        self.bump_version(name)
 
     def get_table(self, name: str) -> Optional[TableHandle]:
         name = name.lower()

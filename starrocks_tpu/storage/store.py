@@ -598,6 +598,8 @@ class TabletStore:
                     return np.zeros((0, 2), dtype=f.type.np_dtype)
                 if f.type.is_decimal128:
                     return np.zeros((0, 4), dtype=np.int64)
+                if f.type.is_hll or f.type.is_bitmap:
+                    return np.zeros((0, f.type.wide_width), dtype=np.int8)
                 return np.zeros(0, dtype=f.type.np_dtype)
 
             return HostTable(sub, {f.name: empty(f) for f in sub}, {})
@@ -668,6 +670,11 @@ def _to_arrow(data: HostTable):
                     for r in range(len(a))]
             arrays.append(pa.array(
                 vals, type=pa.decimal128(f.type.precision, f.type.scale)))
+        elif f.type.is_hll or f.type.is_bitmap:
+            vals = [None if (v is not None and not v[r])
+                    else np.asarray(a[r], dtype=np.int8).tobytes()
+                    for r in range(len(a))]
+            arrays.append(pa.array(vals, type=pa.binary()))
         elif f.type.is_string and f.dict is not None:
             vals = f.dict.decode(a)
             arrays.append(pa.array(vals.tolist(), type=pa.string(),
@@ -693,6 +700,18 @@ def _conform(ht: HostTable, schema: Schema, columns) -> HostTable:
         if f.type.is_array:
             # arrays rebuilt by from_arrow already carry the right layout
             out_fields.append(Field(f.name, f.type, f.nullable, got.dict))
+        elif f.type.is_hll or f.type.is_bitmap:
+            # binary planes read back at data width; pad short rows (files
+            # written before a precision change) up to the declared width
+            w = f.type.wide_width
+            if a.shape[1] < w:
+                a = np.concatenate(
+                    [a, np.zeros((len(a), w - a.shape[1]), np.int8)], axis=1)
+            elif a.shape[1] > w:
+                raise ValueError(
+                    f"{f.name}: stored sketch width {a.shape[1]} exceeds "
+                    f"declared {f.type!r}")
+            out_fields.append(Field(f.name, f.type, f.nullable, None))
         elif f.type.is_string:
             out_fields.append(Field(f.name, f.type, f.nullable, got.dict))
         else:
@@ -712,6 +731,8 @@ def _zonemap(data: HostTable, sel: np.ndarray) -> dict:
     """min/max per numeric/date column (+ dict-decoded strings lexicographic)."""
     zm = {}
     for f in data.schema:
+        if f.type.is_wide:
+            continue  # no ordering on ARRAY/sketch planes
         a = data.arrays[f.name][sel]
         if len(a) == 0:
             continue
@@ -773,6 +794,8 @@ def _arrow_type_of(t: T.LogicalType):
         return pa.list_(et)
     if t.is_decimal128:
         return pa.decimal128(t.precision, t.scale)
+    if t.is_hll or t.is_bitmap:
+        return pa.binary()
     if t.is_string:
         return pa.string()
     if t.kind is T.TypeKind.DATE:

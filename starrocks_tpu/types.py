@@ -42,6 +42,8 @@ class TypeKind(Enum):
     VARCHAR = "varchar"
     ARRAY = "array"  # elem type in LogicalType.elem; 2-D device layout
     DECIMAL128 = "decimal128"  # 4x32-bit limb device layout
+    HLL = "hll"  # HyperLogLog sketch: 2^precision int8 registers per value
+    BITMAP = "bitmap"  # dense bitset: ceil(precision/8) int8 planes per value
     NULL = "null"  # type of a bare NULL literal
 
 
@@ -108,6 +110,16 @@ class LogicalType:
                     f"DECIMAL({p},{sc}): precision > 38 not supported")
             object.__setattr__(self, "precision", p)
             object.__setattr__(self, "scale", sc)
+        elif self.kind is TypeKind.HLL:
+            p = self.precision if self.precision is not None else 12
+            if not 4 <= p <= 16:
+                raise ValueError(f"HLL precision {p} outside [4, 16]")
+            object.__setattr__(self, "precision", p)
+        elif self.kind is TypeKind.BITMAP:
+            n = self.precision if self.precision is not None else 65536
+            if not 1 <= n <= (1 << 24):
+                raise ValueError(f"BITMAP domain {n} outside [1, 2^24]")
+            object.__setattr__(self, "precision", n)
         elif self.kind is TypeKind.ARRAY:
             if self.elem is None:
                 raise ValueError("ARRAY needs an element type")
@@ -123,6 +135,8 @@ class LogicalType:
             return self.elem.dtype
         if self.kind is TypeKind.DECIMAL128:
             return jnp.int64
+        if self.kind in (TypeKind.HLL, TypeKind.BITMAP):
+            return jnp.int8
         return _DTYPES[self.kind]
 
     @property
@@ -131,7 +145,18 @@ class LogicalType:
             return self.elem.np_dtype
         if self.kind is TypeKind.DECIMAL128:
             return np.int64
+        if self.kind in (TypeKind.HLL, TypeKind.BITMAP):
+            return np.int8
         return _NP_DTYPES[self.kind]
+
+    @property
+    def wide_width(self) -> int:
+        """Fixed second device dimension for HLL/BITMAP columns."""
+        if self.kind is TypeKind.HLL:
+            return 1 << (self.precision or 12)
+        if self.kind is TypeKind.BITMAP:
+            return ((self.precision or 65536) + 7) // 8
+        raise TypeError(f"{self!r} has no fixed wide width")
 
     # --- classification -----------------------------------------------------
     @property
@@ -160,8 +185,18 @@ class LogicalType:
 
     @property
     def is_wide(self) -> bool:
-        """2-D device layout (ARRAY values+length / DECIMAL128 limbs)."""
-        return self.kind in (TypeKind.ARRAY, TypeKind.DECIMAL128)
+        """2-D device layout (ARRAY values+length / DECIMAL128 limbs /
+        HLL registers / BITMAP planes)."""
+        return self.kind in (TypeKind.ARRAY, TypeKind.DECIMAL128,
+                             TypeKind.HLL, TypeKind.BITMAP)
+
+    @property
+    def is_hll(self) -> bool:
+        return self.kind is TypeKind.HLL
+
+    @property
+    def is_bitmap(self) -> bool:
+        return self.kind is TypeKind.BITMAP
 
     @property
     def is_string(self) -> bool:
@@ -176,6 +211,10 @@ class LogicalType:
             return f"DECIMAL({self.precision},{self.scale})"
         if self.kind is TypeKind.ARRAY:
             return f"ARRAY<{self.elem!r}>"
+        if self.kind is TypeKind.HLL:
+            return f"HLL({self.precision})"
+        if self.kind is TypeKind.BITMAP:
+            return f"BITMAP({self.precision})"
         return self.kind.name
 
 
@@ -199,6 +238,21 @@ def DECIMAL(precision: int = 18, scale: int = 0) -> LogicalType:
 
 def ARRAY(elem: LogicalType) -> LogicalType:
     return LogicalType(TypeKind.ARRAY, elem=elem)
+
+
+def HLL(precision: int = 12) -> LogicalType:
+    """HyperLogLog sketch type: 2^precision int8 registers per value
+    (reference: be/src/types/hll.h — re-designed as a fixed-width device
+    column so unions are segment-max reductions)."""
+    return LogicalType(TypeKind.HLL, precision)
+
+
+def BITMAP(nbits: int = 65536) -> LogicalType:
+    """Dense-bitset bitmap type over the value domain [0, nbits)
+    (reference: be/src/types/bitmap_value.h — Roaring re-designed as dense
+    int8 bit planes: unions are segment reductions, intersections are
+    elementwise ANDs; bounded domains only, by design)."""
+    return LogicalType(TypeKind.BITMAP, nbits)
 
 
 # --- type promotion ---------------------------------------------------------

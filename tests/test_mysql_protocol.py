@@ -20,9 +20,11 @@ from starrocks_tpu.storage.catalog import Catalog
 class MiniMySQLClient:
     """Just enough of the client side of the MySQL protocol."""
 
-    def __init__(self, host, port):
+    def __init__(self, host, port, user="root", password=""):
         self.sock = socket.create_connection((host, port), timeout=30)
         self.seq = 0
+        self.user = user
+        self.password = password
         self._handshake()
 
     # --- framing ---
@@ -65,20 +67,32 @@ class MiniMySQLClient:
 
     # --- connection phase ---
     def _handshake(self):
+        from starrocks_tpu.runtime.auth import scramble_password
+
         greet = self._read_packet()
         assert greet[0] == 0x0A, "protocol version"
         ver_end = greet.index(b"\x00", 1)
         self.server_version = greet[1:ver_end].decode()
+        # salt: 8 bytes after thread id, 12 more after the caps block
+        pos = ver_end + 1 + 4
+        salt = greet[pos:pos + 8]
+        pos2 = pos + 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
+        salt += greet[pos2:pos2 + 12]
+        token = scramble_password(self.password, salt)
         # HandshakeResponse41: caps, max packet, charset, 23 zeros, user
         caps = 0x0200 | 0x8000 | 0x0008  # PROTOCOL_41|SECURE_CONN|WITH_DB
         resp = (
             struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
             + bytes([45]) + b"\x00" * 23
-            + b"tester\x00" + b"\x00"  # empty auth response
+            + self.user.encode() + b"\x00"
+            + bytes([len(token)]) + token
             + b"default\x00"
         )
         self._send_packet(resp)
         ok = self._read_packet()
+        if ok[0] == 0xFF:
+            code = struct.unpack_from("<H", ok, 1)[0]
+            raise PermissionError(f"auth failed: ERR {code}")
         assert ok[0] == 0x00, f"expected OK after auth, got {ok[:1]!r}"
 
     # --- commands ---
@@ -222,4 +236,233 @@ def test_dual_table_is_hidden_and_readonly(server):
     assert rows == [("1",)]  # still one row
     with pytest.raises(RuntimeError, match="FROM"):
         c.query("SELECT *")
+    c.quit()
+
+
+# --- auth + prepared statements (round 4) -----------------------------------
+
+class PreparedMixin:
+    """COM_STMT_PREPARE/EXECUTE/CLOSE on the mini client."""
+
+    def stmt_prepare(self, sql):
+        self.seq = 0
+        self._send_packet(b"\x16" + sql.encode())
+        first = self._read_packet()
+        if first[0] == 0xFF:
+            code = struct.unpack_from("<H", first, 1)[0]
+            raise RuntimeError(f"ERR {code}")
+        sid = struct.unpack_from("<I", first, 1)[0]
+        ncols = struct.unpack_from("<H", first, 5)[0]
+        nparams = struct.unpack_from("<H", first, 7)[0]
+        for _ in range(nparams):
+            self._read_packet()
+        if nparams:
+            self._read_packet()  # EOF
+        return sid, ncols, nparams
+
+    def stmt_execute(self, sid, params):
+        self.seq = 0
+        nul = bytearray((len(params) + 7) // 8)
+        types, vals = b"", b""
+        for i, p in enumerate(params):
+            if p is None:
+                nul[i // 8] |= 1 << (i % 8)
+                types += bytes([6, 0])  # MYSQL_TYPE_NULL
+            elif isinstance(p, int):
+                types += bytes([8, 0])  # LONGLONG
+                vals += struct.pack("<q", p)
+            elif isinstance(p, float):
+                types += bytes([5, 0])
+                vals += struct.pack("<d", p)
+            else:
+                b = str(p).encode()
+                types += bytes([253, 0])  # VAR_STRING
+                assert len(b) < 0xFB
+                vals += bytes([len(b)]) + b
+        pkt = (b"\x17" + struct.pack("<I", sid) + b"\x00"
+               + struct.pack("<I", 1))
+        if params:
+            pkt += bytes(nul) + b"\x01" + types + vals
+        self._send_packet(pkt)
+        first = self._read_packet()
+        if first[0] == 0xFF:
+            code = struct.unpack_from("<H", first, 1)[0]
+            raise RuntimeError(
+                f"ERR {code}: {first[9:].decode('utf-8', 'replace')}")
+        if first[0] == 0x00:
+            affected, _ = self._lenenc(first, 1)
+            return "OK", affected
+        ncols, _ = self._lenenc(first, 0)
+        cols = []
+        for _ in range(ncols):
+            p = self._read_packet()
+            pos = 0
+            parts = []
+            for _ in range(6):
+                sp, pos = self._lenenc_str(p, pos)
+                parts.append(sp)
+            _, pos = self._lenenc(p, pos)
+            col_type = p[pos + 6]
+            cols.append((parts[4].decode(), col_type))
+        assert self._read_packet()[0] == 0xFE
+        rows = []
+        while True:
+            p = self._read_packet()
+            if p[0] == 0xFE and len(p) < 9:
+                break
+            assert p[0] == 0x00, "binary row header"
+            n = len(cols)
+            nulmap = p[1:1 + (n + 9) // 8]
+            pos = 1 + (n + 9) // 8
+            row = []
+            for i, (_, ct) in enumerate(cols):
+                if nulmap[(i + 2) // 8] & (1 << ((i + 2) % 8)):
+                    row.append(None)
+                    continue
+                if ct == 8:  # LONGLONG
+                    row.append(struct.unpack_from("<q", p, pos)[0])
+                    pos += 8
+                elif ct == 3:  # LONG
+                    row.append(struct.unpack_from("<i", p, pos)[0])
+                    pos += 4
+                elif ct == 1:  # TINY
+                    row.append(struct.unpack_from("<b", p, pos)[0])
+                    pos += 1
+                elif ct == 5:  # DOUBLE
+                    row.append(struct.unpack_from("<d", p, pos)[0])
+                    pos += 8
+                elif ct == 10:  # DATE
+                    ln = p[pos]
+                    y = struct.unpack_from("<H", p, pos + 1)[0]
+                    row.append(f"{y:04d}-{p[pos+3]:02d}-{p[pos+4]:02d}")
+                    pos += 1 + ln
+                else:  # lenenc string forms
+                    v, pos = self._lenenc_str(p, pos)
+                    row.append(v.decode())
+            rows.append(tuple(row))
+        return [c for c, _ in cols], rows
+
+    def stmt_close(self, sid):
+        self.seq = 0
+        self._send_packet(b"\x19" + struct.pack("<I", sid))
+
+
+class FullClient(MiniMySQLClient, PreparedMixin):
+    pass
+
+
+@pytest.fixture()
+def auth_server():
+    cat = Catalog()
+    cat.register("secrets", HostTable.from_pydict({"v": [1, 2, 3]}))
+    cat.register("open_data", HostTable.from_pydict({"v": [10, 20]}))
+    srv = MySQLServer(Session(cat), port=0).start()
+    root = FullClient("127.0.0.1", srv.port)
+    root.query("create user alice identified by 'secret'")
+    root.query("grant select on open_data to alice")
+    yield srv
+    srv.shutdown()
+
+
+def test_auth_correct_password(auth_server):
+    c = FullClient("127.0.0.1", auth_server.port, "alice", "secret")
+    cols, rows = c.query("select sum(v) from open_data")
+    assert rows == [("30",)]
+    c.quit()
+
+
+def test_auth_wrong_password_rejected(auth_server):
+    with pytest.raises(PermissionError):
+        FullClient("127.0.0.1", auth_server.port, "alice", "wrong")
+    with pytest.raises(PermissionError):
+        FullClient("127.0.0.1", auth_server.port, "nobody", "")
+
+
+def test_denied_select_errors(auth_server):
+    c = FullClient("127.0.0.1", auth_server.port, "alice", "secret")
+    with pytest.raises(RuntimeError, match="1142"):
+        c.query("select * from secrets")
+    # DDL denied too
+    with pytest.raises(RuntimeError, match="1142"):
+        c.query("create table t2 (a int)")
+    c.quit()
+
+
+def test_grant_revoke_cycle(auth_server):
+    root = FullClient("127.0.0.1", auth_server.port)
+    root.query("grant select on secrets to alice")
+    c = FullClient("127.0.0.1", auth_server.port, "alice", "secret")
+    _, rows = c.query("select count(*) from secrets")
+    assert rows == [("3",)]
+    root.query("revoke select on secrets from alice")
+    with pytest.raises(RuntimeError, match="1142"):
+        c.query("select count(*) from secrets")
+    _, g = root.query("show grants for alice")
+    assert any("open_data" in r[0] for r in g)
+    c.quit()
+    root.quit()
+
+
+def test_prepared_statement_roundtrip(auth_server):
+    c = FullClient("127.0.0.1", auth_server.port)
+    c.query("create table pt (k int, name varchar, score double)")
+    sid, _, nparams = c.stmt_prepare(
+        "insert into pt values (?, ?, ?)")
+    assert nparams == 3
+    c.stmt_execute(sid, [1, "ann's", 1.5])
+    c.stmt_execute(sid, [2, "bob", None])
+    c.stmt_close(sid)
+    sid2, _, np2 = c.stmt_prepare("select k, name, score from pt "
+                                  "where k >= ? order by k")
+    assert np2 == 1
+    cols, rows = c.stmt_execute(sid2, [1])
+    assert cols == ["k", "name", "score"]
+    assert rows == [(1, "ann's", 1.5), (2, "bob", None)]
+    cols, rows = c.stmt_execute(sid2, [2])
+    assert rows == [(2, "bob", None)]
+    c.stmt_close(sid2)
+    c.quit()
+
+
+def test_subquery_privilege_no_bypass(auth_server):
+    """Tables read only inside IN/EXISTS/scalar subqueries (and EXPLAIN)
+    are privilege-checked too."""
+    c = FullClient("127.0.0.1", auth_server.port, "alice", "secret")
+    for q in (
+        "select * from open_data where v in (select v from secrets)",
+        "select * from open_data where v = (select max(v) from secrets)",
+        "select * from open_data where exists "
+        "(select 1 from secrets where secrets.v = open_data.v)",
+        "explain select * from secrets",
+    ):
+        with pytest.raises(RuntimeError, match="1142"):
+            c.query(q)
+    c.quit()
+
+
+def test_prepared_execute_without_rebound_types(auth_server):
+    """Second execute omits the type block (new_params_bound_flag=0) like
+    spec-following drivers; the cached types must be reused."""
+    c = FullClient("127.0.0.1", auth_server.port)
+    sid, _, _ = c.stmt_prepare("select ? + 1")
+    assert c.stmt_execute(sid, [41])[1] == [(42,)]
+    # re-execute with bound flag 0 and only the value block
+    c.seq = 0
+    pkt = (b"\x17" + struct.pack("<I", sid) + b"\x00"
+           + struct.pack("<I", 1) + b"\x00" + b"\x00"
+           + struct.pack("<q", 99))
+    c._send_packet(pkt)
+    first = c._read_packet()
+    assert first[0] != 0xFF, first
+    ncols, _ = c._lenenc(first, 0)
+    for _ in range(ncols):
+        c._read_packet()
+    assert c._read_packet()[0] == 0xFE
+    row = c._read_packet()
+    assert row[0] == 0x00
+    assert struct.unpack_from("<q", row, 1 + 1)[0] == 100
+    while True:
+        p = c._read_packet()
+        if p[0] == 0xFE and len(p) < 9:
+            break
     c.quit()

@@ -1,0 +1,195 @@
+"""HLL + BITMAP sketch types: accuracy fuzz, SQL surface, storage
+round-trip, distributed-vs-single-chip agreement (VERDICT r4 item 5).
+
+Reference behavior: be/src/types/hll.h (HLL_UNION_AGG / HLL_CARDINALITY),
+be/src/types/bitmap_value.h + be/src/exprs/bitmap_functions.cpp
+(BITMAP_UNION_COUNT / INTERSECT_COUNT), re-designed as dense fixed-width
+device columns (ops/sketch.py)."""
+
+import numpy as np
+import pytest
+
+from starrocks_tpu.column import HostTable
+from starrocks_tpu.runtime.config import config
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.storage.catalog import Catalog
+
+
+def _sess(tables: dict) -> Session:
+    cat = Catalog()
+    for name, data in tables.items():
+        if isinstance(data, HostTable):
+            cat.register(name, data)
+        else:
+            cat.register(name, HostTable.from_pydict(data))
+    return Session(cat)
+
+
+def test_hll_estimate_accuracy_1m_fuzz():
+    """approx_count_distinct within ~2% of exact on 1M rows (p=12 ->
+    theoretical rel. error 1.04/sqrt(4096) = 1.6%)."""
+    rng = np.random.default_rng(5)
+    true_ndv = 137_813
+    vals = rng.integers(0, true_ndv, 1_000_000)
+    vals[:true_ndv] = np.arange(true_ndv)  # every value present
+    s = _sess({"t": {"v": vals}})
+    est = s.sql("select approx_count_distinct(v) from t").rows()[0][0]
+    assert abs(est - true_ndv) / true_ndv < 0.02, (est, true_ndv)
+    exact = s.sql("select ndv(v) from t").rows()[0][0]
+    assert exact == true_ndv  # ndv stays exact
+
+
+def test_hll_grouped_and_strings():
+    rng = np.random.default_rng(6)
+    n = 200_000
+    g = rng.integers(0, 4, n)
+    v = rng.integers(0, 50_000, n)
+    s = _sess({"t": {"g": g, "s": [f"u{x}" for x in v]}})
+    got = s.sql("select g, approx_count_distinct(s) from t "
+                "group by g order by g").rows()
+    import pandas as pd
+
+    df = pd.DataFrame({"g": g, "s": [f"u{x}" for x in v]})
+    exact = df.groupby("g").s.nunique()
+    assert len(got) == 4
+    for gid, est in got:
+        assert abs(est - exact[gid]) / exact[gid] < 0.05, (gid, est)
+
+
+def test_hll_sketch_column_union_roundtrip(tmp_path):
+    """Sketches materialize into a table, survive parquet storage, and
+    hll_union / hll_cardinality work over the stored column."""
+    rng = np.random.default_rng(7)
+    n = 100_000
+    part = rng.integers(0, 8, n)
+    user = rng.integers(0, 20_000, n)
+    s = _sess({"raw": {"p": part, "u": user}})
+    s.store_root = None  # in-memory catalog; storage tested below
+    s.sql("create table daily as select p, hll_sketch(u) as users "
+          "from raw group by p")
+    # per-partition sketches re-merge to the global estimate
+    est = s.sql("select hll_union_agg(users) from daily").rows()[0][0]
+    true_ndv = len(np.unique(user))
+    assert abs(est - true_ndv) / true_ndv < 0.03, (est, true_ndv)
+    merged = s.sql(
+        "select hll_cardinality(hll_union(users)) from daily").rows()[0][0]
+    assert merged == est
+
+
+def test_hll_storage_roundtrip(tmp_path):
+    rng = np.random.default_rng(8)
+    s = Session(data_dir=str(tmp_path))
+    cat = s.catalog
+    s.sql("create table agg_t (k int, users hll(12))")
+    raw = _sess({"raw": {"k": rng.integers(0, 3, 50_000),
+                         "u": rng.integers(0, 9_000, 50_000)}})
+    sk = raw.sql("select k, hll_sketch(u) as users from raw group by k")
+    rows = sk.rows()
+    # insert the sketch rows (binary planes) through the normal write path
+    from starrocks_tpu import types as T
+
+    ht = HostTable.from_pydict(
+        {"k": [r[0] for r in rows], "users": [r[1] for r in rows]},
+        types={"k": T.INT, "users": T.HLL(12)})
+    s._append(cat.get_table("agg_t"), ht)
+    est = s.sql("select hll_union_agg(users) from agg_t").rows()[0][0]
+    true_ndv = len(np.unique(raw.catalog.get_table(
+        "raw").table.arrays["u"]))
+    assert abs(est - true_ndv) / true_ndv < 0.03, (est, true_ndv)
+
+
+def test_bitmap_agg_exact_counts():
+    rng = np.random.default_rng(9)
+    n = 300_000
+    g = rng.integers(0, 5, n)
+    v = rng.integers(0, 3_000, n)
+    s = _sess({"t": {"g": g, "v": v}})
+    got = s.sql("select g, bitmap_union_count(to_bitmap(v)) from t "
+                "group by g order by g").rows()
+    import pandas as pd
+
+    exact = pd.DataFrame({"g": g, "v": v}).groupby("g").v.nunique()
+    assert got == [(int(k), int(exact[k])) for k in sorted(exact.index)]
+
+
+def test_bitmap_union_count_composes_over_stored_bitmaps():
+    rng = np.random.default_rng(10)
+    n = 120_000
+    day = rng.integers(0, 10, n)
+    site = rng.integers(0, 2, n)
+    user = rng.integers(0, 2_500, n)
+    s = _sess({"t": {"dy": day, "site": site, "u": user}})
+    s.sql("create table daily as select dy, site, "
+          "bitmap_agg(u) as users from t group by dy, site")
+    got = s.sql("select site, bitmap_union_count(users) from daily "
+                "group by site order by site").rows()
+    import pandas as pd
+
+    exact = pd.DataFrame({"site": site, "u": user}).groupby(
+        "site").u.nunique()
+    assert got == [(int(k), int(exact[k])) for k in sorted(exact.index)]
+
+
+def test_intersect_count_and_scalar_bitmap_fns():
+    rng = np.random.default_rng(11)
+    n = 80_000
+    dim = rng.integers(1, 4, n)  # 1, 2, 3
+    user = rng.integers(0, 1_500, n)
+    s = _sess({"t": {"dim": dim, "u": user}})
+    s.sql("create table by_dim as select dim, bitmap_agg(u) as users "
+          "from t group by dim")
+    got = s.sql("select intersect_count(users, dim, 1, 2) from by_dim"
+                ).rows()[0][0]
+    u1 = set(user[dim == 1])
+    u2 = set(user[dim == 2])
+    assert got == len(u1 & u2)
+    # scalar and/or/count/contains over two bitmap values
+    r = s.sql("""select bitmap_count(bitmap_and(a.users, b.users)),
+                        bitmap_count(bitmap_or(a.users, b.users)),
+                        bitmap_contains(a.users, 0)
+                 from by_dim a, by_dim b
+                 where a.dim = 1 and b.dim = 2""").rows()[0]
+    assert r[0] == len(u1 & u2)
+    assert r[1] == len(u1 | u2)
+    assert r[2] == (0 in u1)
+
+
+def test_bitmap_storage_roundtrip(tmp_path):
+    rng = np.random.default_rng(12)
+    s = Session(data_dir=str(tmp_path))
+    cat = s.catalog
+    s.sql("create table bm (k int, users bitmap(4096))")
+    from starrocks_tpu import types as T
+
+    vals = [sorted(set(rng.integers(0, 4096, 50).tolist())) for _ in range(3)]
+    def planes(vs):
+        b = np.zeros(512, dtype=np.uint8)
+        for x in vs:
+            b[x >> 3] |= 1 << (x & 7)
+        return b.astype(np.int8).tobytes()
+    ht = HostTable.from_pydict(
+        {"k": [0, 1, 2], "users": [planes(v) for v in vals]},
+        types={"k": T.INT, "users": T.BITMAP(4096)})
+    s._append(cat.get_table("bm"), ht)
+    got = s.sql("select k, bitmap_count(users) from bm order by k").rows()
+    assert got == [(i, len(vals[i])) for i in range(3)]
+    tot = s.sql("select bitmap_union_count(users) from bm").rows()[0][0]
+    assert tot == len(set().union(*map(set, vals)))
+
+
+def test_sketch_aggs_distributed_match_single_chip(eight_devices):
+    """The distributed planner gathers rows for holistic sketch aggs — the
+    result must be bit-identical to single-chip."""
+    rng = np.random.default_rng(13)
+    n = 100_000
+    g = rng.integers(0, 6, n)
+    v = rng.integers(0, 20_000, n)
+    cat = Catalog()
+    cat.register("t", HostTable.from_pydict({"g": g, "v": v}))
+    single = Session(cat)
+    q = ("select g, approx_count_distinct(v), "
+         "bitmap_union_count(v) from t group by g order by g")
+    want = single.sql(q).rows()
+    dist = Session(cat, dist_shards=8)
+    got = dist.sql(q).rows()
+    assert got == want
