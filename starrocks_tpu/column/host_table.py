@@ -115,6 +115,16 @@ class HostTable:
                     a = a.astype(np.int64) * 10 ** t.scale
                 elif t.is_decimal and a.dtype.kind == "f":
                     a = np.round(a * 10 ** t.scale).astype(np.int64)
+                elif t.is_decimal and a.dtype.kind == "O":
+                    # decimal.Decimal objects: scale EXACTLY (an int64
+                    # astype would truncate the fraction away)
+                    import decimal as _d
+
+                    ctx = _d.Context(prec=60)
+                    a = np.array(
+                        [int(_d.Decimal(str(v)).scaleb(t.scale, ctx)
+                             .to_integral_value(_d.ROUND_HALF_EVEN, ctx))
+                         for v in vals], dtype=np.int64)
                 elif t.kind is TypeKind.DATE and a.dtype.kind in "UO":
                     a = np.asarray(a, dtype="datetime64[D]").astype(np.int32)
                 elif t.kind is TypeKind.DATETIME and a.dtype.kind in "UO":

@@ -83,6 +83,11 @@ def _infer_lit(value, ltype: T.LogicalType | None) -> tuple:
     if isinstance(value, int):
         if ltype is not None and ltype.is_decimal:
             return value * 10 ** ltype.scale, ltype
+        if abs(value) >= (1 << 63):
+            # beyond int64: the literal rides as DECIMAL128 limbs
+            from ..column.host_table import _int_to_dec128
+
+            return _int_to_dec128(value), T.DECIMAL(38, 0)
         return value, ltype or T.BIGINT
     if isinstance(value, float):
         if ltype is not None and ltype.is_decimal:
@@ -94,6 +99,15 @@ def _infer_lit(value, ltype: T.LogicalType | None) -> tuple:
         if ltype is not None and ltype.is_decimal:
             return int(value.scaleb(ltype.scale,
                                     decimal.Context(prec=60))), ltype
+        exp = -value.as_tuple().exponent
+        s = max(int(exp), 0)
+        unscaled = int(value.scaleb(s, decimal.Context(prec=60)))
+        if abs(unscaled) >= (1 << 63):
+            # beyond int64/float64 exactness: carry the literal as
+            # DECIMAL128 limbs so dec128 comparisons stay exact
+            from ..column.host_table import _int_to_dec128
+
+            return _int_to_dec128(unscaled), T.DECIMAL(38, s)
         return float(value), ltype or T.DOUBLE
     if isinstance(value, datetime.date):
         return (value - datetime.date(1970, 1, 1)).days, T.DATE
@@ -196,6 +210,20 @@ def _promote_temporal_literals(a: EVal, b: EVal):
 def _to_numeric(v: EVal, target: T.LogicalType) -> jnp.ndarray:
     """Cast v.data to target's representation (handles decimal rescale and
     temporal unit conversion)."""
+    if v.type.is_decimal128 and target.is_float:
+        from ..ops import dec128 as d128
+
+        f = d128.to_f64(jnp.asarray(v.data)) / (10 ** v.type.scale)
+        return jnp.asarray(f, target.dtype)
+    if v.type.is_decimal128 and target.is_decimal128:
+        if target.scale < v.type.scale:
+            raise NotImplementedError("DECIMAL128 downscale cast")
+        from ..ops import dec128 as d128
+
+        return d128.rescale(jnp.asarray(v.data),
+                            target.scale - v.type.scale)
+    if target.is_decimal128:
+        return _to_dec128(v, target.scale or 0)
     if v.type.kind is T.TypeKind.DATE and target.kind is T.TypeKind.DATETIME:
         return jnp.asarray(v.data, jnp.int64) * 86_400_000_000
     if v.type.kind is T.TypeKind.DATETIME and target.kind is T.TypeKind.DATE:
@@ -265,6 +293,12 @@ class ExprCompiler:
         if isinstance(e, Call):
             fn = _FUNCTIONS.get(e.fn)
             if fn is None:
+                from ..runtime.udf import eval_udf, get_udf
+
+                udef = get_udf(e.fn)
+                if udef is not None:
+                    return eval_udf(self, udef,
+                                    [self.eval(a) for a in e.args])
                 raise KeyError(f"unknown function {e.fn!r}")
             return fn(self, *[self.eval(a) for a in e.args])
         if isinstance(e, EVal):
@@ -340,7 +374,20 @@ class ExprCompiler:
         cap = self.chunk.capacity
         has_null = any(x is None for x in e.values)
         values = [x for x in e.values if x is not None]
-        if v.type.is_string:
+        if v.type.is_decimal128:
+            # OR of exact limb equalities (the 128-bit compare kernels)
+            import decimal as _d
+
+            from ..column.host_table import _int_to_dec128
+            from ..ops import dec128 as d128
+
+            ctx = _d.Context(prec=60)
+            m = jnp.zeros((cap,), jnp.bool_)
+            for x in values:
+                iv = int(_d.Decimal(str(x)).scaleb(v.type.scale, ctx)
+                         .to_integral_value(_d.ROUND_HALF_EVEN, ctx))
+                m = m | d128.eq(v.data, jnp.asarray(_int_to_dec128(iv)))
+        elif v.type.is_string:
             codes = {v.dict.encode_one(str(x)) for x in values}
             codes.discard(-1)
             if not codes:
@@ -402,28 +449,63 @@ def _scale_maxpad(a, b, ct):
     return ct
 
 
+def _is_dec128_pair(a, b):
+    nonfloat = all(t.is_decimal or t.is_decimal128 or t.is_integer
+                   or t.kind is T.TypeKind.BOOLEAN for t in (a.type, b.type))
+    return nonfloat and (a.type.is_decimal128 or b.type.is_decimal128)
+
+
+def _dec128_addsub(a: EVal, b: EVal, is_sub: bool) -> EVal:
+    from ..ops import dec128 as d128
+
+    sa = a.type.scale if (a.type.is_decimal or a.type.is_decimal128) else 0
+    sb = b.type.scale if (b.type.is_decimal or b.type.is_decimal128) else 0
+    s = max(sa, sb)
+    da, db = _to_dec128(a, s), _to_dec128(b, s)
+    out = d128.sub(da, db) if is_sub else d128.add(da, db)
+    return EVal(out, _and_valid(a.valid, b.valid), T.DECIMAL(38, s))
+
+
 @function("add")
 def _f_add(cc, a, b):
+    if _is_dec128_pair(a, b):
+        return _dec128_addsub(a, b, False)
     d, v, t, *_ = _binary_numeric(cc, a, b, jnp.add, _scale_maxpad)
     return EVal(d, v, t)
 
 
 @function("subtract")
 def _f_sub(cc, a, b):
+    if _is_dec128_pair(a, b):
+        return _dec128_addsub(a, b, True)
     d, v, t, *_ = _binary_numeric(cc, a, b, jnp.subtract, _scale_maxpad)
     return EVal(d, v, t)
+
+
+def _dec128_mul(a: EVal, b: EVal) -> EVal:
+    from ..ops import dec128 as d128
+
+    sa = a.type.scale if (a.type.is_decimal or a.type.is_decimal128) else 0
+    sb = b.type.scale if (b.type.is_decimal or b.type.is_decimal128) else 0
+    if sa + sb > 38:
+        raise NotImplementedError(f"decimal multiply scale {sa + sb} > 38")
+    out = d128.mul(_to_dec128(a, sa), _to_dec128(b, sb))
+    return EVal(out, _and_valid(a.valid, b.valid), T.DECIMAL(38, sa + sb))
 
 
 @function("multiply")
 def _f_mul(cc, a, b):
     a, b = _promote_temporal_literals(a, b)
+    if _is_dec128_pair(a, b):
+        return _dec128_mul(a, b)
     ct = _common(a, b)
     if ct.is_decimal:
         sa = a.type.scale if a.type.is_decimal else 0
         sb = b.type.scale if b.type.is_decimal else 0
         out_s = sa + sb
         if out_s > 18:
-            raise NotImplementedError(f"decimal multiply scale {out_s} > 18")
+            # product scale overflows DECIMAL64: promote to the 128-bit path
+            return _dec128_mul(a, b)
         da = jnp.asarray(a.data, jnp.int64) if a.type.is_decimal else _to_numeric(a, T.DECIMAL(18, 0))
         db = jnp.asarray(b.data, jnp.int64) if b.type.is_decimal else _to_numeric(b, T.DECIMAL(18, 0))
         return EVal(da * db, _and_valid(a.valid, b.valid), T.DECIMAL(18, out_s))
@@ -466,14 +548,63 @@ def _f_abs(cc, a):
 
 def _dec128_guard(*vals):
     for v in vals:
-        if v.type.is_decimal128 or v.type.is_array:
+        if v.type.is_array:
             raise NotImplementedError(
                 f"comparisons over {v.type} are not supported yet "
-                "(cast to DOUBLE, or compare via array functions)")
+                "(compare via array functions)")
+
+
+def _to_dec128(v: EVal, scale: int):
+    """v's data as [cap, 4] limbs at `scale` (exact widening casts only)."""
+    from ..ops import dec128 as d128
+
+    if v.type.is_decimal128:
+        if v.type.scale > scale:
+            raise NotImplementedError("DECIMAL128 downscale in comparison")
+        return d128.rescale(jnp.asarray(v.data), scale - v.type.scale)
+    if v.type.is_decimal:
+        d = d128.from_i64(jnp.asarray(v.data, jnp.int64))
+        return d128.rescale(d, scale - v.type.scale)
+    if v.type.is_integer or v.type.kind is T.TypeKind.BOOLEAN:
+        return d128.rescale(
+            d128.from_i64(jnp.asarray(v.data, jnp.int64)), scale)
+    if v.type.is_float and np.ndim(v.data) == 0 \
+            and not isinstance(v.data, jnp.ndarray):
+        # concrete float literal: exact iff it round-trips at this scale
+        # (decimal literals small enough for float64 always do)
+        iv = int(round(float(v.data) * (10 ** scale)))
+        if iv / (10 ** scale) == float(v.data) and abs(iv) < (1 << 63):
+            return d128.from_i64(jnp.asarray(iv, jnp.int64))
+    raise NotImplementedError(
+        f"cannot widen {v.type!r} to DECIMAL128 exactly (cast to DOUBLE)")
+
+
+def _compare_dec128(cc, a: EVal, b: EVal, op):
+    from ..ops import dec128 as d128
+
+    sa = a.type.scale if (a.type.is_decimal or a.type.is_decimal128) else 0
+    sb = b.type.scale if (b.type.is_decimal or b.type.is_decimal128) else 0
+    s = max(sa, sb)
+    da, db = _to_dec128(a, s), _to_dec128(b, s)
+    if op is jnp.equal:
+        res = d128.eq(da, db)
+    elif op is jnp.not_equal:
+        res = ~d128.eq(da, db)
+    elif op is jnp.less:
+        res = d128.lt(da, db)
+    elif op is jnp.less_equal:
+        res = ~d128.lt(db, da)
+    elif op is jnp.greater:
+        res = d128.lt(db, da)
+    else:  # greater_equal
+        res = ~d128.lt(da, db)
+    return EVal(res, _and_valid(a.valid, b.valid), T.BOOLEAN)
 
 
 def _compare(cc, a, b, op):
     _dec128_guard(a, b)
+    if a.type.is_decimal128 or b.type.is_decimal128:
+        return _compare_dec128(cc, a, b, op)
     a, b = _promote_temporal_literals(a, b)
     if a.type.is_string or b.type.is_string:
         return _compare_strings(cc, a, b, op)

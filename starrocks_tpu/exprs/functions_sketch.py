@@ -65,39 +65,39 @@ def _f_to_bitmap(cc, a: EVal) -> EVal:
 
 
 def _bitmap_pair(a: EVal, b: EVal, fn: str):
+    """Type check + result type: mismatched domains zero-extend to the
+    wider one inside sketch.bitmap_binary."""
     _require(a.type.is_bitmap and b.type.is_bitmap,
              f"{fn} expects BITMAP arguments")
-    _require(a.type.precision == b.type.precision,
-             f"{fn}: bitmap domains differ "
-             f"({a.type.precision} vs {b.type.precision})")
+    return a.type if a.type.precision >= b.type.precision else b.type
 
 
 @function("bitmap_and")
 def _f_bitmap_and(cc, a: EVal, b: EVal) -> EVal:
-    _bitmap_pair(a, b, "bitmap_and")
+    out_t = _bitmap_pair(a, b, "bitmap_and")
     return EVal(sketch.bitmap_binary(a.data, b.data, "and"),
-                _and_valid(a.valid, b.valid), a.type)
+                _and_valid(a.valid, b.valid), out_t)
 
 
 @function("bitmap_or")
 def _f_bitmap_or(cc, a: EVal, b: EVal) -> EVal:
-    _bitmap_pair(a, b, "bitmap_or")
+    out_t = _bitmap_pair(a, b, "bitmap_or")
     return EVal(sketch.bitmap_binary(a.data, b.data, "or"),
-                _and_valid(a.valid, b.valid), a.type)
+                _and_valid(a.valid, b.valid), out_t)
 
 
 @function("bitmap_xor")
 def _f_bitmap_xor(cc, a: EVal, b: EVal) -> EVal:
-    _bitmap_pair(a, b, "bitmap_xor")
+    out_t = _bitmap_pair(a, b, "bitmap_xor")
     return EVal(sketch.bitmap_binary(a.data, b.data, "xor"),
-                _and_valid(a.valid, b.valid), a.type)
+                _and_valid(a.valid, b.valid), out_t)
 
 
 @function("bitmap_andnot")
 def _f_bitmap_andnot(cc, a: EVal, b: EVal) -> EVal:
-    _bitmap_pair(a, b, "bitmap_andnot")
+    out_t = _bitmap_pair(a, b, "bitmap_andnot")
     return EVal(sketch.bitmap_binary(a.data, b.data, "andnot"),
-                _and_valid(a.valid, b.valid), a.type)
+                _and_valid(a.valid, b.valid), out_t)
 
 
 @function("bitmap_count")

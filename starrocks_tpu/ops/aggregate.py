@@ -221,11 +221,44 @@ def _lowcard_key_columns(infos, total: int, num_groups: int):
 
 
 
+def _string_hash_lut(d):
+    """Stable per-code 64-bit hashes of a StringDict's VALUES (FNV-1a over
+    utf-8). Sketches built from different tables/dictionary rebuilds must
+    agree on equal strings — hashing raw codes would make sketches
+    non-mergeable and unions overcount. Cached on the dict (trace-time
+    constant)."""
+    import numpy as np
+
+    cached = _HASH_LUTS.get(id(d))
+    if cached is not None and cached[0] is d:
+        return cached[1]
+    out = np.empty(max(len(d), 1), dtype=np.uint64)
+    with np.errstate(over="ignore"):  # FNV-1a wraps mod 2^64 by design
+        for i in range(max(len(d), 1)):
+            h = np.uint64(0xCBF29CE484222325)
+            s = str(d.values[i]).encode() if len(d) else b""
+            for byte in s:
+                h = (h ^ np.uint64(byte)) * np.uint64(0x100000001B3)
+            out[i] = h
+    if len(_HASH_LUTS) > 64:
+        _HASH_LUTS.clear()
+    _HASH_LUTS[id(d)] = (d, out)  # strong ref keeps the id stable
+    return out
+
+
+_HASH_LUTS: dict = {}
+
+
 def _hash_input_i64(a: EVal):
     """Distinct-preserving int64 view of a column for sketch hashing
-    (dict codes ARE value ids; floats hash their bit patterns)."""
+    (strings hash their VALUE bytes via a dict LUT; floats hash their bit
+    patterns)."""
     if a.type.is_wide:
         raise NotImplementedError(f"cannot sketch {a.type!r} values")
+    if a.type.is_string and a.dict is not None:
+        lut = jnp.asarray(_string_hash_lut(a.dict).view("int64"))
+        codes = jnp.clip(jnp.asarray(a.data, jnp.int32), 0, lut.shape[0] - 1)
+        return lut[codes]
     if a.type.is_float:
         return jax.lax.bitcast_convert_type(
             jnp.asarray(a.data, jnp.float64), jnp.int64)
@@ -520,6 +553,36 @@ def _emit_agg_columns(cc, aggs, mode, cap, live_rows, reorder, gid,
 
         # sum / min / max / count(x)
         a = cc.eval(Col(name)) if mode == FINAL else cc.eval(agg.arg)
+        if a.type.is_decimal128 and agg.fn in ("min", "max"):
+            # lexicographic limb refinement: per limb (ms->ls), keep only
+            # rows still tied on all more-significant limbs and take the
+            # segment extreme — 4 scatter-free passes
+            from . import dec128 as d128
+
+            is_min = agg.fn == "min"
+            m = live_rows if a.valid is None else (
+                live_rows & reorder(jnp.broadcast_to(a.valid, (cap,))))
+            d = reorder(jnp.asarray(a.data))
+            adj = d128.cmp_limbs(d)
+            ident = (1 << 32) if is_min else -1
+            gidc = jnp.clip(jnp.asarray(gid, jnp.int32), 0, num_groups - 1)
+            segfn = seg_min if is_min else seg_max
+            tied = m
+            best_limbs = []
+            for limb in adj:
+                lv = jnp.where(tied, limb, ident)
+                best = segfn(lv, gid, num_groups, identity=ident,
+                             sorted_gid=indices_sorted)
+                best_limbs.append(best)
+                tied = tied & (limb == best[gidc])
+            best_limbs[0] = best_limbs[0] ^ 0x80000000  # undo sign adjust
+            res = jnp.stack([jnp.asarray(x, jnp.int64) & 0xFFFFFFFF
+                             for x in best_limbs], axis=1)
+            nonempty = _seg_sum(m, nbits=1) > 0
+            out_fields.append(Field(name, a.type, True))
+            out_data.append(res)
+            out_valid.append(nonempty)
+            continue
         if a.type.is_decimal128 and agg.fn not in ("sum", "count"):
             raise NotImplementedError(
                 f"{agg.fn} over DECIMAL(>18) is not supported yet "

@@ -22,7 +22,10 @@ def eval_keys(chunk: Chunk, key_exprs) -> list:
     out = []
     for e in key_exprs:
         v = cc.eval(e)
-        data = jnp.broadcast_to(jnp.asarray(v.data), (chunk.capacity,))
+        d = jnp.asarray(v.data)
+        shape = (chunk.capacity,) + d.shape[1:] if d.ndim > 1 \
+            else (chunk.capacity,)
+        data = jnp.broadcast_to(d, shape)
         # valid can come back scalar too (e.g. `x % 3`: nullness derives
         # from the literal divisor) — lexsort/boundaries need full rank
         valid = (None if v.valid is None else
@@ -40,6 +43,11 @@ def key_sort_arrays(keys, live, nulls_last_sentinel=True):
     """
     ops = []
     for k in reversed(keys):
+        if k.type.is_decimal128:
+            from . import dec128 as d128
+
+            ops.extend(d128.sort_ops(k.data, k.valid))
+            continue
         ops.append(k.data)
         if k.valid is not None:
             # sort by (is_null, value): nulls form their own cluster
@@ -59,7 +67,9 @@ def boundaries(keys, live, order):
     diff = jnp.zeros((cap,), jnp.bool_)
     for k in keys:
         ks = k.data[order]
-        d = jnp.concatenate([jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
+        neq = (jnp.any(ks[1:] != ks[:-1], axis=-1)
+               if ks.ndim > 1 else ks[1:] != ks[:-1])
+        d = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
         if k.valid is not None:
             vs = k.valid[order]
             dv = jnp.concatenate([jnp.ones((1,), jnp.bool_), vs[1:] != vs[:-1]])

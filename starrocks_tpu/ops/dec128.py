@@ -1,0 +1,155 @@
+"""128-bit decimal limb arithmetic (DECIMAL(19..38) device kernels).
+
+Reference behavior: be/src/runtime/decimalv3.h + be/src/types/int128 paths
+(int128 accumulators/compares in the vectorized engine). The TPU has no
+128-bit integers, so values live as 4x32-bit limbs in an int64 rank-2
+column [rows, 4], MOST significant limb first, two's complement mod 2^128
+(the same wrap-around contract as the reference's int128).
+
+Kernels here are scatter-free and elementwise: compares are sign-adjusted
+lexicographic cascades, multiplication runs over 16-bit half-limbs so every
+partial product and carry fits int64 exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_MASK32 = 0xFFFFFFFF
+_SIGN32 = 0x80000000
+
+
+def from_i64(x):
+    """Sign-extend int64 -> [.., 4] limbs ms-first."""
+    x = jnp.asarray(x, jnp.int64)
+    ext = jnp.where(x < 0, jnp.int64(_MASK32), jnp.int64(0))
+    hi = (x >> 32) & _MASK32
+    lo = x & _MASK32
+    return jnp.stack([ext, ext, hi, lo], axis=-1)
+
+
+def to_f64(d):
+    """Approximate float64 value of the signed 128-bit integer. The ms limb
+    is signed BEFORE the weighted sum — computing (unsigned - 2^128) in
+    float64 would cancel catastrophically (2^128 >> ulp of the result)."""
+    d = jnp.asarray(d, jnp.int64)
+    ms = jnp.where(d[..., 0] >= _SIGN32, d[..., 0] - (1 << 32), d[..., 0])
+    return (ms * (2.0 ** 96) + d[..., 1] * (2.0 ** 64)
+            + d[..., 2] * (2.0 ** 32) + d[..., 3] * 1.0)
+
+
+def cmp_limbs(d):
+    """Limbs with the sign bit flipped on the ms limb: unsigned
+    lexicographic order over these == signed 128-bit order."""
+    d = jnp.asarray(d, jnp.int64)
+    return (d[..., 0] ^ _SIGN32, d[..., 1], d[..., 2], d[..., 3])
+
+
+def _lex_lt(a, b):
+    lt = jnp.zeros(a[0].shape, jnp.bool_)
+    decided = jnp.zeros(a[0].shape, jnp.bool_)
+    for ai, bi in zip(a, b):
+        lt = jnp.where(~decided & (ai < bi), True, lt)
+        decided = decided | (ai != bi)
+    return lt
+
+
+def lt(a, b):
+    return _lex_lt(cmp_limbs(a), cmp_limbs(b))
+
+
+def eq(a, b):
+    return jnp.all(jnp.asarray(a, jnp.int64) == jnp.asarray(b, jnp.int64),
+                   axis=-1)
+
+
+def add(a, b):
+    """(a + b) mod 2^128, limbwise with carry propagation."""
+    a = jnp.asarray(a, jnp.int64)
+    b = jnp.asarray(b, jnp.int64)
+    out = []
+    carry = jnp.zeros(a.shape[:-1], jnp.int64)
+    for i in (3, 2, 1, 0):  # least significant first
+        tot = a[..., i] + b[..., i] + carry
+        out.append(tot & _MASK32)
+        carry = tot >> 32
+    return jnp.stack(out[::-1], axis=-1)
+
+
+def neg(a):
+    """Two's complement negation."""
+    a = jnp.asarray(a, jnp.int64)
+    inv = (~a) & _MASK32
+    one = jnp.zeros(a.shape, jnp.int64).at[..., 3].set(1)
+    return add(inv, one)
+
+
+def sub(a, b):
+    return add(a, neg(b))
+
+
+def _to_halves(d):
+    """[.., 4] 32-bit limbs ms-first -> [.., 8] 16-bit half-limbs LS-first."""
+    d = jnp.asarray(d, jnp.int64)
+    parts = []
+    for i in (3, 2, 1, 0):
+        parts.append(d[..., i] & 0xFFFF)
+        parts.append((d[..., i] >> 16) & 0xFFFF)
+    return jnp.stack(parts, axis=-1)  # [.., 8] ls-first
+
+
+def _from_halves(h):
+    """[.., 8] LS-first half-limbs (already carry-normalized < 2^16) ->
+    [.., 4] ms-first 32-bit limbs."""
+    limbs = []
+    for i in (3, 2, 1, 0):  # ms first
+        limbs.append((h[..., 2 * i + 1] << 16) | h[..., 2 * i])
+    return jnp.stack(limbs, axis=-1)
+
+
+def mul(a, b):
+    """(a * b) mod 2^128. 16-bit half-limb schoolbook product: each partial
+    sum is < 8 * 2^32 and every carry chain stays far below 2^63."""
+    ha, hb = _to_halves(a), _to_halves(b)
+    acc = [jnp.zeros(ha.shape[:-1], jnp.int64) for _ in range(8)]
+    for i in range(8):
+        for j in range(8 - i):
+            acc[i + j] = acc[i + j] + ha[..., i] * hb[..., j]
+    out = []
+    carry = jnp.zeros(ha.shape[:-1], jnp.int64)
+    for i in range(8):
+        tot = acc[i] + carry
+        out.append(tot & 0xFFFF)
+        carry = tot >> 16
+    return _from_halves(jnp.stack(out, axis=-1))
+
+
+def mul_small(a, c: int):
+    """a * c for a host constant 0 <= c < 2^31 (single limb pass)."""
+    a = jnp.asarray(a, jnp.int64)
+    out = []
+    carry = jnp.zeros(a.shape[:-1], jnp.int64)
+    for i in (3, 2, 1, 0):
+        tot = a[..., i] * c + carry
+        out.append(tot & _MASK32)
+        carry = tot >> 32
+    return jnp.stack(out[::-1], axis=-1)
+
+
+def rescale(a, k: int):
+    """a * 10^k (k >= 0), chunked so each multiplier stays below 2^31."""
+    while k > 0:
+        step = min(k, 9)
+        a = mul_small(a, 10 ** step)
+        k -= step
+    return a
+
+
+def sort_ops(d, valid):
+    """lexsort operand list (least-significant-first) for a dec128 key,
+    mirroring key_sort_arrays' per-key convention."""
+    ms, l1, l2, l3 = cmp_limbs(d)
+    ops = [l3, l2, l1, ms]
+    if valid is not None:
+        ops.append(jnp.asarray(~valid, jnp.int8))
+    return ops

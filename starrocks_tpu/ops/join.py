@@ -48,12 +48,18 @@ def _pack_evals(keys, live, capacity: int, bit_widths):
         packed = jnp.asarray(keys[0].data, jnp.int64)
     elif bit_widths == "hash":
         h = jnp.zeros((capacity,), jnp.uint64)
+        cols = []
         for k in keys:
             kd = jnp.asarray(k.data)
+            if kd.ndim == 2:  # rank-2 (DECIMAL128 limbs): hash each limb
+                cols.extend(kd[:, j] for j in range(kd.shape[1]))
+                continue
             if not jnp.issubdtype(kd.dtype, jnp.integer):
                 kd = jnp.asarray(kd, jnp.float64)
                 kd = jnp.where(kd == 0, 0.0, kd)  # -0.0 == +0.0 in SQL
                 kd = kd.view(jnp.int64)
+            cols.append(kd)
+        for kd in cols:
             kh = mix64(jnp.asarray(kd, jnp.int64).view(jnp.uint64))
             # boost hash_combine: order-sensitive, avalanched
             h = mix64(h ^ (kh + jnp.uint64(0x9E3779B97F4A7C15)

@@ -158,6 +158,16 @@ def bitmap_count(planes):
 
 
 def bitmap_binary(a, b, op: str):
+    """Elementwise bitmap combine; the narrower side zero-extends to the
+    wider domain (bitmaps over different stats-derived widths combine the
+    way the reference's unbounded bitmaps do)."""
+    wa, wb = a.shape[-1], b.shape[-1]
+    if wa < wb:
+        a = jnp.concatenate(
+            [a, jnp.zeros(a.shape[:-1] + (wb - wa,), a.dtype)], axis=-1)
+    elif wb < wa:
+        b = jnp.concatenate(
+            [b, jnp.zeros(b.shape[:-1] + (wa - wb,), b.dtype)], axis=-1)
     au, bu = _bytes_u(a), _bytes_u(b)
     if op == "and":
         out = au & bu

@@ -16,17 +16,23 @@ from ..column.column import Chunk
 from .common import eval_keys
 
 
-def sort_chunk(chunk: Chunk, sort_keys, limit: int | None = None) -> Chunk:
-    """sort_keys: tuple of (expr, asc: bool, nulls_first: bool).
-
-    Dead rows always sort last; output sel marks the first n (or limit) rows.
-    """
-    cap = chunk.capacity
-    live = chunk.sel_mask()
-    keys = eval_keys(chunk, tuple(e for e, _, _ in sort_keys))
-
+def sort_operands(keys, sort_keys) -> list:
+    """lexsort operand list (least-significant first, WITHOUT the liveness
+    operand) for evaluated sort keys. Shared by the device sort and the
+    host-merge spill path so both order rows with the SAME comparator."""
     ops = []
-    for k, (_, asc, nulls_first) in zip(reversed(keys), reversed(list(sort_keys))):
+    for k, (_, asc, nulls_first) in zip(reversed(keys),
+                                        reversed(list(sort_keys))):
+        if k.type.is_decimal128:
+            from .dec128 import cmp_limbs
+
+            _M32 = 0xFFFFFFFF
+            for limb in reversed(cmp_limbs(k.data)):  # ls-first operands
+                ops.append(limb if asc else (_M32 - limb))
+            if k.valid is not None:
+                ops.append(jnp.asarray(
+                    k.valid if nulls_first else ~k.valid, jnp.int8))
+            continue
         d = k.data
         if d.dtype == jnp.bool_:
             d = jnp.asarray(d, jnp.int8)
@@ -36,6 +42,19 @@ def sort_chunk(chunk: Chunk, sort_keys, limit: int | None = None) -> Chunk:
             # the flag is more significant than the value (appended later);
             # ascending sort puts 0 first, so: nulls_first -> valid flag (null=0)
             ops.append(jnp.asarray(k.valid if nulls_first else ~k.valid, jnp.int8))
+    return ops
+
+
+def sort_chunk(chunk: Chunk, sort_keys, limit: int | None = None) -> Chunk:
+    """sort_keys: tuple of (expr, asc: bool, nulls_first: bool).
+
+    Dead rows always sort last; output sel marks the first n (or limit) rows.
+    """
+    cap = chunk.capacity
+    live = chunk.sel_mask()
+    keys = eval_keys(chunk, tuple(e for e, _, _ in sort_keys))
+
+    ops = sort_operands(keys, sort_keys)
     ops.append(jnp.asarray(~live, jnp.int8))  # live rows first
     order = jnp.lexsort(tuple(ops))
 

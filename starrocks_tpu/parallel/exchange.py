@@ -74,13 +74,6 @@ def shuffle_chunk(
     `pack_keys`'s ok flag is ignored here on purpose (exchange must move
     every live row).
     """
-    for f, d in zip(chunk.schema.fields, chunk.data):
-        if getattr(d, "ndim", 1) > 1:
-            raise NotImplementedError(
-                f"distributed exchange of wide column {f.name!r} "
-                "(ARRAY/DECIMAL128) is not supported yet — these queries "
-                "run single-chip or via broadcast placements")
-
     live = chunk.sel_mask()
     # dead rows -> bucket n (dropped); NULL-key live rows still travel
     keys = eval_keys(chunk, key_exprs)
@@ -116,17 +109,19 @@ def _exchange_by_bucket(chunk, bucket, axis, n_shards, bucket_capacity):
     )
 
     def scatter(x):
-        buf = jnp.zeros((out_cap,), x.dtype)
+        # wide columns ([cap, W] ARRAY/DECIMAL128/sketch planes) route
+        # row-wise: dest indexes the leading axis
+        buf = jnp.zeros((out_cap,) + x.shape[1:], x.dtype)
         return buf.at[dest].set(x[order], mode="drop")
 
     live_buf = jnp.zeros((out_cap,), jnp.bool_).at[dest].set(ok, mode="drop")
 
     def a2a(x):
-        # [n*C] -> [n, C] -> swap shard/abucket -> receive my bucket from all
+        # [n*C, ...] -> [n, C, ...] -> swap shard/bucket -> my bucket from all
         return lax.all_to_all(
-            x.reshape(n_shards, bucket_capacity), axis, split_axis=0, concat_axis=0,
-            tiled=False,
-        ).reshape(out_cap)
+            x.reshape((n_shards, bucket_capacity) + x.shape[1:]), axis,
+            split_axis=0, concat_axis=0, tiled=False,
+        ).reshape((out_cap,) + x.shape[1:])
 
     data = tuple(a2a(scatter(d)) for d in chunk.data)
     valid = tuple(

@@ -388,3 +388,132 @@ def execute_grace_join(
     else:
         out = _apply_top_chain(concat_many(outs), gp.top_chain)
     return out, list(checks_max.items())
+
+
+# --- spilled ORDER BY: device-evaluated keys, host global order ---------------
+
+
+@dataclasses.dataclass
+class SpillSortPlan:
+    limit_node: object  # LLimit above the sort | None
+    sort: LSort
+    scan_chain: list  # (Filter/Project)* topmost first
+    scan: LScan
+
+
+def match_spill_sort(plan: LogicalPlan) -> SpillSortPlan | None:
+    """[LLimit]? -> LSort -> (Filter/Project)* -> LScan."""
+    limit_node = None
+    node = plan
+    if isinstance(node, LLimit):
+        limit_node = node
+        node = node.child
+    if not isinstance(node, LSort):
+        return None
+    sort = node
+    chain = []
+    node = sort.child
+    while isinstance(node, (LFilter, LProject)):
+        chain.append(node)
+        node = node.child
+    if not isinstance(node, LScan):
+        return None
+    return SpillSortPlan(limit_node, sort, chain, node)
+
+
+def make_sort_spill_program(sp: SpillSortPlan):
+    """Per-batch device program: scan chain + sort-key OPERAND columns.
+    The host concatenates the operands across batches and orders globally
+    with numpy's lexsort — the identical comparator to the device sort
+    (ops/sort.py sort_operands), so spilled and in-HBM ORDER BY agree
+    bit-for-bit. The analog of the reference's merge-path external sort
+    (be/src/compute_env/sorting/merge_path.h): runs stream through the
+    device, global order is assembled off-device."""
+    from ..ops.common import eval_keys
+    from ..ops.sort import sort_operands
+
+    def prog(chunk: Chunk):
+        c = chunk
+        for node in reversed(sp.scan_chain):
+            if isinstance(node, LFilter):
+                c = filter_chunk(c, node.predicate)
+            else:
+                c = project(c, [e for _, e in node.exprs],
+                            [n for n, _ in node.exprs])
+        keys = eval_keys(c, tuple(e for e, _, _ in sp.sort.keys))
+        ops = sort_operands(keys, sp.sort.keys)
+        return c, tuple(ops), c.sel_mask()
+
+    return jax.jit(prog)
+
+
+def execute_spill_sort(sp: SpillSortPlan, catalog, batch_rows: int,
+                       programs_cache: dict, profile_node):
+    """Stream batches; return the globally ordered result as a HostTable
+    (the spilled result lives in host memory — it exceeds HBM by
+    assumption)."""
+    import numpy as np
+
+    from ..column import HostTable
+
+    handle = catalog.get_table(sp.scan.table)
+    ht = handle.table
+    total = ht.num_rows
+    n_batches = max(1, -(-total // batch_rows))
+    cap = pad_capacity(min(batch_rows, total))
+    prog_key = ("spill_sort", sp.sort, tuple(sp.scan_chain), cap)
+    if prog_key not in programs_cache:
+        programs_cache[prog_key] = make_sort_spill_program(sp)
+    jprog = programs_cache[prog_key]
+
+    alias, cols = sp.scan.alias, sp.scan.columns
+    profile_node.set_info("batches", n_batches)
+    out_tables, out_ops = [], None
+    for b in range(n_batches):
+        lo, hi = b * batch_rows, min((b + 1) * batch_rows, total)
+        arrays = {f"{alias}.{c}": ht.arrays[c][lo:hi] for c in cols}
+        valids = {f"{alias}.{c}": ht.valids[c][lo:hi]
+                  for c in cols if c in ht.valids}
+        fields = tuple(
+            dataclasses.replace(ht.schema.field(c), name=f"{alias}.{c}")
+            for c in cols)
+        chunk = chunk_from_arrays(
+            Schema(fields), arrays, valids, hi - lo, capacity=cap)
+        c, ops, live = jprog(chunk)
+        live_np = np.asarray(live)
+        out_tables.append(HostTable.from_chunk(c))  # drops dead rows
+        batch_ops = [np.asarray(o)[live_np] for o in ops]
+        if out_ops is None:
+            out_ops = [[o] for o in batch_ops]
+        else:
+            for acc, o in zip(out_ops, batch_ops):
+                acc.append(o)
+
+    first = out_tables[0]
+    merged_arrays, merged_valids = {}, {}
+    for f in first.schema:
+        for t in out_tables[1:]:
+            if t.schema.field(f.name).dict is not f.dict:
+                raise AssertionError(
+                    "spill-sort batches must share source dictionaries")
+        merged_arrays[f.name] = np.concatenate(
+            [t.arrays[f.name] for t in out_tables])
+        if any(f.name in t.valids for t in out_tables):
+            merged_valids[f.name] = np.concatenate([
+                t.valids.get(f.name,
+                             np.ones(t.num_rows, dtype=np.bool_))
+                for t in out_tables])
+    order = np.lexsort(tuple(np.concatenate(a) for a in out_ops))
+    lo = 0
+    hi = len(order)
+    if sp.sort.limit is not None:
+        hi = min(hi, sp.sort.limit)
+    if sp.limit_node is not None:
+        lo = sp.limit_node.offset
+        hi = min(hi, lo + sp.limit_node.limit)
+    order = order[lo:hi]
+    return HostTable(
+        first.schema,
+        {k: v[order] for k, v in merged_arrays.items()},
+        {k: v[order] for k, v in merged_valids.items()},
+    )

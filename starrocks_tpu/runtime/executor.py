@@ -271,7 +271,9 @@ class Executor:
                 plan = self._resolve_scalar_subqueries(opt)
             out_chunk = self._run(plan, profile)
             with profile.timer("fetch_results"):
-                ht = HostTable.from_chunk(out_chunk)
+                # spilled sorts return host-materialized results directly
+                ht = (out_chunk if isinstance(out_chunk, HostTable)
+                      else HostTable.from_chunk(out_chunk))
                 # strip alias qualifiers for final output names where unambiguous
                 ht = _prettify_names(ht)
             ROWS_RETURNED.inc(ht.num_rows)
@@ -592,6 +594,19 @@ class Executor:
 
         bp = match_batchable(plan)
         batch_rows = config.get("spill_batch_rows") or batch_threshold
+        if bp is None:
+            # spilled ORDER BY: device keys, host global order (a beyond-HBM
+            # sort returns a HostTable — it can't fit on device by premise)
+            from .batched import execute_spill_sort, match_spill_sort
+
+            sp = match_spill_sort(plan)
+            if sp is not None:
+                h = self.catalog.get_table(sp.scan.table)
+                if h is not None and h.row_count > batch_threshold:
+                    cache = self.cache.program_bucket(("spillsort", plan))
+                    node = profile.child("spill_sort")
+                    return execute_spill_sort(
+                        sp, self.catalog, batch_rows, cache["progs"], node)
         if bp is None:
             # Grace join: both sides host-partitioned by the join key when
             # either exceeds the streaming threshold

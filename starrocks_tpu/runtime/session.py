@@ -150,6 +150,21 @@ class Session:
         if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.Grant,
                              ast.Revoke, ast.ShowGrants)):
             return self._auth_stmt(stmt)
+        if isinstance(stmt, ast.CreateFunction):
+            from .udf import create_udf
+
+            create_udf(stmt.name, stmt.params, stmt.ret, stmt.source,
+                       replace=stmt.replace)
+            self.cache.programs.clear()  # plans may now resolve differently
+            self.cache.opt_plans.clear()
+            return None
+        if isinstance(stmt, ast.DropFunction):
+            from .udf import drop_udf
+
+            drop_udf(stmt.name, stmt.if_exists)
+            self.cache.programs.clear()
+            self.cache.opt_plans.clear()
+            return None
         if isinstance(stmt, ast.CreateTable):
             return self._create(stmt)
         if isinstance(stmt, ast.DropTable):
@@ -408,7 +423,8 @@ class Session:
         elif isinstance(stmt, (ast.CreateTable, ast.DropTable,
                                ast.CreateView, ast.RefreshView,
                                ast.CreateUser, ast.DropUser, ast.Grant,
-                               ast.Revoke, ast.AlterTable)):
+                               ast.Revoke, ast.AlterTable,
+                               ast.CreateFunction, ast.DropFunction)):
             raise PermissionError(
                 f"user {user!r} lacks the admin privileges for DDL")
 

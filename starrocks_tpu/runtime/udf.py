@@ -1,0 +1,139 @@
+"""Python scalar UDFs as host callbacks inside compiled plans.
+
+Reference behavior: be/src/exprs/udf/python/ (python UDFs executed out of
+process over Arrow batches) and the CREATE FUNCTION DDL
+(fe sql/ast/CreateFunctionStmt.java). Re-designed for the compiled world:
+the UDF body runs on the HOST through `jax.pure_callback`, which XLA calls
+with the materialized argument arrays mid-program — the TPU analog of the
+reference's UDF side-channel. The callback is shape-polymorphic, so the
+same compiled plan works single-chip and under the distributed mesh.
+
+Semantics:
+- strict NULLs: the result is NULL where any argument is NULL, and the
+  Python body may also return None for a NULL result;
+- string arguments arrive as Python str (dictionary codes decode in the
+  callback against the trace-time dictionary);
+- return types: numeric / boolean / date (strings would need a
+  data-dependent output dictionary, which the static-dict design forbids).
+
+Registry scope is the process (single-controller engine), mirroring the
+single shared catalog.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .. import types as T
+
+
+@dataclasses.dataclass
+class UdfDef:
+    name: str
+    params: tuple  # tuple[(name, LogicalType)]
+    ret: T.LogicalType
+    fn: object  # the compiled python callable
+    source: str
+
+
+_REGISTRY: dict = {}
+
+
+def create_udf(name: str, params, ret: T.LogicalType, source: str,
+               replace: bool = False):
+    key = name.lower()
+    if key in _REGISTRY and not replace:
+        raise ValueError(f"function {name!r} already exists")
+    if ret.is_string or ret.is_wide:
+        raise NotImplementedError(
+            f"UDF return type {ret!r} not supported (needs a data-dependent "
+            "output dictionary; return numerics/bool/date)")
+    ns: dict = {}
+    exec(source, ns)  # noqa: S102 — UDF bodies are operator-provided code
+    fn = ns.get(name)
+    if fn is None:
+        # accept a single unambiguous callable under a different name;
+        # multiple candidates would bind an arbitrary one silently
+        cands = [v for k, v in ns.items() if callable(v)
+                 and not k.startswith("__")]
+        fn = cands[0] if len(cands) == 1 else None
+    if not callable(fn):
+        raise ValueError(
+            f"UDF source must define a function named {name!r}")
+    _REGISTRY[key] = UdfDef(key, tuple(params), ret, fn, source)
+    return _REGISTRY[key]
+
+
+def drop_udf(name: str, if_exists: bool = False):
+    if _REGISTRY.pop(name.lower(), None) is None and not if_exists:
+        raise ValueError(f"unknown function {name!r}")
+
+
+def get_udf(name: str):
+    return _REGISTRY.get(name.lower())
+
+
+def list_udfs():
+    return sorted(_REGISTRY)
+
+
+def eval_udf(cc, udef: UdfDef, args):
+    """Compile a UDF call into the traced program via pure_callback."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..exprs.compile import EVal, _and_valid
+
+    if len(args) != len(udef.params):
+        raise TypeError(
+            f"{udef.name} takes {len(udef.params)} arguments, "
+            f"got {len(args)}")
+    cap = cc.chunk.capacity
+    datas, valids, decoders = [], [], []
+    for a in args:
+        datas.append(jnp.broadcast_to(jnp.asarray(a.data), (cap,)))
+        valids.append(
+            jnp.ones((cap,), jnp.bool_) if a.valid is None
+            else jnp.broadcast_to(a.valid, (cap,)))
+        if a.type.is_string and a.dict is not None:
+            vals = a.dict.values  # trace-time constant
+            decoders.append(lambda c, vals=vals: str(vals[int(c)]))
+        elif a.type.is_decimal:
+            scale = 10 ** a.type.scale
+            decoders.append(lambda x, s=scale: int(x) / s)
+        elif a.type.is_float:
+            decoders.append(float)
+        elif a.type.kind is T.TypeKind.BOOLEAN:
+            decoders.append(bool)
+        else:
+            decoders.append(int)
+
+    ret_np = udef.ret.np_dtype
+    fn = udef.fn
+
+    def host_fn(mask, *arrs):
+        n = mask.shape[0]
+        out = np.zeros(n, dtype=ret_np)
+        ok = np.asarray(mask).copy()
+        idx = np.nonzero(ok)[0]
+        for i in idx:
+            v = fn(*[dec(col[i]) for dec, col in zip(decoders, arrs)])
+            if v is None:
+                ok[i] = False
+            else:
+                out[i] = v
+        return out, ok
+
+    all_valid = _and_valid(*valids)
+    sel = cc.chunk.sel_mask()
+    mask = sel if all_valid is None else (sel & all_valid)
+    out, ok = jax.pure_callback(
+        host_fn,
+        (jax.ShapeDtypeStruct(mask.shape, ret_np),
+         jax.ShapeDtypeStruct(mask.shape, np.bool_)),
+        mask, *datas,
+    )
+    valid = ok if all_valid is None else (ok & all_valid)
+    return EVal(jnp.asarray(out), valid, udef.ret)

@@ -519,6 +519,12 @@ class Analyzer:
                 # resolved to a 0/1 level marker by the aggregate builder
                 return Call("grouping",
                             self._lower(e.args[0], scope, ctes, allow_agg=False))
+            from ..runtime.udf import get_udf
+
+            if get_udf(e.name) is not None:
+                return Call(e.name.lower(),
+                            *[self._lower(a, scope, ctes, allow_agg=False)
+                              for a in e.args])
             raise AnalyzerError(f"unknown function {e.name!r}")
         if isinstance(e, ast.Star):
             raise AnalyzerError("* only allowed as a top-level select item")

@@ -283,3 +283,18 @@ class Revoke:
 @dataclasses.dataclass(frozen=True)
 class ShowGrants:
     user: str | None  # None = current user
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateFunction:
+    name: str
+    params: tuple  # tuple[(name, LogicalType)]
+    ret: object  # LogicalType
+    source: str
+    replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropFunction:
+    name: str
+    if_exists: bool = False

@@ -1086,6 +1086,41 @@ class Parser:
 
     def parse_create(self):
         self.expect_kw("create")
+        replace = False
+        if self.at_kw("or"):
+            self.next()
+            t = self.next()
+            if t.value.lower() != "replace":
+                raise ParseError("expected REPLACE after OR")
+            replace = True
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "function"):
+            # CREATE [OR REPLACE] FUNCTION f(a BIGINT, ...) RETURNS t AS 'py'
+            self.next()
+            name = self.expect_ident()
+            self.expect_op("(")
+            params = []
+            if not self.at_op(")"):
+                while True:
+                    pname = self.expect_ident()
+                    ptype = self.parse_type_name()
+                    params.append((pname, ptype))
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            t = self.next()
+            if t.value.lower() != "returns":
+                raise ParseError("expected RETURNS")
+            ret = self.parse_type_name()
+            self.expect_kw("as")
+            src = self.next()
+            if src.kind != "string":
+                raise ParseError("CREATE FUNCTION body must be a string")
+            self.accept_op(";")
+            return ast.CreateFunction(name, tuple(params), ret, src.value,
+                                      replace)
+        if replace:
+            raise ParseError("OR REPLACE is only supported for FUNCTION")
         if self.peek().kind == "ident" and self.peek().value.lower() == "user":
             self.next()
             user = self._parse_user_name()
@@ -1238,6 +1273,16 @@ class Parser:
             user = self._parse_user_name()
             self.accept_op(";")
             return ast.DropUser(user)
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "function"):
+            self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            name = self.expect_ident()
+            self.accept_op(";")
+            return ast.DropFunction(name, if_exists)
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

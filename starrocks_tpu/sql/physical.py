@@ -158,6 +158,25 @@ def choose_key_packing(p, probe_keys, build_keys, residual, catalog):
     - unique: build side provably unique on the keys (never trusted in hash
       mode — fingerprint collisions would break the 1:1 gather join).
     """
+    def _wide_key(plan, key) -> bool:
+        if not isinstance(key, Col):
+            return False
+        origin = col_origin(plan, key.name)
+        if origin is None:
+            return False
+        t = catalog.get_table(origin[0])
+        f = (t.schema.field(origin[1])
+             if t is not None and t.schema is not None else None)
+        return f is not None and f.type.is_wide
+
+    if any(_wide_key(p.left, pk) or _wide_key(p.right, bk)
+           for pk, bk in zip(probe_keys, build_keys)):
+        # rank-2 keys (DECIMAL128 limbs) can't pack into an int64 directly:
+        # fingerprint them and re-verify with eq residuals
+        return ("hash", residual + [
+            Call("eq", pk, bk) for pk, bk in zip(probe_keys, build_keys)
+        ], False)
+
     bit_widths = None
     if len(probe_keys) > 1:
         widths = []

@@ -138,13 +138,11 @@ def test_review_regressions():
     s.sql("CREATE TABLE rr (d DECIMAL(38, 2))")
     s.sql("INSERT INTO rr VALUES (1.006)")
     assert s.sql("SELECT d FROM rr").rows() == [(decimal.Decimal("1.01"),)]
-    # unsupported dec128 operations fail loudly, not with trace errors
-    import pytest as _pt
-
-    with _pt.raises(Exception, match="DECIMAL"):
-        s.sql("SELECT min(d) FROM rr")
-    with _pt.raises(Exception, match="not supported"):
-        s.sql("SELECT count(*) FROM rr WHERE d > 1")
+    # round 4: dec128 min/max and comparisons are now real operations
+    assert s.sql("SELECT min(d), max(d) FROM rr").rows() == [
+        (decimal.Decimal("1.01"), decimal.Decimal("1.01"))]
+    assert s.sql("SELECT count(*) FROM rr WHERE d > 1").rows() == [(1,)]
+    assert s.sql("SELECT count(*) FROM rr WHERE d > 2").rows() == [(0,)]
 
 
 def test_dec128_storage_precision(tmp_path):

@@ -193,3 +193,27 @@ def test_sketch_aggs_distributed_match_single_chip(eight_devices):
     dist = Session(cat, dist_shards=8)
     got = dist.sql(q).rows()
     assert got == want
+
+
+def test_hll_sketches_merge_across_dictionaries():
+    """Sketches over the SAME strings from independently built dictionaries
+    must merge to the single-population estimate (value-hash stability)."""
+    names = [f"user{i}" for i in range(4000)]
+    s = _sess({
+        "t1": HostTable.from_pydict({"u": names}),
+        "t2": HostTable.from_pydict({"u": list(reversed(names))}),
+    })
+    s.sql("create table sk1 as select hll_sketch(u) as h from t1")
+    s.sql("create table sk2 as select hll_sketch(u) as h from t2")
+    est = s.sql("select hll_union_agg(h) from "
+                "(select h from sk1 union all select h from sk2) x"
+                ).rows()[0][0]
+    assert abs(est - 4000) / 4000 < 0.05, est
+
+
+def test_bitmap_binary_widens_domains():
+    s = _sess({"t": {"a": [1, 2, 3], "b": [100, 200, 300]}})
+    # different stats-derived domains must still combine
+    r = s.sql("select bitmap_count(bitmap_or(to_bitmap(a), to_bitmap(b))) "
+              "from t where a = 1").rows()
+    assert r == [(2,)]

@@ -1,0 +1,89 @@
+"""Python scalar UDFs: CREATE FUNCTION -> host callback inside compiled
+plans (VERDICT r4 item 7; reference: be/src/exprs/udf/python/ +
+fe sql/ast/CreateFunctionStmt.java)."""
+
+import numpy as np
+import pytest
+
+from starrocks_tpu.column import HostTable
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.storage.catalog import Catalog
+
+
+@pytest.fixture()
+def sess():
+    cat = Catalog()
+    cat.register("t", HostTable.from_pydict({
+        "a": [1, 2, None, 4],
+        "b": [10.0, 2.5, 3.0, None],
+        "s": ["x", "yy", "zzz", None],
+    }))
+    s = Session(cat)
+    yield s
+    from starrocks_tpu.runtime.udf import _REGISTRY
+
+    _REGISTRY.clear()
+
+
+def test_udf_in_select_and_where(sess):
+    sess.sql("""create function my_mix(a bigint, b double) returns double as '
+def my_mix(a, b):
+    return a * b + 0.5
+'""")
+    rows = sess.sql("select a, my_mix(a, b) from t order by a").rows()
+    # strict NULLs: any NULL argument -> NULL result
+    assert rows == [(1, 10.5), (2, 5.5), (4, None), (None, None)]
+    rows = sess.sql("select a from t where my_mix(a, b) > 6 order by a").rows()
+    assert rows == [(1,)]
+
+
+def test_udf_string_args_and_none_result(sess):
+    sess.sql("""create function odd_len(s varchar) returns boolean as '
+def odd_len(s):
+    if s == "zzz":
+        return None
+    return len(s) % 2 == 1
+'""")
+    rows = sess.sql("select s, odd_len(s) from t order by a").rows()
+    # row order follows a = 1, 2, 4, NULL
+    assert rows == [("x", True), ("yy", False), (None, None), ("zzz", None)]
+
+
+def test_udf_composes_with_aggregates(sess):
+    sess.sql("""create function twice(a bigint) returns bigint as '
+def twice(a):
+    return 2 * a
+'""")
+    r = sess.sql("select sum(twice(a)) from t").rows()
+    assert r == [(14,)]
+
+
+def test_udf_replace_and_drop(sess):
+    sess.sql("create function f1(a bigint) returns bigint as '\ndef f1(a):\n    return a + 1\n'")
+    assert sess.sql("select f1(1) from t limit 1").rows() == [(2,)]
+    with pytest.raises(ValueError, match="already exists"):
+        sess.sql("create function f1(a bigint) returns bigint as '\ndef f1(a):\n    return a\n'")
+    sess.sql("create or replace function f1(a bigint) returns bigint as '\ndef f1(a):\n    return a + 10\n'")
+    assert sess.sql("select f1(1) from t limit 1").rows() == [(11,)]
+    sess.sql("drop function f1")
+    with pytest.raises(Exception, match="unknown function"):
+        sess.sql("select f1(1) from t")
+
+
+def test_udf_distributed_matches_single_chip(sess, eight_devices):
+    sess.sql("""create function rank_bucket(a bigint) returns bigint as '
+def rank_bucket(a):
+    return a % 3
+'""")
+    rng = np.random.default_rng(2)
+    big = Catalog()
+    big.register("u", HostTable.from_pydict(
+        {"v": rng.integers(0, 1000, 20_000)}))
+    from starrocks_tpu.runtime.udf import get_udf
+
+    assert get_udf("rank_bucket") is not None
+    q = ("select rank_bucket(v) as g, count(*) from u group by g "
+         "order by g")
+    single = Session(big).sql(q).rows()
+    dist = Session(big, dist_shards=8).sql(q).rows()
+    assert dist == single
