@@ -384,9 +384,11 @@ class ExprCompiler:
             ctx = _d.Context(prec=60)
             m = jnp.zeros((cap,), jnp.bool_)
             for x in values:
-                iv = int(_d.Decimal(str(x)).scaleb(v.type.scale, ctx)
-                         .to_integral_value(_d.ROUND_HALF_EVEN, ctx))
-                m = m | d128.eq(v.data, jnp.asarray(_int_to_dec128(iv)))
+                scaled = _d.Decimal(str(x)).scaleb(v.type.scale, ctx)
+                if scaled != scaled.to_integral_value(_d.ROUND_FLOOR, ctx):
+                    continue  # inexact at this scale: can never match
+                m = m | d128.eq(v.data,
+                                jnp.asarray(_int_to_dec128(int(scaled))))
         elif v.type.is_string:
             codes = {v.dict.encode_one(str(x)) for x in values}
             codes.discard(-1)

@@ -232,14 +232,24 @@ def _string_hash_lut(d):
     cached = _HASH_LUTS.get(id(d))
     if cached is not None and cached[0] is d:
         return cached[1]
-    out = np.empty(max(len(d), 1), dtype=np.uint64)
-    with np.errstate(over="ignore"):  # FNV-1a wraps mod 2^64 by design
-        for i in range(max(len(d), 1)):
-            h = np.uint64(0xCBF29CE484222325)
-            s = str(d.values[i]).encode() if len(d) else b""
-            for byte in s:
-                h = (h ^ np.uint64(byte)) * np.uint64(0x100000001B3)
-            out[i] = h
+    n = max(len(d), 1)
+    if len(d):
+        # vectorized FNV: fixed-width byte matrix, fold column-wise (UTF-8
+        # text has no interior NULs, so the first zero byte ends the value)
+        m = np.array([str(v) for v in d.values[:len(d)]],
+                     dtype=bytes).view(np.uint8)
+        m = m.reshape(len(d), -1) if m.size else np.zeros((len(d), 1),
+                                                          np.uint8)
+        out = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+        alive = np.ones(n, dtype=bool)
+        with np.errstate(over="ignore"):  # FNV-1a wraps mod 2^64 by design
+            for j in range(m.shape[1]):
+                b = m[:, j]
+                alive = alive & (b != 0)
+                folded = (out ^ b) * np.uint64(0x100000001B3)
+                out = np.where(alive, folded, out)
+    else:
+        out = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
     if len(_HASH_LUTS) > 64:
         _HASH_LUTS.clear()
     _HASH_LUTS[id(d)] = (d, out)  # strong ref keeps the id stable

@@ -62,6 +62,12 @@ class DeviceCache:
         self.opt_plans: OrderedDict = OrderedDict()
 
     def program_bucket(self, key):
+        from .udf import registry_epoch
+
+        # UDF create/replace/drop must invalidate EVERY session's compiled
+        # plans (callbacks close over the registered callable): the epoch
+        # rides in the cache key so stale programs simply miss
+        key = (key, registry_epoch())
         b = self.programs.get(key)
         if b is None:
             b = self.programs[key] = {"last": None, "progs": {}}
@@ -81,7 +87,10 @@ class DeviceCache:
 
         def scans_table(key) -> bool:
             for part in key:
-                if isinstance(part, LogicalPlan):
+                if isinstance(part, tuple):  # nested keys (udf epoch wrap)
+                    if scans_table(part):
+                        return True
+                elif isinstance(part, LogicalPlan):
                     for node in walk_plan(part):
                         if isinstance(node, LScan) and node.table == table:
                             return True
@@ -155,7 +164,10 @@ class DeviceCache:
             default_cap = pad_capacity((n + n_shards - 1) // n_shards) * n_shards
         else:
             default_cap = pad_capacity(n)
-        cap = self._caps.setdefault(cap_key, default_cap)
+        if handle.name.startswith("information_schema."):
+            cap = default_cap  # virtual tables grow between reads
+        else:
+            cap = self._caps.setdefault(cap_key, default_cap)
 
         def layout(a, fill):
             """Host layout: pad (range mode) or bucket-slotted (hash mode).
@@ -180,9 +192,14 @@ class DeviceCache:
 
         from ..column.column import Field, Schema
 
+        # information_schema relations are virtual (rebuilt per read);
+        # caching their columns would serve stale catalog state
+        cacheable = not handle.name.startswith("information_schema.")
         fields, data, valid = [], [], []
         for c in columns:
             key = (handle.name, c, tag)
+            if not cacheable:
+                self._cols.pop(key, None)
             if key not in self._cols:
                 a = layout(ht.arrays[c], 0)
                 v = ht.valids.get(c)
@@ -206,6 +223,8 @@ class DeviceCache:
             # cached: building + transferring a capacity-sized mask per run
             # costs ~50ms at 8M rows — invalidated with the columns on DML
             sel_key = (handle.name, "__sel__", tag)
+            if not cacheable:
+                self._cols.pop(sel_key, None)
             if sel_key not in self._cols:
                 if reorder is None:
                     selv = np.arange(cap) < n

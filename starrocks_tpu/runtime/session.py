@@ -141,6 +141,37 @@ class Session:
         return ht
 
     def sql(self, text: str):
+        """Execute one statement. Top-level calls append to the catalog's
+        query log (information_schema.query_log; reference analog: the FE
+        audit log) — nested internal statements (MV refresh bodies,
+        INSERT..SELECT subqueries) don't double-log."""
+        if getattr(self, "_in_sql", False):
+            return self._sql_inner(text)
+        import time as _time
+
+        self._in_sql = True
+        t0 = _time.time()
+        entry = {"user": self.current_user, "sql": text.strip(),
+                 "state": "OK", "rows": 0, "ms": 0}
+        try:
+            res = self._sql_inner(text)
+            if isinstance(res, QueryResult):
+                entry["rows"] = res.table.num_rows
+            elif isinstance(res, int):
+                entry["rows"] = res
+            return res
+        except Exception:
+            entry["state"] = "ERR"
+            raise
+        finally:
+            self._in_sql = False
+            entry["ms"] = int((_time.time() - t0) * 1000)
+            log = self.catalog.query_log
+            log.append(entry)
+            if len(log) > 10_000:
+                del log[:5000]
+
+    def _sql_inner(self, text: str):
         stmt = parse(text)
         self._enforce_privileges(stmt)
         if isinstance(stmt, ast.Explain):
@@ -214,7 +245,11 @@ class Session:
         if isinstance(stmt, ast.RefreshView):
             return self._refresh_mv(stmt.name.lower())
         if isinstance(stmt, ast.ShowTables):
-            return sorted(self.catalog.tables)
+            if stmt.full:  # SHOW FULL TABLES: (name, type) resultset
+                return self._query(parse(
+                    "select table_name, table_type "
+                    "from information_schema.tables"))
+            return sorted(self.catalog.tables) + sorted(self.catalog.views)
         if isinstance(stmt, ast.ShowPartitions):
             return self._show_partitions(stmt.table.lower())
         if isinstance(stmt, ast.AlterTable):

@@ -39,6 +39,13 @@ class UdfDef:
 
 
 _REGISTRY: dict = {}
+# bumped on every create/drop; program caches key on it so OTHER sessions'
+# compiled plans (whose callbacks close over the old callable) re-resolve
+_EPOCH: int = 0
+
+
+def registry_epoch() -> int:
+    return _EPOCH
 
 
 def create_udf(name: str, params, ret: T.LogicalType, source: str,
@@ -63,12 +70,16 @@ def create_udf(name: str, params, ret: T.LogicalType, source: str,
         raise ValueError(
             f"UDF source must define a function named {name!r}")
     _REGISTRY[key] = UdfDef(key, tuple(params), ret, fn, source)
+    global _EPOCH
+    _EPOCH += 1
     return _REGISTRY[key]
 
 
 def drop_udf(name: str, if_exists: bool = False):
     if _REGISTRY.pop(name.lower(), None) is None and not if_exists:
         raise ValueError(f"unknown function {name!r}")
+    global _EPOCH
+    _EPOCH += 1
 
 
 def get_udf(name: str):
@@ -107,11 +118,44 @@ def eval_udf(cc, udef: UdfDef, args):
             decoders.append(float)
         elif a.type.kind is T.TypeKind.BOOLEAN:
             decoders.append(bool)
+        elif a.type.kind is T.TypeKind.DATE:
+            import datetime as _dt
+
+            epoch = _dt.date(1970, 1, 1)
+            decoders.append(
+                lambda d, e=epoch: e + _dt.timedelta(days=int(d)))
+        elif a.type.kind is T.TypeKind.DATETIME:
+            import datetime as _dt
+
+            e0 = _dt.datetime(1970, 1, 1)
+            decoders.append(
+                lambda us, e=e0: e + _dt.timedelta(microseconds=int(us)))
         else:
             decoders.append(int)
 
     ret_np = udef.ret.np_dtype
     fn = udef.fn
+    if udef.ret.kind is T.TypeKind.DATE:
+        import datetime as _dt
+
+        def encode(v):
+            return ((v - _dt.date(1970, 1, 1)).days
+                    if isinstance(v, _dt.date) else v)
+    elif udef.ret.kind is T.TypeKind.DATETIME:
+        import datetime as _dt
+
+        def encode(v):
+            return ((v - _dt.datetime(1970, 1, 1))
+                    // _dt.timedelta(microseconds=1)
+                    if isinstance(v, _dt.datetime) else v)
+    elif udef.ret.is_decimal:
+        _rs = 10 ** udef.ret.scale
+
+        def encode(v):
+            return int(round(float(v) * _rs))
+    else:
+        def encode(v):
+            return v
 
     def host_fn(mask, *arrs):
         n = mask.shape[0]
@@ -123,7 +167,7 @@ def eval_udf(cc, udef: UdfDef, args):
             if v is None:
                 ok[i] = False
             else:
-                out[i] = v
+                out[i] = encode(v)
         return out, ok
 
     all_valid = _and_valid(*valids)

@@ -217,7 +217,7 @@ class DropTable:
 
 @dataclasses.dataclass(frozen=True)
 class ShowTables:
-    pass
+    full: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

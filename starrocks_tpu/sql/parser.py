@@ -253,9 +253,10 @@ class Parser:
                 self.next()
                 self.accept_op(";")
                 return ast.ShowProfile()
+            full = self.accept_kw("full")
             self.expect_kw("tables")
             self.accept_op(";")
-            return ast.ShowTables()
+            return ast.ShowTables(full)
         if (self.peek().kind == "ident"
                 and self.peek().value.lower() == "alter"):
             self.next()

@@ -113,6 +113,20 @@ class StoredTableHandle(TableHandle):
         self._table = None
         self._stats = {}
 
+    def file_metas(self):
+        """Per-data-file metadata rows for the information_schema tablets/
+        partitions views (manifest only — no data load)."""
+        m = self.store.read_manifest(self.name)
+        out = []
+        for rs in m["rowsets"]:
+            for f in rs["files"]:
+                out.append({
+                    "file": f.get("file", ""),
+                    "rows": f["rows"] - len(f.get("delvec") or ()),
+                    "part": f.get("part", rs.get("part", 0)) or 0,
+                })
+        return out
+
 
 class Catalog:
     def __init__(self):
@@ -128,6 +142,8 @@ class Catalog:
         self.versions: dict = {}
         # users + table-level grants (runtime/auth.py); created on demand
         self.auth = None
+        # recent statements (sessions append; information_schema.query_log)
+        self.query_log: list = []
 
     def bump_version(self, name: str):
         n = name.lower()
@@ -190,11 +206,134 @@ class Catalog:
                                HostTable(Schema(tuple(fields)), arrays, {}))
 
         if view == "tables":
-            names = sorted(self.tables)
+            rows = [(n, self.tables[n].row_count,
+                     "MATERIALIZED VIEW" if n in self.mv_defs
+                     else "BASE TABLE")
+                    for n in sorted(self.tables)]
+            rows += [(n, 0, "VIEW") for n in sorted(self.views)]
+            rows.sort()
+            return vtable([
+                ("table_name", T.VARCHAR, [r[0] for r in rows]),
+                ("table_rows", T.BIGINT, [r[1] for r in rows]),
+                ("table_type", T.VARCHAR, [r[2] for r in rows]),
+            ])
+        if view == "schemata":
+            return vtable([
+                ("schema_name", T.VARCHAR, ["default", "information_schema"]),
+            ])
+        if view == "views":
+            names = (sorted(self.views)
+                     + sorted(self.mv_defs))
+            defs = ([self.views[n] for n in sorted(self.views)]
+                    + [self.mv_defs[n] for n in sorted(self.mv_defs)])
+            kinds = (["VIEW"] * len(self.views)
+                     + ["MATERIALIZED VIEW"] * len(self.mv_defs))
             return vtable([
                 ("table_name", T.VARCHAR, names),
-                ("table_rows", T.BIGINT,
-                 [self.tables[n].row_count for n in names]),
+                ("view_definition", T.VARCHAR,
+                 [d.strip() for d in defs]),
+                ("view_type", T.VARCHAR, kinds),
+            ])
+        if view == "statistics":
+            def fmt(f, v):
+                """SQL-value render of an internal stats value."""
+                if v is None or f.type.is_string:
+                    return ""  # string stats hold dictionary CODES
+                if f.type.is_decimal:
+                    return str(v / 10 ** f.type.scale)
+                if f.type.kind is T.TypeKind.DATE:
+                    return str(np.datetime64(int(v), "D"))
+                if f.type.kind is T.TypeKind.DATETIME:
+                    return str(np.datetime64(int(v), "us"))
+                return str(v)
+
+            tn, cn, ndv, mn, mx, fresh = [], [], [], [], [], []
+            for n in sorted(self.tables):
+                h = self.tables[n]
+                # metadata-only contract: computing stats loads + scans the
+                # data — only report tables already resident (ANALYZE-style
+                # warmth); cold stored tables show analyzed=0
+                loaded = getattr(h, "_table", None) is not None \
+                    or getattr(h, "store", None) is None
+                for f in h.schema:
+                    if f.type.is_wide:
+                        continue
+                    tn.append(n)
+                    cn.append(f.name)
+                    if loaded:
+                        st = h.column_stats(f.name)
+                        ndv.append(int(h.column_ndv(f.name) or 0))
+                        mn.append(fmt(f, st.min))
+                        mx.append(fmt(f, st.max))
+                    else:
+                        ndv.append(0)
+                        mn.append("")
+                        mx.append("")
+                    fresh.append(1 if loaded else 0)
+            return vtable([
+                ("table_name", T.VARCHAR, tn),
+                ("column_name", T.VARCHAR, cn),
+                ("ndv", T.BIGINT, ndv),
+                ("min", T.VARCHAR, mn),
+                ("max", T.VARCHAR, mx),
+                ("analyzed", T.INT, fresh),
+            ])
+        if view == "tablets":
+            # storage-layout introspection (be_tablets analog): one row per
+            # stored data file; in-memory tables report one resident blob
+            tn, fn, rws, prt = [], [], [], []
+            for n in sorted(self.tables):
+                h = self.tables[n]
+                metas = getattr(h, "file_metas", None)
+                if callable(metas):
+                    for m in metas():
+                        tn.append(n)
+                        fn.append(m.get("file", ""))
+                        rws.append(int(m.get("rows", 0)))
+                        prt.append(int(m.get("part", 0)))
+                else:
+                    tn.append(n)
+                    fn.append("<memory>")
+                    rws.append(h.row_count)
+                    prt.append(0)
+            return vtable([
+                ("table_name", T.VARCHAR, tn),
+                ("file", T.VARCHAR, fn),
+                ("rows", T.BIGINT, rws),
+                ("partition_id", T.BIGINT, prt),
+            ])
+        if view == "partitions":
+            tn, pn, rws = [], [], []
+            for n in sorted(self.tables):
+                h = self.tables[n]
+                metas = getattr(h, "file_metas", None)
+                if callable(metas):
+                    by_part: dict = {}
+                    for m in metas():
+                        by_part[int(m.get("part", 0))] = (
+                            by_part.get(int(m.get("part", 0)), 0)
+                            + int(m.get("rows", 0)))
+                    for p in sorted(by_part) or [0]:
+                        tn.append(n)
+                        pn.append(f"p{p}")
+                        rws.append(by_part.get(p, 0))
+                else:
+                    tn.append(n)
+                    pn.append("p0")
+                    rws.append(h.row_count)
+            return vtable([
+                ("table_name", T.VARCHAR, tn),
+                ("partition_name", T.VARCHAR, pn),
+                ("rows", T.BIGINT, rws),
+            ])
+        if view == "query_log":
+            log = self.query_log[-1000:]
+            return vtable([
+                ("user", T.VARCHAR, [e["user"] for e in log]),
+                ("statement", T.VARCHAR, [e["sql"][:512] for e in log]),
+                ("state", T.VARCHAR, [e["state"] for e in log]),
+                ("rows", T.BIGINT, [e["rows"] for e in log]),
+                ("ms", T.BIGINT, [e["ms"] for e in log]),
             ])
         if view == "be_configs":
             from ..runtime.config import config as cfg

@@ -103,9 +103,8 @@ def test_wide_columns_cross_distributed_exchange(eight_devices):
         "v": [D(f"{(i * 7919) % 100000}.{i % 100:02d}") * D(10) ** 15
               for i in range(n)],
         "arr": [[i % 5, i % 3] for i in range(n)],
-    }, types={"g": None, "v": None, "arr": None} and {
-        "v": __import__("starrocks_tpu.types", fromlist=["DECIMAL"]
-                        ).DECIMAL(30, 2)}))
+    }, types={"v": __import__("starrocks_tpu.types", fromlist=["DECIMAL"]
+                              ).DECIMAL(30, 2)}))
     q = ("SELECT g, sum(v), min(v), max(v), sum(array_sum(arr)) FROM w "
          "GROUP BY g ORDER BY g")
     single = Session(cat).sql(q).rows()

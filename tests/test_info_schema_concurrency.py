@@ -1,0 +1,119 @@
+"""information_schema breadth + concurrent multi-client sessions
+(VERDICT r4 item 10; reference: be/src/schema_scanner/ + the FE audit log)."""
+
+import threading
+
+import pytest
+
+from starrocks_tpu.column import HostTable
+from starrocks_tpu.runtime.mysql_service import MySQLServer
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.storage.catalog import Catalog
+
+from tests.test_mysql_protocol import FullClient
+
+
+@pytest.fixture()
+def sess(tmp_path):
+    s = Session(data_dir=str(tmp_path))
+    s.sql("create table facts (k int, v double)")
+    s.sql("insert into facts values (1, 1.5), (2, 2.5), (3, null)")
+    s.sql("create view v_facts as select k from facts where v is not null")
+    s.sql("create materialized view mv_sum as "
+          "select k, sum(v) as s from facts group by k")
+    return s
+
+
+def test_schemata_views_tables(sess):
+    r = sess.sql("select schema_name from information_schema.schemata "
+                 "order by 1").rows()
+    assert r == [("default",), ("information_schema",)]
+    r = dict(sess.sql("select table_name, table_type "
+                      "from information_schema.tables").rows())
+    assert r["facts"] == "BASE TABLE"
+    assert r["v_facts"] == "VIEW"
+    assert r["mv_sum"] == "MATERIALIZED VIEW"
+    r = dict((a, (b, c)) for a, b, c in sess.sql(
+        "select table_name, view_definition, view_type "
+        "from information_schema.views").rows())
+    assert "select k from facts" in r["v_facts"][0]
+    assert r["mv_sum"][1] == "MATERIALIZED VIEW"
+
+
+def test_statistics_and_storage_views(sess):
+    stats = {(t, c): (n, mn, mx, az) for t, c, n, mn, mx, az in sess.sql(
+        "select * from information_schema.statistics").rows()}
+    assert stats[("facts", "k")][:3] == (3, "1", "3")  # exact NDV + bounds
+    tablets = sess.sql("select table_name, rows from "
+                       "information_schema.tablets where table_name = "
+                       "'facts'").rows()
+    assert sum(r[1] for r in tablets) == 3
+    parts = sess.sql("select table_name, partition_name, rows from "
+                     "information_schema.partitions "
+                     "where table_name = 'facts'").rows()
+    assert sum(p[2] for p in parts) == 3
+
+
+def test_query_log(sess):
+    sess.sql("select count(*) from facts")
+    log = sess.sql("select user, statement, state, rows from "
+                   "information_schema.query_log").rows()
+    assert any("count(*)" in r[1] and r[0] == "root" and r[2] == "OK"
+               for r in log)
+    with pytest.raises(Exception):
+        sess.sql("select nope from facts")
+    log = sess.sql("select statement, state from "
+                   "information_schema.query_log").rows()
+    assert any(r[1] == "ERR" and "nope" in r[0] for r in log)
+
+
+def test_show_full_tables_over_the_wire(sess):
+    srv = MySQLServer(sess, port=0).start()
+    try:
+        c = FullClient("127.0.0.1", srv.port)
+        cols, rows = c.query("show full tables")
+        assert cols == ["table_name", "table_type"]
+        d = dict(rows)
+        assert d["facts"] == "BASE TABLE" and d["v_facts"] == "VIEW"
+        c.quit()
+    finally:
+        srv.shutdown()
+
+
+def test_concurrent_sessions_ddl_query_insert(sess):
+    """Two byte-level MySQL clients + direct session traffic running DDL,
+    INSERT, and SELECT concurrently must serialize correctly (no torn
+    state, every client sees its own writes)."""
+    srv = MySQLServer(sess, port=0).start()
+    errors = []
+
+    def worker(wid: int):
+        try:
+            c = FullClient("127.0.0.1", srv.port)
+            c.query(f"create table w{wid} (a int, b varchar)")
+            total = 0
+            for i in range(10):
+                c.query(f"insert into w{wid} values ({i}, 'x{wid}_{i}')")
+                total += 1
+                _, rows = c.query(f"select count(*) from w{wid}")
+                assert rows == [(str(total),)], (wid, i, rows)
+                # interleave reads of the shared table + info schema
+                _, rows = c.query("select count(*) from facts")
+                assert rows[0][0] >= "3"
+                c.query("select table_name from information_schema.tables")
+            _, rows = c.query(
+                f"select b from w{wid} where a = 7")
+            assert rows == [(f"x{wid}_7",)]
+            c.query(f"drop table w{wid}")
+            c.quit()
+        except Exception as e:  # noqa: BLE001
+            errors.append((wid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    srv.shutdown()
+    assert not errors, errors
+    assert all(f"w{i}" not in sess.catalog.tables for i in range(3))
