@@ -233,14 +233,15 @@ def _string_hash_lut(d):
     if cached is not None and cached[0] is d:
         return cached[1]
     n = max(len(d), 1)
-    if len(d):
-        # vectorized FNV: fixed-width byte matrix, fold column-wise (UTF-8
-        # text has no interior NULs, so the first zero byte ends the value)
-        m = np.array([str(v) for v in d.values[:len(d)]],
-                     dtype=bytes).view(np.uint8)
-        m = m.reshape(len(d), -1) if m.size else np.zeros((len(d), 1),
-                                                          np.uint8)
-        out = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+    encoded = [str(v).encode() for v in d.values[:len(d)]]
+    out = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+    if encoded and not any(b"\x00" in s for s in encoded):
+        # vectorized FNV: fixed-width byte matrix (NUL-padded), fold
+        # column-wise; the first zero byte ends the value, which is only
+        # sound when no value embeds a NUL (checked above)
+        m = np.array(encoded, dtype=bytes).view(np.uint8)
+        m = m.reshape(len(encoded), -1) if m.size else np.zeros(
+            (len(encoded), 1), np.uint8)
         alive = np.ones(n, dtype=bool)
         with np.errstate(over="ignore"):  # FNV-1a wraps mod 2^64 by design
             for j in range(m.shape[1]):
@@ -248,8 +249,13 @@ def _string_hash_lut(d):
                 alive = alive & (b != 0)
                 folded = (out ^ b) * np.uint64(0x100000001B3)
                 out = np.where(alive, folded, out)
-    else:
-        out = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+    elif encoded:  # embedded NULs: exact scalar fold for those dicts
+        with np.errstate(over="ignore"):
+            for i, s in enumerate(encoded):
+                h = np.uint64(0xCBF29CE484222325)
+                for byte in s:
+                    h = (h ^ np.uint64(byte)) * np.uint64(0x100000001B3)
+                out[i] = h
     if len(_HASH_LUTS) > 64:
         _HASH_LUTS.clear()
     _HASH_LUTS[id(d)] = (d, out)  # strong ref keeps the id stable

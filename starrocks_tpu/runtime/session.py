@@ -249,7 +249,7 @@ class Session:
                 return self._query(parse(
                     "select table_name, table_type "
                     "from information_schema.tables"))
-            return sorted(self.catalog.tables) + sorted(self.catalog.views)
+            return sorted([*self.catalog.tables, *self.catalog.views])
         if isinstance(stmt, ast.ShowPartitions):
             return self._show_partitions(stmt.table.lower())
         if isinstance(stmt, ast.AlterTable):

@@ -853,12 +853,55 @@ def _replace_scalar_marker(e: Expr, marker, replacement: Expr) -> Expr:
 # --- 3. join reordering ------------------------------------------------------
 
 
+def _filter_selectivity(pred, child, catalog) -> float:
+    """Stats-aware selectivity (reference: the CBO's PredicateStatisticsCalculator
+    re-designed on exact NDV): eq-vs-literal = 1/NDV, IN-list = k/NDV,
+    LIKE = 0.1, range conjunct = 0.3, anything else 0.25; conjuncts
+    multiply with a floor so stacked guesses can't zero out."""
+    def col_ndv(e) -> float | None:
+        if not isinstance(e, Col):
+            return None
+        origin = col_origin(child, e.name)
+        if origin is None:
+            return None
+        t = catalog.get_table(origin[0])
+        if t is None:
+            return None
+        ndv = t.column_ndv(origin[1])
+        return float(ndv) if ndv else None
+
+    sel = 1.0
+    for c in _conjuncts(pred):
+        s = 0.25
+        if isinstance(c, InList) and not c.negated:
+            ndv = col_ndv(c.arg)
+            if ndv:
+                s = min(len(c.values) / ndv, 1.0)
+        elif isinstance(c, Call) and len(c.args) == 2:
+            a, b = c.args
+            lit_side = isinstance(b, Lit) or isinstance(a, Lit)
+            col = a if isinstance(a, Col) else (b if isinstance(b, Col)
+                                                else None)
+            if c.fn == "eq" and lit_side and col is not None:
+                ndv = col_ndv(col)
+                if ndv:
+                    s = 1.0 / ndv
+            elif c.fn in ("ge", "gt", "le", "lt") and lit_side:
+                s = 0.3
+            elif c.fn == "like":
+                s = 0.1
+        sel *= s
+    return max(sel, 1e-4)
+
+
 def estimate_rows(plan: LogicalPlan, catalog) -> float:
     if isinstance(plan, LScan):
         t = catalog.get_table(plan.table)
         return float(t.row_count if t is not None else 1000)
     if isinstance(plan, LFilter):
-        return 0.25 * estimate_rows(plan.child, catalog)
+        return _filter_selectivity(
+            plan.predicate, plan.child, catalog
+        ) * estimate_rows(plan.child, catalog)
     if isinstance(plan, LProject):
         return estimate_rows(plan.child, catalog)
     if isinstance(plan, LAggregate):
@@ -1170,10 +1213,17 @@ def _dp_order(rels, conjuncts, catalog) -> LogicalPlan:
                     # Exception: a single-leaf unique-key build lowers to the
                     # direct-addressing LUT join (one scatter, no sort).
                     build_w = 0.3
-                    if n_eq == 1 and bmask & (bmask - 1) == 0:
+                    if bmask & (bmask - 1) == 0:
                         bi = bmask.bit_length() - 1
-                        if prod_b >= 0.99 * base_rows[bi]:
-                            build_w = 0.02
+                        if (n_eq == 1
+                                and prod_b >= 0.99 * base_rows[bi]):
+                            build_w = 0.02  # unique dense key: LUT join
+                        elif isinstance(rels[bi], LScan):
+                            # base-scan build: its sort permutation is
+                            # cached across runs (DeviceCache
+                            # build_order_for) — far cheaper than sorting
+                            # a derived intermediate every execution
+                            build_w = 0.08
                     cost = ca + cb + rows + build_w * rb
                     if (entry is None or (has_eq and not entry_has_eq)
                             or (has_eq == entry_has_eq and cost < entry[0])):

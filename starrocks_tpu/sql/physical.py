@@ -505,9 +505,23 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                     out = filter_chunk(out, and_all(residual))
                 return out
 
+            # SEMI/ANTI against a stats-bounded single key: the exact dense
+            # presence bitmap IS the join (no build sort / probe search)
+            from ..runtime.config import config as _cfg
+
+            if (p.kind in ("semi", "anti") and not residual
+                    and _cfg.get("enable_runtime_filters")):
+                dsr = dense_rf_range(p.left, p.right, probe_keys,
+                                     build_keys, catalog)
+                if dsr is not None:
+                    from ..ops.join import dense_semi_anti_mask
+
+                    return lc.and_sel(dense_semi_anti_mask(
+                        lc, rc, tuple(probe_keys), tuple(build_keys), dsr,
+                        p.kind == "anti"))
+
             # build-side min/max runtime filter on the probe (INNER/SEMI only —
             # LEFT OUTER/ANTI must keep non-matching probe rows)
-            from ..runtime.config import config as _cfg
             from ..ops.join import runtime_filter_mask
 
             if p.kind in ("inner", "semi", "cross") and probe_keys and not (
