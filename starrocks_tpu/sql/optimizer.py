@@ -910,7 +910,25 @@ def estimate_rows(plan: LogicalPlan, catalog) -> float:
         l = estimate_rows(plan.left, catalog)
         r = estimate_rows(plan.right, catalog)
         if plan.kind in ("semi", "anti"):
-            return l * 0.5
+            # containment: the probe keeps at most as many key groups as the
+            # build has rows — l * |S| / NDV(probe key) (flat 0.5 otherwise)
+            frac = 0.5
+            if plan.condition is not None:
+                eqs = [c for c in _conjuncts(plan.condition)
+                       if isinstance(c, Call) and c.fn == "eq"
+                       and len(c.args) == 2]
+                if len(eqs) == 1:
+                    a, b = eqs[0].args
+                    lcol = (a if isinstance(a, Col)
+                            and col_origin(plan.left, a.name) else
+                            (b if isinstance(b, Col) else None))
+                    if lcol is not None:
+                        ndv = _key_ndv(plan.left, lcol.name, l, catalog)
+                        frac = min(estimate_rows(plan.right, catalog)
+                                   / max(ndv, 1.0), 1.0)
+            if plan.kind == "anti":
+                frac = 1.0 - 0.5 * frac  # anti keeps the complement-ish
+            return max(l * frac, 1.0)
         if plan.kind in ("inner", "left") and plan.condition is not None:
             # composite-key System-R estimate (same formula as _dp_order):
             # |L ⋈ R| = |L||R| / max(side composite NDVs), each side's key-
