@@ -345,12 +345,21 @@ class Executor:
         res = self._execute_plain(plan_a, profile)
         ht = res.table
 
-        # side plan: (keys..., arg per gc) straight off the agg input
+        # side plan: (keys..., arg per gc, order-by exprs per gc) straight
+        # off the agg input
         items = tuple(
             (f"__k{i}", e) for i, (_, e) in enumerate(agg.group_by)
         ) + tuple(
             (f"__a{j}", a.arg) for j, (_, a) in enumerate(gcs)
         )
+        order_specs = []  # per gc: [(col_offset, asc), ...]
+        for j, (_, a) in enumerate(gcs):
+            spec = []
+            for m, item in enumerate(a.extra[1:]):
+                expr, asc = item[0], item[1]
+                spec.append((len(items), asc))
+                items = items + ((f"__o{j}_{m}", expr),)
+            order_specs.append(spec)
         side = self._execute_plain(LProject(agg.child, items))
         srows = side.table.to_pylist()
         nk = len(agg.group_by)
@@ -361,7 +370,8 @@ class Executor:
                 v = row[nk + j]
                 if v is None:
                     continue
-                per_gc[j].setdefault(key, []).append(v)
+                okey = tuple(row[pos] for pos, _ in order_specs[j])
+                per_gc[j].setdefault(key, []).append((okey, v))
 
         def fmt(v):
             if isinstance(v, bool):
@@ -373,14 +383,36 @@ class Executor:
         concat = []
         for j, (_, a) in enumerate(gcs):
             sep = ","
-            if a.extra and isinstance(a.extra[0], Lit):
+            if a.extra and isinstance(a.extra[0], Lit) \
+                    and a.extra[0].value is not None:
                 sep = str(a.extra[0].value)
+            spec = order_specs[j]
             m = {}
-            for key, vals in per_gc[j].items():
+            for key, pairs in per_gc[j].items():
+                if spec:
+                    # explicit ORDER BY: stable multi-pass sort; NULL order
+                    # keys always sort last (second stable pass per key)
+                    for idx in range(len(spec) - 1, -1, -1):
+                        _, asc = spec[idx]
+                        pairs = sorted(
+                            pairs,
+                            key=lambda p, i=idx: (
+                                (isinstance(p[0][i], str), p[0][i])
+                                if p[0][i] is not None else (False, 0)),
+                            reverse=not asc)
+                        # NULL placement follows the engine's ORDER BY
+                        # default: last on ASC, first on DESC
+                        pairs = sorted(
+                            pairs,
+                            key=lambda p, i=idx, a=asc: (
+                                (p[0][i] is None) == a))
+                    vals = [v for _, v in pairs]
+                else:
+                    vals = sorted((v for _, v in pairs),
+                                  key=lambda x: (isinstance(x, str), x))
                 if a.distinct:
                     vals = list(dict.fromkeys(vals))
-                m[key] = sep.join(fmt(v) for v in sorted(
-                    vals, key=lambda x: (isinstance(x, str), x)))
+                m[key] = sep.join(fmt(v) for v in vals)
             concat.append(m)
 
         # patch the result: replace gc columns, drop hidden key columns

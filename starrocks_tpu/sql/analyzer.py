@@ -478,11 +478,15 @@ class Analyzer:
                 if e.arg is not None
                 else None
             )
-            extra = tuple(
-                self._lower(x, scope, ctes, allow_agg=False)
-                if not isinstance(x, Lit) else x
-                for x in e.extra
-            )
+            def lower_extra(x):
+                if isinstance(x, Lit):
+                    return x
+                if isinstance(x, tuple):  # (expr, asc) order items
+                    return (self._lower(x[0], scope, ctes,
+                                        allow_agg=False),) + x[1:]
+                return self._lower(x, scope, ctes, allow_agg=False)
+
+            extra = tuple(lower_extra(x) for x in e.extra)
             return AggExpr(e.fn, arg, e.distinct, extra)
         if isinstance(e, Call):
             return Call(e.fn, *[self._lower(a, scope, ctes, allow_agg) for a in e.args])

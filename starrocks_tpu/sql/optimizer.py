@@ -155,10 +155,15 @@ def rewrite_distinct_aggs(plan: LogicalPlan) -> LogicalPlan:
     l1_aggs, l2_aggs, post = [], [], {}
     for name, a in plan.aggs:
         if a.distinct:
-            if any(isinstance(x, Expr) and not isinstance(x, Lit)
+            # tuple extras are group_concat (expr, asc) ORDER BY items —
+            # the level-2 aggregate could not re-evaluate them over the
+            # level-1 output, so the rewrite must not fire either
+            if any(isinstance(x, tuple)
+                   or (isinstance(x, Expr) and not isinstance(x, Lit))
                    for x in a.extra):
                 raise NotImplementedError(
-                    f"DISTINCT with two-argument aggregate {a.fn}")
+                    f"DISTINCT with expression arguments in {a.fn} cannot "
+                    "be two-level rewritten")
             l2_aggs.append((name, AggExpr(a.fn, Col("__darg"), extra=a.extra)))
         elif a.fn in ("count", "count_star"):
             l1_aggs.append((name, a))

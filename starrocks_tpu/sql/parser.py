@@ -842,6 +842,33 @@ class Parser:
             args.append(self.parse_expr())
             while self.accept_op(","):
                 args.append(self.parse_expr())
+        # group_concat tails: [ORDER BY e [ASC|DESC], ...] [SEPARATOR 's']
+        gc_order, gc_sep = None, None
+        if self.at_kw("order"):
+            self.next()
+            self.expect_kw("by")
+            gc_order = []
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.accept_kw("desc"):
+                    asc = False
+                else:
+                    self.accept_kw("asc")
+                gc_order.append((e, asc))
+                if not self.accept_op(","):
+                    break
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "separator"):
+            self.next()
+            t = self.next()
+            if t.kind != "string":
+                raise ParseError("SEPARATOR expects a string literal")
+            gc_sep = t.value
+        if (gc_order is not None or gc_sep is not None) \
+                and name.lower() != "group_concat":
+            raise ParseError(
+                f"ORDER BY/SEPARATOR inside {name}() is not supported")
         self.expect_op(")")
         if self.at_kw("over"):
             return self.parse_over(name, args, distinct)
@@ -876,12 +903,19 @@ class Parser:
                            extra=(args[1],))
         if name == "group_concat":
             # host-finalized aggregate (executor runs a side plan; see
-            # runtime/executor.py _execute_group_concat); optional second
-            # argument is the separator
+            # runtime/executor.py _execute_group_concat). Separator comes
+            # either as the legacy second argument or SEPARATOR 's';
+            # ORDER BY items ride in extra as (expr, asc) tuples.
             if not args:
                 raise ParseError("group_concat takes at least one argument")
+            if len(args) > 1 and gc_sep is not None:
+                raise ParseError(
+                    "group_concat: use either a positional separator or "
+                    "SEPARATOR, not both")
+            sep = args[1] if len(args) > 1 else (
+                Lit(gc_sep) if gc_sep is not None else Lit(","))
             return AggExpr("group_concat", args[0], distinct,
-                           extra=tuple(args[1:2]))
+                           extra=(sep, *map(tuple, gc_order or ())))
         if name in AGG_FUNCS:
             if name == "count" and args and isinstance(args[0], ast.Star):
                 return AggExpr("count", None, distinct)

@@ -1,0 +1,47 @@
+"""group_concat ORDER BY / SEPARATOR (round-3 leftover; reference:
+be/src/exprs/agg/group_concat.h ORDER BY support)."""
+
+import pytest
+
+from starrocks_tpu.column import HostTable
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.storage.catalog import Catalog
+
+
+@pytest.fixture()
+def sess():
+    cat = Catalog()
+    cat.register("t", HostTable.from_pydict({
+        "g": [1, 1, 1, 2, 2],
+        "name": ["bob", "amy", "cid", "zed", "ann"],
+        "rank": [2, 1, None, 5, 4],
+    }))
+    return Session(cat)
+
+
+def test_order_by_expr(sess):
+    r = sess.sql("select g, group_concat(name order by rank) from t "
+                 "group by g order by g").rows()
+    # NULL rank sorts last within the group
+    assert r == [(1, "amy,bob,cid"), (2, "ann,zed")]
+    r = sess.sql("select g, group_concat(name order by rank desc) from t "
+                 "group by g order by g").rows()
+    # NULL placement follows the engine's ORDER BY default: first on DESC
+    assert r == [(1, "cid,bob,amy"), (2, "zed,ann")]
+
+
+def test_double_separator_rejected(sess):
+    import pytest as _pt
+
+    with _pt.raises(Exception, match="not both"):
+        sess.sql("select group_concat(name, ';' separator '|') from t")
+
+
+def test_separator_and_self_order(sess):
+    r = sess.sql("select g, group_concat(name order by name separator '|') "
+                 "from t group by g order by g").rows()
+    assert r == [(1, "amy|bob|cid"), (2, "ann|zed")]
+    # legacy positional separator still works, default ordering unchanged
+    r = sess.sql("select g, group_concat(name, ';') from t "
+                 "group by g order by g").rows()
+    assert r == [(1, "amy;bob;cid"), (2, "ann;zed")]
