@@ -517,3 +517,158 @@ def execute_spill_sort(sp: SpillSortPlan, catalog, batch_rows: int,
         {k: v[order] for k, v in merged_arrays.items()},
         {k: v[order] for k, v in merged_valids.items()},
     )
+
+
+# --- spilled WINDOW: host hash-partitioned groups, device window per group ----
+
+
+@dataclasses.dataclass
+class SpillWindowPlan:
+    top_chain: list  # Project/Filter above the windows (per-row operators)
+    windows: list  # LWindow stack, outermost first
+    hash_cols: list  # scan columns common to every window's PARTITION BY
+    scan_chain: list  # Filter/identity-Project* between windows and scan
+    scan: LScan
+
+
+def match_spill_window(plan: LogicalPlan):
+    """(Project/Filter)* -> LWindow(partitioned) -> Filter* -> LScan.
+    Window partitions are disjoint under PARTITION BY, so hash-splitting
+    ROWS by the partition keys preserves exact window semantics per group
+    (the Grace-join recipe applied to windows). Partition keys must be
+    plain scan columns so the host can route without re-implementing
+    expression semantics."""
+    from ..sql.logical import LWindow
+
+    top = []
+    node = plan
+    while isinstance(node, (LProject, LFilter)):
+        top.append(node)
+        node = node.child
+    windows = []
+    while isinstance(node, LWindow):
+        if not node.partition_by:
+            return None
+        windows.append(node)
+        node = node.child
+    if not windows:
+        return None
+    chain = []
+    while isinstance(node, (LFilter, LProject)):
+        if isinstance(node, LProject) and not all(
+                isinstance(e, Col) and n == e.name for n, e in node.exprs):
+            return None  # computed/renaming projections between window and
+            # scan would detach partition-key names from scan columns
+        chain.append(node)
+        node = node.child
+    if not isinstance(node, LScan):
+        return None
+    # hash-splitting by K preserves every window iff K is a subset of each
+    # window's partition keys: use the intersection of their key sets
+    key_sets = []
+    for w in windows:
+        cols = set()
+        for e in w.partition_by:
+            if not isinstance(e, Col):
+                return None
+            base = e.name.split(".", 1)[-1]
+            if base not in node.columns:
+                return None
+            cols.add(base)
+        key_sets.append(cols)
+    common = sorted(set.intersection(*key_sets))
+    if not common:
+        return None
+    return SpillWindowPlan(top, windows, common, chain, node)
+
+
+def _np_mix64(x):
+    import numpy as np
+
+    z = np.asarray(x, np.uint64).copy()
+    with np.errstate(over="ignore"):
+        z ^= z >> np.uint64(30)
+        z *= np.uint64(0xBF58476D1CE4E5B9)
+        z ^= z >> np.uint64(27)
+        z *= np.uint64(0x94D049BB133111EB)
+        z ^= z >> np.uint64(31)
+    return z
+
+
+def execute_spill_window(sp: SpillWindowPlan, catalog, batch_rows: int,
+                         programs_cache: dict, profile_node):
+    """Host-partition rows by the window's PARTITION BY keys, run the full
+    window program per group on device, concatenate on the host."""
+    import numpy as np
+
+    from ..column import HostTable
+    from ..ops.window import window_op
+
+    handle = catalog.get_table(sp.scan.table)
+    ht = handle.table
+    total = ht.num_rows
+    n_groups = max(1, -(-total // batch_rows))
+
+    key_cols = sp.hash_cols
+    h = np.zeros(total, np.uint64)
+    with np.errstate(over="ignore"):
+        for c in key_cols:
+            kd = np.asarray(ht.arrays[c]).astype(np.int64).view(np.uint64)
+            h = _np_mix64(h ^ (kd * np.uint64(0x9E3779B97F4A7C15)))
+    bucket = (h % np.uint64(n_groups)).astype(np.int64)
+    order = np.argsort(bucket, kind="stable")
+    counts = np.bincount(bucket, minlength=n_groups)
+    cap = pad_capacity(int(counts.max()) if total else 1)
+
+    prog_key = ("spill_window", tuple(sp.windows), tuple(sp.scan_chain),
+                tuple(sp.top_chain), cap)
+    if prog_key not in programs_cache:
+        def prog(chunk: Chunk):
+            c = chunk
+            for node in reversed(sp.scan_chain):
+                if isinstance(node, LFilter):
+                    c = filter_chunk(c, node.predicate)
+                else:
+                    c = project(c, [e for _, e in node.exprs],
+                                [n for n, _ in node.exprs])
+            for w in reversed(sp.windows):  # innermost window first
+                c = window_op(c, w.partition_by, w.order_by, w.funcs)
+            return _apply_top_chain(c, sp.top_chain)
+
+        programs_cache[prog_key] = jax.jit(prog)
+    jprog = programs_cache[prog_key]
+
+    alias, cols = sp.scan.alias, sp.scan.columns
+    profile_node.set_info("partition_groups", n_groups)
+    outs = []
+    off = 0
+    fields = tuple(
+        dataclasses.replace(ht.schema.field(c), name=f"{alias}.{c}")
+        for c in cols)
+    for g in range(n_groups):
+        cnt = int(counts[g])
+        idx = order[off:off + cnt]
+        off += cnt
+        if cnt == 0:
+            continue
+        arrays = {f"{alias}.{c}": np.asarray(ht.arrays[c])[idx]
+                  for c in cols}
+        valids = {f"{alias}.{c}": ht.valids[c][idx]
+                  for c in cols if c in ht.valids}
+        chunk = chunk_from_arrays(Schema(fields), arrays, valids, cnt,
+                                  capacity=cap)
+        outs.append(HostTable.from_chunk(jprog(chunk)))
+
+    first = outs[0]
+    arrays, valids = {}, {}
+    for f in first.schema:
+        for t in outs[1:]:
+            if t.schema.field(f.name).dict is not f.dict:
+                raise AssertionError(
+                    "spill-window groups must share source dictionaries")
+        arrays[f.name] = np.concatenate([t.arrays[f.name] for t in outs])
+        if any(f.name in t.valids for t in outs):
+            valids[f.name] = np.concatenate([
+                t.valids.get(f.name, np.ones(t.num_rows, dtype=np.bool_))
+                for t in outs])
+    return HostTable(first.schema, arrays, valids)

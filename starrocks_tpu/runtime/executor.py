@@ -658,6 +658,17 @@ class Executor:
                     node = profile.child("spill_sort")
                     return execute_spill_sort(
                         sp, self.catalog, batch_rows, cache["progs"], node)
+            # spilled WINDOW: partitions hash-split to HBM-sized groups
+            from .batched import execute_spill_window, match_spill_window
+
+            wp = match_spill_window(plan)
+            if wp is not None:
+                h = self.catalog.get_table(wp.scan.table)
+                if h is not None and h.row_count > batch_threshold:
+                    cache = self.cache.program_bucket(("spillwin", plan))
+                    node = profile.child("spill_window")
+                    return execute_spill_window(
+                        wp, self.catalog, batch_rows, cache["progs"], node)
         if bp is None:
             # Grace join: both sides host-partitioned by the join key when
             # either exceeds the streaming threshold

@@ -43,6 +43,15 @@ def _writable(name: str):
         raise ValueError(f"table name {name!r} is reserved")
 
 
+def _reject_external(handle):
+    from ..storage.external import ExternalTableHandle
+
+    if isinstance(handle, ExternalTableHandle):
+        raise ValueError(
+            f"table {handle.name!r} is EXTERNAL (read-only: the files "
+            "belong to another system)")
+
+
 class Session:
     """data_dir=None -> in-memory tables only; with a data_dir, DDL and loads
     persist through the TabletStore (bucketed parquet rowsets + edit log) and
@@ -77,6 +86,51 @@ class Session:
                         tuple(m.get("distribution", ())),
                     )
                 )
+            self._replay_external_defs()
+
+    def _external_defs_path(self):
+        import os
+
+        return (os.path.join(self.store.root, "external_tables.json")
+                if self.store is not None else None)
+
+    def _save_external_defs(self, add=None, remove=None):
+        """External-table definitions survive restarts next to the store's
+        manifests (the FE edit-log analog for connector metadata)."""
+        import json as _json
+        import os
+
+        path = self._external_defs_path()
+        if path is None:
+            return
+        defs = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                defs = _json.load(f)
+        if add:
+            defs.update(add)
+        if remove:
+            defs.pop(remove, None)
+        with open(path, "w") as f:
+            _json.dump(defs, f)
+
+    def _replay_external_defs(self):
+        import json as _json
+        import os
+
+        path = self._external_defs_path()
+        if path is None or not os.path.exists(path):
+            return
+        from ..storage.external import ExternalTableHandle
+
+        with open(path) as f:
+            defs = _json.load(f)
+        for name, location in defs.items():
+            try:
+                self.catalog.register_handle(
+                    ExternalTableHandle(name, location))
+            except ValueError:
+                pass  # files vanished; the definition stays until DROP
 
     def load_csv(self, table: str, path: str, **csv_opts) -> int:
         """Stream-load a CSV file into a table (reference: stream load path,
@@ -85,6 +139,7 @@ class Session:
         handle = self.catalog.get_table(table)
         if handle is None:
             raise ValueError(f"unknown table {table}")
+        _reject_external(handle)
         incoming = None
         if not csv_opts:
             incoming = self._load_csv_native(handle, path)
@@ -196,6 +251,17 @@ class Session:
             self.cache.programs.clear()
             self.cache.opt_plans.clear()
             return None
+        if isinstance(stmt, ast.CreateExternalTable):
+            from ..storage.external import ExternalTableHandle
+
+            name = stmt.name.lower()
+            if self.catalog.get_table(name) is not None \
+                    or name in self.catalog.views:
+                raise ValueError(f"name {name!r} already exists")
+            self.catalog.register_handle(
+                ExternalTableHandle(name, stmt.location))
+            self._save_external_defs(add={name: stmt.location})
+            return None
         if isinstance(stmt, ast.CreateTable):
             return self._create(stmt)
         if isinstance(stmt, ast.DropTable):
@@ -204,11 +270,16 @@ class Session:
                 del self.catalog.views[nm]
                 return None
             self.catalog.mv_defs.pop(nm, None)
+            from ..storage.external import ExternalTableHandle as _Ext
+
+            was_external = isinstance(self.catalog.get_table(nm), _Ext)
             existed = self.catalog.get_table(stmt.name) is not None
             self.catalog.drop(stmt.name, stmt.if_exists)
             self.cache.invalidate(stmt.name.lower())
             self.catalog.bump_version(stmt.name.lower())
-            if self.store is not None and existed:
+            if was_external:
+                self._save_external_defs(remove=nm)
+            elif self.store is not None and existed:
                 self.store.drop_table(stmt.name.lower())
             return None
         if isinstance(stmt, ast.Insert):
@@ -279,6 +350,8 @@ class Session:
         _writable(stmt.table)
         name = stmt.table.lower()
         handle = self.catalog.get_table(name)
+        if handle is not None:
+            _reject_external(handle)
         if handle is None:
             raise ValueError(f"unknown table {name}")
         if self.store is not None and isinstance(handle, StoredTableHandle):
@@ -459,7 +532,8 @@ class Session:
                                ast.CreateView, ast.RefreshView,
                                ast.CreateUser, ast.DropUser, ast.Grant,
                                ast.Revoke, ast.AlterTable,
-                               ast.CreateFunction, ast.DropFunction)):
+                               ast.CreateFunction, ast.DropFunction,
+                               ast.CreateExternalTable)):
             raise PermissionError(
                 f"user {user!r} lacks the admin privileges for DDL")
 
@@ -560,6 +634,7 @@ class Session:
         handle = self.catalog.get_table(stmt.table)
         if handle is None:
             raise ValueError(f"unknown table {stmt.table}")
+        _reject_external(handle)
         before = handle.row_count
         if stmt.where is None:
             kept = _empty_like(handle.schema)
@@ -584,6 +659,7 @@ class Session:
         handle = self.catalog.get_table(stmt.table)
         if handle is None:
             raise ValueError(f"unknown table {stmt.table}")
+        _reject_external(handle)
         assigned = dict(stmt.assignments)
         pk_cols = {k for ks in handle.unique_keys for k in ks}
         for c in assigned:
@@ -752,6 +828,7 @@ class Session:
         handle = self.catalog.get_table(stmt.table)
         if handle is None:
             raise ValueError(f"unknown table {stmt.table}")
+        _reject_external(handle)
         if stmt.select is not None:
             res = self._query(stmt.select)
             incoming = res.table

@@ -298,3 +298,9 @@ class CreateFunction:
 class DropFunction:
     name: str
     if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateExternalTable:
+    name: str
+    location: str

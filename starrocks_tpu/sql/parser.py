@@ -1156,6 +1156,19 @@ class Parser:
                                       replace)
         if replace:
             raise ParseError("OR REPLACE is only supported for FUNCTION")
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "external"):
+            # CREATE EXTERNAL TABLE name FROM '<parquet dir/glob/file>'
+            self.next()
+            self.expect_kw("table")
+            name = self.expect_ident()
+            self.expect_kw("from")
+            t = self.next()
+            if t.kind != "string":
+                raise ParseError(
+                    "CREATE EXTERNAL TABLE expects a quoted location")
+            self.accept_op(";")
+            return ast.CreateExternalTable(name, t.value)
         if self.peek().kind == "ident" and self.peek().value.lower() == "user":
             self.next()
             user = self._parse_user_name()

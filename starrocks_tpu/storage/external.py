@@ -1,0 +1,89 @@
+"""Read-only external parquet tables (the connector framework's first axis).
+
+Reference behavior: the connector SPI + file external tables
+(be/src/connector/, fe/fe-core/.../connector/ — federation over files the
+engine does not own). Re-designed to the engine's host-table model: an
+external table is a parquet directory/glob whose schema is read from file
+footers; data loads lazily through the same HostTable path as native
+tables, so every operator (joins, aggregates, MV definitions, sketches)
+works unchanged. Writes are rejected — the files belong to someone else.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+
+import numpy as np
+
+from ..column import HostTable, Schema
+from .catalog import TableHandle
+
+
+def _resolve(path: str) -> list:
+    if any(ch in path for ch in "*?["):
+        files = sorted(_glob.glob(path))
+    elif os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".parquet"))
+    else:
+        files = [path]
+    return [f for f in files if os.path.isfile(f)]
+
+
+class ExternalTableHandle(TableHandle):
+    """Catalog handle over foreign parquet files: schema from footers,
+    row counts from metadata (no data IO), lazy full load on first scan."""
+
+    def __init__(self, name: str, location: str):
+        if not _resolve(location):
+            raise ValueError(f"no parquet files match {location!r}")
+        super().__init__(name, None)
+        self.location = location
+        self._schema: Schema | None = None
+        self._meta_rows: int | None = None
+
+    @property
+    def schema(self) -> Schema:
+        if self._schema is None:
+            self._load()
+        return self._schema
+
+    @property
+    def table(self) -> HostTable:
+        if self._table is None:
+            self._load()
+        return self._table
+
+    @property
+    def row_count(self) -> int:
+        if self._table is not None:
+            return self._table.num_rows
+        if self._meta_rows is None:  # cached: footer IO is per-file
+            import pyarrow.parquet as pq
+
+            self._meta_rows = sum(
+                pq.read_metadata(f).num_rows
+                for f in _resolve(self.location))
+        return self._meta_rows
+
+    def _load(self):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        files = _resolve(self.location)  # fresh: the dir may have changed
+        if not files:
+            raise ValueError(f"no parquet files match {self.location!r}")
+        tables = [pq.read_table(f) for f in files]
+        merged = pa.concat_tables(tables, promote_options="default")
+        self._table = HostTable.from_arrow(merged)
+        self._schema = self._table.schema
+
+    def invalidate(self):
+        # external data may change underneath; a refresh re-resolves the
+        # file set and re-reads footers/data
+        self._table = None
+        self._schema = None
+        self._meta_rows = None
+        self._stats = {}

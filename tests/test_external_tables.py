@@ -1,0 +1,109 @@
+"""Read-only external parquet tables (connector framework, first axis;
+reference: be/src/connector/ + file external tables)."""
+
+import numpy as np
+import pytest
+
+from starrocks_tpu.runtime.session import Session
+
+
+@pytest.fixture()
+def ext_dir(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = tmp_path / "lake"
+    d.mkdir()
+    for i in range(3):
+        t = pa.table({
+            "k": pa.array([i * 10 + j for j in range(10)], pa.int64()),
+            "cat": pa.array([f"c{(i * 10 + j) % 4}" for j in range(10)]),
+            "x": pa.array([float(j) + i for j in range(10)], pa.float64()),
+        })
+        pq.write_table(t, d / f"part-{i}.parquet")
+    return str(d)
+
+
+def test_external_scan_and_joins(ext_dir):
+    s = Session()
+    s.sql(f"create external table lake from '{ext_dir}'")
+    assert s.sql("select count(*), min(k), max(k) from lake").rows() == \
+        [(30, 0, 29)]
+    r = s.sql("select cat, count(*), sum(x) from lake group by cat "
+              "order by cat").rows()
+    assert len(r) == 4 and sum(row[1] for row in r) == 30
+    # joins with native tables work unchanged
+    s.sql("create table dim (cat varchar, label varchar)")
+    s.sql("insert into dim values ('c0', 'zero'), ('c1', 'one')")
+    r = s.sql("select d.label, count(*) from lake l join dim d "
+              "on l.cat = d.cat group by d.label order by 1").rows()
+    assert [x[0] for x in r] == ["one", "zero"]
+
+
+def test_external_metadata_only_row_count(ext_dir):
+    from starrocks_tpu.storage.external import ExternalTableHandle
+
+    h = ExternalTableHandle("lake", ext_dir)
+    assert h.row_count == 30        # footers only
+    assert h._table is None         # no data loaded yet
+    assert len(h.schema.names) == 3
+
+
+def test_external_rejects_writes(ext_dir):
+    s = Session()
+    s.sql(f"create external table lake from '{ext_dir}'")
+    for stmt in ("insert into lake values (1, 'c0', 1.0)",
+                 "delete from lake where k = 1",
+                 "update lake set x = 0 where k = 1"):
+        with pytest.raises(ValueError, match="EXTERNAL"):
+            s.sql(stmt)
+    # DROP unregisters without touching the files
+    s.sql("drop table lake")
+    import os
+
+    assert len(os.listdir(ext_dir)) == 3
+
+
+def test_external_glob_and_info_schema(ext_dir):
+    s = Session()
+    s.sql(f"create external table l2 from '{ext_dir}/part-*.parquet'")
+    assert s.sql("select count(*) from l2").rows() == [(30,)]
+    r = dict(s.sql("select table_name, table_type from "
+                   "information_schema.tables").rows())
+    assert "l2" in r
+
+
+def test_external_defs_survive_restart(ext_dir, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    store = tmp_path / "store"
+    s = Session(data_dir=str(store))
+    s.sql(f"create external table lake from '{ext_dir}'")
+    assert s.sql("select count(*) from lake").rows() == [(30,)]
+    s2 = Session(data_dir=str(store))
+    assert s2.sql("select count(*) from lake").rows() == [(30,)]
+    # a new file appears after CREATE: refresh sees it
+    pq.write_table(pa.table({"k": pa.array([99], pa.int64()),
+                             "cat": pa.array(["c9"]),
+                             "x": pa.array([1.0], pa.float64())}),
+                   ext_dir + "/part-9.parquet")
+    s2.catalog.get_table("lake").invalidate()
+    s2.cache.invalidate("lake")
+    assert s2.sql("select count(*) from lake").rows() == [(31,)]
+    s2.sql("drop table lake")
+    s3 = Session(data_dir=str(store))
+    assert s3.catalog.get_table("lake") is None
+
+
+def test_external_rejects_load_csv_and_alter(ext_dir, tmp_path):
+    s = Session()
+    s.sql(f"create external table lake from '{ext_dir}'")
+    csv = tmp_path / "x.csv"
+    csv.write_text("1,c0,1.0\n")
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="EXTERNAL"):
+        s.load_csv("lake", str(csv))
+    with _pt.raises(ValueError, match="EXTERNAL"):
+        s.sql("alter table lake add column extra int")
