@@ -53,8 +53,11 @@ def _string_to_array_fn(cc, s: EVal, parts_fn) -> EVal:
         parts = parts_fn(s.data)
         d, codes = StringDict.from_strings(parts)
         row = jnp.concatenate([
-            jnp.asarray([len(parts)], jnp.int32), jnp.asarray(codes)])
-        return EVal(row[None, :], s.valid, T.ARRAY(T.VARCHAR), d)
+            jnp.asarray([len(parts)], jnp.int32),
+            jnp.asarray(codes, jnp.int32)])
+        data = jnp.broadcast_to(row[None, :],
+                                (cc.chunk.capacity, row.shape[0]))
+        return EVal(data, s.valid, T.ARRAY(T.VARCHAR), d)
     assert s.dict is not None, "string column required"
     all_parts = [list(parts_fn(str(v))) for v in s.dict.values]
     flat = [p for ps in all_parts for p in ps]
@@ -1064,3 +1067,118 @@ def _f_hll_serialize(cc, a):
 
 
 _alias("hll_deserialize", "hll_serialize")
+
+
+# --- regexp long tail ---------------------------------------------------------
+
+
+@function("regexp_count")
+def _f_regexp_count(cc, a, pat):
+    import re as _re
+
+    rx = _re.compile(_lit_str(pat, "regexp_count"))
+    return _string_int_fn(cc, a, lambda s: len(rx.findall(str(s))),
+                          T.BIGINT)
+
+
+@function("regexp_position")
+def _f_regexp_position(cc, a, pat):
+    import re as _re
+
+    rx = _re.compile(_lit_str(pat, "regexp_position"))
+
+    def f(s):
+        m = rx.search(str(s))
+        return (m.start() + 1) if m else -1  # 1-based; -1 = no match
+
+    return _string_int_fn(cc, a, f, T.BIGINT)
+
+
+@function("regexp_split")
+def _f_regexp_split(cc, a, pat):
+    import re as _re
+
+    rx = _re.compile(_lit_str(pat, "regexp_split"))
+    return _string_to_array_fn(cc, a, lambda s: rx.split(str(s)))
+
+
+@function("regexp_extract_all")
+def _f_regexp_extract_all(cc, a, pat, group=None):
+    import re as _re
+
+    rx = _re.compile(_lit_str(pat, "regexp_extract_all"))
+    g = int(group.data) if group is not None else (
+        1 if rx.groups else 0)
+
+    def f(s):
+        out = []
+        for m in rx.finditer(str(s)):
+            out.append(m.group(g) or "")
+        return out
+
+    return _string_to_array_fn(cc, a, f)
+
+
+# --- numeric / utility long tail ----------------------------------------------
+
+
+@function("equiwidth_bucket")
+def _f_equiwidth_bucket(cc, x, lo, hi, nb):
+    """Bucket id in [0, nb+1]: 0 below lo, nb+1 at/above hi (reference:
+    the histogram bucketing builtin)."""
+    xv = jnp.asarray(x.data, jnp.float64)
+    lo_v, hi_v, n = float(lo.data), float(hi.data), int(nb.data)
+    if hi_v <= lo_v or n <= 0:
+        raise ValueError("equiwidth_bucket needs lo < hi and buckets > 0")
+    b = jnp.floor((xv - lo_v) / (hi_v - lo_v) * n) + 1
+    b = jnp.where(xv < lo_v, 0, jnp.where(xv >= hi_v, n + 1, b))
+    return EVal(jnp.asarray(b, jnp.int64), x.valid, T.BIGINT)
+
+
+@function("bit_shift_right_logical")
+def _f_bsr_logical(cc, a, n):
+    av = jnp.asarray(a.data, jnp.int64).view(jnp.uint64)
+    nv = jnp.asarray(n.data, jnp.uint64)
+    return EVal(jnp.asarray(av >> nv, jnp.uint64).view(jnp.int64),
+                _and_valid(a.valid, n.valid), T.BIGINT)
+
+
+@function("sec_to_time")
+def _f_sec_to_time(cc, a):
+    def f(v):
+        v = int(v)
+        sign = "-" if v < 0 else ""
+        v = abs(v)
+        return f"{sign}{v // 3600:02d}:{(v // 60) % 60:02d}:{v % 60:02d}"
+
+    return _bounded_value_strings(cc, a, f, "sec_to_time")
+
+
+@function("bar")
+def _f_bar(cc, x, lo, hi, width):
+    """Text histogram bar (reference: the diagnostics bar() render)."""
+    lo_v, hi_v, w = float(lo.data), float(hi.data), int(width.data)
+
+    def f(v):
+        frac = (float(v) - lo_v) / max(hi_v - lo_v, 1e-300)
+        n = max(0, min(w, int(round(frac * w))))
+        return "█" * n
+
+    return _bounded_value_strings(cc, x, f, "bar")
+
+
+@function("query_id")
+def _f_query_id(cc):
+    return _const_str(cc, "")  # per-statement ids live in the query log
+
+
+_alias("last_query_id", "query_id")
+
+
+@function("sleep")
+def _f_sleep(cc, a):
+    import time as _time
+
+    _time.sleep(min(float(a.data), 5.0))  # capped trace-time sleep
+    return EVal(jnp.broadcast_to(jnp.asarray(True),
+                                 (cc.chunk.capacity,)), None, T.BOOLEAN)

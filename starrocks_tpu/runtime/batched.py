@@ -613,7 +613,15 @@ def execute_spill_window(sp: SpillWindowPlan, catalog, batch_rows: int,
     h = np.zeros(total, np.uint64)
     with np.errstate(over="ignore"):
         for c in key_cols:
-            kd = np.asarray(ht.arrays[c]).astype(np.int64).view(np.uint64)
+            kd = np.asarray(ht.arrays[c]).astype(np.int64)
+            v = ht.valids.get(c)
+            if v is not None:
+                # NULL keys must land in ONE group like the device window's
+                # both-NULL-equal rule; payload under invalid lanes is
+                # arbitrary, so zero it and mix the validity bit instead
+                kd = np.where(v, kd, np.int64(0))
+                kd = kd * 2 + np.asarray(v, np.int64)
+            kd = kd.view(np.uint64)
             h = _np_mix64(h ^ (kd * np.uint64(0x9E3779B97F4A7C15)))
     bucket = (h % np.uint64(n_groups)).astype(np.int64)
     order = np.argsort(bucket, kind="stable")

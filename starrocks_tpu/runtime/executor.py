@@ -664,6 +664,12 @@ class Executor:
             wp = match_spill_window(plan)
             if wp is not None:
                 h = self.catalog.get_table(wp.scan.table)
+                if h is not None and any(
+                        np.asarray(h.table.arrays[c]).ndim != 1
+                        for c in wp.hash_cols):
+                    wp = None  # wide keys (DECIMAL128/ARRAY): device path
+            if wp is not None:
+                h = self.catalog.get_table(wp.scan.table)
                 if h is not None and h.row_count > batch_threshold:
                     cache = self.cache.program_bucket(("spillwin", plan))
                     node = profile.child("spill_window")

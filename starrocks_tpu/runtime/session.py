@@ -111,8 +111,12 @@ class Session:
             defs.update(add)
         if remove:
             defs.pop(remove, None)
-        with open(path, "w") as f:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:  # atomic: the store's manifest pattern
             _json.dump(defs, f)
+        import os as _os
+
+        _os.replace(tmp, path)
 
     def _replay_external_defs(self):
         import json as _json
@@ -123,8 +127,11 @@ class Session:
             return
         from ..storage.external import ExternalTableHandle
 
-        with open(path) as f:
-            defs = _json.load(f)
+        try:
+            with open(path) as f:
+                defs = _json.load(f)
+        except (OSError, _json.JSONDecodeError):
+            return  # torn write must not brick the whole store
         for name, location in defs.items():
             try:
                 self.catalog.register_handle(

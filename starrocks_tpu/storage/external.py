@@ -47,7 +47,15 @@ class ExternalTableHandle(TableHandle):
     @property
     def schema(self) -> Schema:
         if self._schema is None:
-            self._load()
+            # footers only: DESCRIBE/information_schema must not read data
+            import pyarrow.parquet as pq
+
+            files = _resolve(self.location)
+            if not files:
+                raise ValueError(
+                    f"no parquet files match {self.location!r}")
+            empty = pq.read_schema(files[0]).empty_table()
+            self._schema = HostTable.from_arrow(empty).schema
         return self._schema
 
     @property

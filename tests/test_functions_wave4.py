@@ -191,3 +191,15 @@ def test_xxh64_long_input_vector():
     assert _xxh64_py(b"a" * 32) != _xxh64_py(b"a" * 31)
     # cross-checked reference value for b'x'*32
     assert _xxh64_py(b"x" * 32) == 0xE2DF261FC2EC30EB
+
+
+def test_regexp_and_utility_longtail(sess):
+    assert q1(sess, "regexp_count(s, 'l')") == 3  # 'hello world'
+    assert q1(sess, "regexp_position(s, 'wor')") == 7
+    assert q1(sess, "regexp_split('a1b22c', '[0-9]+')") == ["a", "b", "c"]
+    assert q1(sess, "regexp_extract_all('a1b22', '([0-9]+)')") == ["1", "22"]
+    assert q1(sess, "equiwidth_bucket(i, 0, 100, 10)") == 1
+    assert q1(sess, "equiwidth_bucket(i, 0, 100, 10)", "i = 255") == 11
+    assert q1(sess, "bit_shift_right_logical(-1, 63)") == 1
+    assert q1(sess, "sec_to_time(i)", "i = 4096") == "01:08:16"
+    assert q1(sess, "query_id()") == ""

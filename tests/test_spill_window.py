@@ -56,3 +56,26 @@ def test_spill_window_matches_device(cat, qi):
         config.set("batch_rows_threshold", 0)
         config.set("spill_batch_rows", 0)
     assert _norm(spill) == _norm(base)
+
+
+def test_spill_window_null_partition_keys_one_group():
+    """NULL partition keys must form ONE window partition in the spilled
+    path, matching the device window's both-NULL-equal rule."""
+    rng = np.random.default_rng(7)
+    n = 9000
+    keys = [None if i % 7 == 0 else int(i % 50) for i in range(n)]
+    c = Catalog()
+    c.register("t", HostTable.from_pydict({
+        "k": keys, "v": rng.integers(0, 1000, n)}))
+    q = "select k, count(*) over (partition by k) c from t"
+    base = Session(c).sql(q).rows()
+    config.set("batch_rows_threshold", 1024)
+    config.set("spill_batch_rows", 2000)
+    try:
+        s = Session(c)
+        spill = s.sql(q).rows()
+        assert "spill_window" in s.last_profile.render()
+    finally:
+        config.set("batch_rows_threshold", 0)
+        config.set("spill_batch_rows", 0)
+    assert sorted(spill, key=str) == sorted(base, key=str)
