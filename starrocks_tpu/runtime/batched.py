@@ -37,6 +37,43 @@ from ..sql.logical import (
 GROUP_CAP_KEY = "batched_agg"
 
 
+def slice_scan_chunk(ht, alias: str, cols, sel, cap: int):
+    """Device chunk of `ht[sel]` with alias-qualified names (shared by the
+    batched-agg, spill-sort, and spill-window group loops)."""
+    import numpy as np
+
+    arrays = {f"{alias}.{c}": np.asarray(ht.arrays[c])[sel] for c in cols}
+    valids = {f"{alias}.{c}": np.asarray(ht.valids[c])[sel]
+              for c in cols if c in ht.valids}
+    fields = tuple(
+        dataclasses.replace(ht.schema.field(c), name=f"{alias}.{c}")
+        for c in cols)
+    n = next(iter(arrays.values())).shape[0] if cols else 0
+    return chunk_from_arrays(Schema(fields), arrays, valids, n, capacity=cap)
+
+
+def host_concat_tables(tables):
+    """Concatenate same-schema HostTables (valids default to all-true);
+    asserts shared source dictionaries — the spill contract."""
+    import numpy as np
+
+    first = tables[0]
+    arrays, valids = {}, {}
+    for f in first.schema:
+        for t in tables[1:]:
+            if t.schema.field(f.name).dict is not f.dict:
+                raise AssertionError(
+                    "spill groups must share source dictionaries")
+        arrays[f.name] = np.concatenate([t.arrays[f.name] for t in tables])
+        if any(f.name in t.valids for t in tables):
+            valids[f.name] = np.concatenate([
+                t.valids.get(f.name, np.ones(t.num_rows, dtype=np.bool_))
+                for t in tables])
+    return first.schema, arrays, valids
+
+
+
+
 def _apply_top_chain(c, chain):
     """Interpret the (Project/Sort/Limit/Filter)* nodes above the merge."""
     for node in reversed(chain):
@@ -139,17 +176,7 @@ def execute_batched(
     max_ng = 0
     for b in range(n_batches):
         lo, hi = b * batch_rows, min((b + 1) * batch_rows, total)
-        arrays = {f"{alias}.{c}": ht.arrays[c][lo:hi] for c in cols}
-        valids = {
-            f"{alias}.{c}": ht.valids[c][lo:hi] for c in cols if c in ht.valids
-        }
-        fields = tuple(
-            dataclasses.replace(ht.schema.field(c), name=f"{alias}.{c}")
-            for c in cols
-        )
-        chunk = chunk_from_arrays(
-            Schema(fields), arrays, valids, hi - lo, capacity=cap
-        )
+        chunk = slice_scan_chunk(ht, alias, cols, slice(lo, hi), cap)
         out, ng = jpartial(chunk)
         partials.append(out)
         max_ng = max(max_ng, int(ng))
@@ -471,14 +498,7 @@ def execute_spill_sort(sp: SpillSortPlan, catalog, batch_rows: int,
     out_tables, out_ops = [], None
     for b in range(n_batches):
         lo, hi = b * batch_rows, min((b + 1) * batch_rows, total)
-        arrays = {f"{alias}.{c}": ht.arrays[c][lo:hi] for c in cols}
-        valids = {f"{alias}.{c}": ht.valids[c][lo:hi]
-                  for c in cols if c in ht.valids}
-        fields = tuple(
-            dataclasses.replace(ht.schema.field(c), name=f"{alias}.{c}")
-            for c in cols)
-        chunk = chunk_from_arrays(
-            Schema(fields), arrays, valids, hi - lo, capacity=cap)
+        chunk = slice_scan_chunk(ht, alias, cols, slice(lo, hi), cap)
         c, ops, live = jprog(chunk)
         live_np = np.asarray(live)
         out_tables.append(HostTable.from_chunk(c))  # drops dead rows
@@ -489,20 +509,7 @@ def execute_spill_sort(sp: SpillSortPlan, catalog, batch_rows: int,
             for acc, o in zip(out_ops, batch_ops):
                 acc.append(o)
 
-    first = out_tables[0]
-    merged_arrays, merged_valids = {}, {}
-    for f in first.schema:
-        for t in out_tables[1:]:
-            if t.schema.field(f.name).dict is not f.dict:
-                raise AssertionError(
-                    "spill-sort batches must share source dictionaries")
-        merged_arrays[f.name] = np.concatenate(
-            [t.arrays[f.name] for t in out_tables])
-        if any(f.name in t.valids for t in out_tables):
-            merged_valids[f.name] = np.concatenate([
-                t.valids.get(f.name,
-                             np.ones(t.num_rows, dtype=np.bool_))
-                for t in out_tables])
+    schema, merged_arrays, merged_valids = host_concat_tables(out_tables)
     order = np.lexsort(tuple(np.concatenate(a) for a in out_ops))
     lo = 0
     hi = len(order)
@@ -513,7 +520,7 @@ def execute_spill_sort(sp: SpillSortPlan, catalog, batch_rows: int,
         hi = min(hi, lo + sp.limit_node.limit)
     order = order[lo:hi]
     return HostTable(
-        first.schema,
+        schema,
         {k: v[order] for k, v in merged_arrays.items()},
         {k: v[order] for k, v in merged_valids.items()},
     )
@@ -650,33 +657,14 @@ def execute_spill_window(sp: SpillWindowPlan, catalog, batch_rows: int,
     profile_node.set_info("partition_groups", n_groups)
     outs = []
     off = 0
-    fields = tuple(
-        dataclasses.replace(ht.schema.field(c), name=f"{alias}.{c}")
-        for c in cols)
     for g in range(n_groups):
         cnt = int(counts[g])
         idx = order[off:off + cnt]
         off += cnt
         if cnt == 0:
             continue
-        arrays = {f"{alias}.{c}": np.asarray(ht.arrays[c])[idx]
-                  for c in cols}
-        valids = {f"{alias}.{c}": ht.valids[c][idx]
-                  for c in cols if c in ht.valids}
-        chunk = chunk_from_arrays(Schema(fields), arrays, valids, cnt,
-                                  capacity=cap)
+        chunk = slice_scan_chunk(ht, alias, cols, idx, cap)
         outs.append(HostTable.from_chunk(jprog(chunk)))
 
-    first = outs[0]
-    arrays, valids = {}, {}
-    for f in first.schema:
-        for t in outs[1:]:
-            if t.schema.field(f.name).dict is not f.dict:
-                raise AssertionError(
-                    "spill-window groups must share source dictionaries")
-        arrays[f.name] = np.concatenate([t.arrays[f.name] for t in outs])
-        if any(f.name in t.valids for t in outs):
-            valids[f.name] = np.concatenate([
-                t.valids.get(f.name, np.ones(t.num_rows, dtype=np.bool_))
-                for t in outs])
-    return HostTable(first.schema, arrays, valids)
+    schema, arrays, valids = host_concat_tables(outs)
+    return HostTable(schema, arrays, valids)
