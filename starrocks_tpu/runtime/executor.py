@@ -307,41 +307,7 @@ class Executor:
         main plan with min() placeholders + a (keys, args) side plan, joined
         on the host by group-key values."""
         agg, gcs = gc
-        new_aggs = tuple(
-            (n, AggExpr("min", a.arg) if a.fn == "group_concat" else a)
-            for n, a in agg.aggs
-        )
-        agg_a = LAggregate(agg.child, agg.group_by, new_aggs)
-
-        # rebuild the chain root->agg with hidden key passthroughs on every
-        # projection so the final output always carries the group keys
-        key_names = [n for n, _ in agg.group_by]
-
-        def rebuild(node):
-            """Returns (new_node, key_map, gc_map): key_map tracks each
-            group key's visible column name at this level (hidden
-            passthroughs are appended to every projection); gc_map tracks
-            each group_concat output's visible name through renames."""
-            if node is agg:
-                return agg_a, {k: k for k in key_names}, {n: n for n, _ in gcs}
-            child, key_map, gc_map = rebuild(node.child)
-            if isinstance(node, LProject):
-                items = list(node.exprs)
-                new_gc = {}
-                for n, e in node.exprs:
-                    if isinstance(e, Col):
-                        for g, vis in gc_map.items():
-                            if e.name == vis:
-                                new_gc[g] = n
-                new_key = {}
-                for i, k in enumerate(key_names):
-                    hid = f"__gck_{i}"
-                    items.append((hid, Col(key_map[k])))
-                    new_key[k] = hid
-                return LProject(child, tuple(items)), new_key, new_gc
-            return dataclasses.replace(node, child=child), key_map, gc_map
-
-        plan_a, _key_map, gc_vis = rebuild(plan)
+        plan_a, gc_vis = group_concat_main_plan(plan, gc)
         res = self._execute_plain(plan_a, profile)
         ht = res.table
 
@@ -817,6 +783,52 @@ def _extract_group_concat(plan: LogicalPlan):
                         "expressions (host-finalized aggregate)")
             visible = nxt
     return agg, gcs
+
+
+def group_concat_main_plan(plan, gc):
+    """Build the MAIN plan of the group_concat two-plan orchestration:
+    the aggregate re-emitted with min() placeholders in each group_concat
+    slot (min over the arg is well-typed and cheap; the host overwrites the
+    column), and hidden group-key passthroughs appended to every projection
+    above it so the final output still carries the join keys. Shared by
+    execution and EXPLAIN so the explained plan is the executed plan.
+
+    Returns (plan_a, gc_vis) where gc_vis maps each group_concat output
+    name to its visible column name at the root."""
+    agg, gcs = gc
+    new_aggs = tuple(
+        (n, AggExpr("min", a.arg) if a.fn == "group_concat" else a)
+        for n, a in agg.aggs
+    )
+    agg_a = LAggregate(agg.child, agg.group_by, new_aggs)
+    key_names = [n for n, _ in agg.group_by]
+
+    def rebuild(node):
+        """Returns (new_node, key_map, gc_map): key_map tracks each
+        group key's visible column name at this level (hidden
+        passthroughs are appended to every projection); gc_map tracks
+        each group_concat output's visible name through renames."""
+        if node is agg:
+            return agg_a, {k: k for k in key_names}, {n: n for n, _ in gcs}
+        child, key_map, gc_map = rebuild(node.child)
+        if isinstance(node, LProject):
+            items = list(node.exprs)
+            new_gc = {}
+            for n, e in node.exprs:
+                if isinstance(e, Col):
+                    for g, vis in gc_map.items():
+                        if e.name == vis:
+                            new_gc[g] = n
+            new_key = {}
+            for i, k in enumerate(key_names):
+                hid = f"__gck_{i}"
+                items.append((hid, Col(key_map[k])))
+                new_key[k] = hid
+            return LProject(child, tuple(items)), new_key, new_gc
+        return dataclasses.replace(node, child=child), key_map, gc_map
+
+    plan_a, _key_map, gc_vis = rebuild(plan)
+    return plan_a, gc_vis
 
 
 def _expr_cols_safe(e):

@@ -87,6 +87,87 @@ class Session:
                     )
                 )
             self._replay_external_defs()
+            self._restore_catalog_meta()
+
+    # journal ops before an image snapshot triggers (the FE
+    # CheckpointController's checkpoint-interval analog)
+    CHECKPOINT_OPS = 256
+
+    def checkpoint_metadata(self) -> int | None:
+        """Snapshot catalog-level metadata (views, MV definitions, users +
+        grants) into the store's image and truncate the edit log. Table
+        state is NOT in the image: manifests are authoritative for it
+        (object-store-first), so the journal's table ops compact away."""
+        if self.store is None:
+            return None
+        a = self.catalog.auth
+        auth = None
+        if a is not None:
+            auth = {
+                "users": {u: h.hex() for u, h in a.users.items()},
+                "grants": {u: {t: sorted(p) for t, p in g.items()}
+                           for u, g in a.grants.items()},
+            }
+        img = {
+            "views": dict(self.catalog.views),
+            "mv_defs": dict(self.catalog.mv_defs),
+            "auth": auth,
+        }
+        return self.store.checkpoint(img)
+
+    def _restore_catalog_meta(self):
+        """Startup: load the catalog image, then replay the journal tail's
+        catalog-level ops (image + tail = full metadata state; fe
+        persist/EditLog.java:133 loadImage + replayJournal). MVs
+        re-materialize from their definitions at the end — base tables are
+        already registered from manifests."""
+        img = self.store.read_image()
+        base = img["seq"] if img else 0
+        cat = (img or {}).get("catalog", {})
+        self.catalog.views.update(cat.get("views", {}))
+        mv_defs = dict(cat.get("mv_defs", {}))
+        auth_img = cat.get("auth")
+        if auth_img:
+            a = self.auth()
+            a.users = {u: bytes.fromhex(h)
+                       for u, h in auth_img["users"].items()}
+            a.grants = {u: {t: set(p) for t, p in g.items()}
+                        for u, g in auth_img["grants"].items()}
+        for op in self.store.replay(after_seq=base):
+            k = op["op"]
+            if k == "create_view":
+                self.catalog.views[op["name"]] = op["text"]
+            elif k == "drop_view":
+                self.catalog.views.pop(op["name"], None)
+            elif k == "create_mv":
+                mv_defs[op["name"]] = op["text"]
+            elif k == "drop_mv":
+                mv_defs.pop(op["name"], None)
+            elif k == "create_user":
+                a = self.auth()
+                a.users[op["user"]] = bytes.fromhex(op["hash"])
+                a.grants.setdefault(op["user"], {})
+            elif k == "drop_user":
+                self.auth().drop_user(op["user"])
+            elif k == "grant":
+                self.auth().grant(op["user"], op["table"], op["privs"])
+            elif k == "revoke":
+                self.auth().revoke(op["user"], op["table"], op["privs"])
+        for n, text in mv_defs.items():
+            self.catalog.mv_defs[n] = text
+            try:
+                self._refresh_mv(n)
+            except Exception:  # noqa: BLE001
+                # defining query no longer runs (e.g. base table dropped
+                # without dropping the MV): keep the definition visible and
+                # unmaterialized; queries against it fail with the real error
+                pass
+        self.store.ensure_seq()
+
+    def _log_meta(self, op: dict):
+        """Journal a catalog-level op (no-op without a persistent store)."""
+        if self.store is not None:
+            self.store.log(op)
 
     def _external_defs_path(self):
         import os
@@ -232,6 +313,15 @@ class Session:
             log.append(entry)
             if len(log) > 10_000:
                 del log[:5000]
+            # auto-checkpoint: once the journal tail outgrows the threshold,
+            # snapshot catalog metadata + truncate the log (the FE
+            # CheckpointController analog, leader/CheckpointController.java:85)
+            if (self.store is not None
+                    and (self.store.tail_count or 0) >= self.CHECKPOINT_OPS):
+                try:
+                    self.checkpoint_metadata()
+                except OSError:
+                    pass  # disk hiccup: keep serving; next statement retries
 
     def _sql_inner(self, text: str):
         stmt = parse(text)
@@ -275,8 +365,16 @@ class Session:
             nm = stmt.name.lower()
             if nm in self.catalog.views:
                 del self.catalog.views[nm]
+                self._log_meta({"op": "drop_view", "name": nm})
                 return None
-            self.catalog.mv_defs.pop(nm, None)
+            if nm in self.catalog.mv_defs:
+                self._log_meta({"op": "drop_mv", "name": nm})
+                self.catalog.mv_defs.pop(nm)
+                if self.catalog.get_table(nm) is None:
+                    # definition restored but never materialized (its
+                    # defining query stopped running, e.g. base dropped):
+                    # there is no backing table to drop
+                    return None
             from ..storage.external import ExternalTableHandle as _Ext
 
             was_external = isinstance(self.catalog.get_table(nm), _Ext)
@@ -317,8 +415,12 @@ class Session:
                 except Exception:
                     self.catalog.mv_defs.pop(name, None)
                     raise
+                self._log_meta({"op": "create_mv", "name": name,
+                                "text": stmt.select_text})
             else:
                 self.catalog.views[name] = stmt.select_text
+                self._log_meta({"op": "create_view", "name": name,
+                                "text": stmt.select_text})
             return None
         if isinstance(stmt, ast.RefreshView):
             return self._refresh_mv(stmt.name.lower())
@@ -584,15 +686,26 @@ class Session:
         a = self.auth()
         if isinstance(stmt, ast.CreateUser):
             a.create_user(stmt.user, stmt.password)
+            # journal the stage2 hash, never the password (the mysql
+            # protocol only needs sha1(sha1(pw)) to authenticate)
+            self._log_meta({"op": "create_user", "user": stmt.user,
+                            "hash": a.users[stmt.user].hex()})
             return None
         if isinstance(stmt, ast.DropUser):
             a.drop_user(stmt.user)
+            self._log_meta({"op": "drop_user", "user": stmt.user})
             return None
         if isinstance(stmt, ast.Grant):
             a.grant(stmt.user, stmt.table, stmt.privs)
+            self._log_meta({"op": "grant", "user": stmt.user,
+                            "table": stmt.table,
+                            "privs": sorted(stmt.privs)})
             return None
         if isinstance(stmt, ast.Revoke):
             a.revoke(stmt.user, stmt.table, stmt.privs)
+            self._log_meta({"op": "revoke", "user": stmt.user,
+                            "table": stmt.table,
+                            "privs": sorted(stmt.privs)})
             return None
         user = stmt.user or self.current_user
         if user != self.current_user and not a.is_admin(self.current_user):
@@ -628,8 +741,21 @@ class Session:
             return plan_tree_str(res.plan) + "\n" + res.profile.render()
         plan = Analyzer(self.catalog).analyze(stmt.stmt)
         self._check_select_privs(plan)  # EXPLAIN leaks schema/stats otherwise
+        # mirror the executor's group_concat two-plan orchestration: EXPLAIN
+        # must show the plan that would actually run (and never raise on
+        # executable SQL — the raw plan's DISTINCT rewrite can refuse
+        # group_concat ORDER BY extras that the orchestration handles)
+        from .executor import _extract_group_concat, group_concat_main_plan
+
+        header = ""
+        gc = _extract_group_concat(plan)
+        if gc is not None:
+            plan, _ = group_concat_main_plan(plan, gc)
+            header = ("-- group_concat: two-plan orchestration (main plan "
+                      "below; per-group concatenation host-finalized from a "
+                      "(keys, arg) side plan)\n")
         plan = optimize(plan, self.catalog)
-        return plan_tree_str(plan)
+        return header + plan_tree_str(plan)
 
     def _delete(self, stmt: ast.Delete):
         """DELETE FROM t [WHERE pred]: keep rows where pred is FALSE or NULL,

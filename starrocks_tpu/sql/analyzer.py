@@ -130,7 +130,10 @@ class Analyzer:
             # left-associative n-ary chain: fold pairwise
             plan = aligned[0]
             for rhs in aligned[1:]:
-                plan = self._setop_filtered([plan, rhs], names, so.kind)
+                if so.all:
+                    plan = self._setop_all([plan, rhs], names, so.kind)
+                else:
+                    plan = self._setop_filtered([plan, rhs], names, so.kind)
         else:
             plan = LUnion(tuple(aligned))
             if not so.all:
@@ -179,6 +182,35 @@ class Analyzer:
             pred = Call("and", Call("gt", Col(cl_c), Lit(0)),
                         Call("eq", Col(cr_c), Lit(0)))
         filt = LFilter(agg, pred)
+        return LProject(filt, tuple((n, Col(n)) for n in names))
+
+    def _setop_all(self, aligned, names, kind):
+        """INTERSECT ALL / EXCEPT ALL via window-counted multiplicity
+        (reference: be/src/exec/intersect_node.h's hash-counting semantics):
+        union both sides tagged 0/1, then over PARTITION BY all columns
+        (NULLs group together — window partitioning, not a join, so set-op
+        NULL semantics hold) compute cr = whole-partition count of right
+        rows and rn = row_number ordered by side (left rows get 1..cl).
+        Keep left rows with rn <= cr (INTERSECT ALL -> min(cl, cr) copies)
+        or rn > cr (EXCEPT ALL -> max(cl - cr, 0) copies)."""
+        uid = next(self._ids)
+        side_c, rn_c, cr_c = f"__side_{uid}", f"__rn_{uid}", f"__cr_{uid}"
+        tagged = []
+        for side, p in enumerate(aligned):
+            tagged.append(LProject(
+                p,
+                tuple((n, Col(n)) for n in names) + ((side_c, Lit(side)),),
+            ))
+        u = LUnion(tuple(tagged))
+        part = tuple(Col(n) for n in names)
+        w = LWindow(u, part, (),
+                    ((cr_c, "sum", Col(side_c), None, None, None),))
+        w = LWindow(w, part, ((Col(side_c), True, False),),
+                    ((rn_c, "row_number", None, None, None, None),))
+        cmp = "le" if kind == "intersect" else "gt"
+        pred = Call("and", Call("eq", Col(side_c), Lit(0)),
+                    Call(cmp, Col(rn_c), Col(cr_c)))
+        filt = LFilter(w, pred)
         return LProject(filt, tuple((n, Col(n)) for n in names))
 
     def _lower_order_expr_union(self, o, names):

@@ -334,21 +334,14 @@ class Parser:
         last_paren = first_paren
         while self.at_kw("union", "intersect", "except"):
             kinds.append(self.next().value)
-            if kinds[-1] == "union":
-                all_flags.append(self.accept_kw("all"))
-            else:
-                if self.accept_kw("all"):
-                    raise ParseError(
-                        f"{kinds[-1].upper()} ALL is unsupported (bag "
-                        "semantics); use plain " + kinds[-1].upper()
-                    )
-                all_flags.append(False)
+            all_flags.append(self.accept_kw("all"))
             s, last_paren = self._parse_set_operand()
             selects.append(s)
         if len(set(kinds)) > 1:
             raise ParseError("mixing UNION/INTERSECT/EXCEPT is unsupported")
-        if kinds[0] == "union" and len(set(all_flags)) > 1:
-            raise ParseError("mixing UNION and UNION ALL is unsupported")
+        if len(set(all_flags)) > 1:
+            k = kinds[0].upper()
+            raise ParseError(f"mixing {k} and {k} ALL is unsupported")
         if last_paren:
             # parenthesized last operand keeps its own clauses; outer
             # ORDER BY / LIMIT may follow the chain

@@ -68,22 +68,97 @@ class TabletStore:
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.log_path = os.path.join(root, "edit_log.jsonl")
+        self.image_path = os.path.join(root, "image.json")
         self._pk_index: dict = {}  # table -> {pk tuple: (rowset, file, pos)}
+        self._next_seq = None  # lazily scanned (image seq + log tail)
+        self.tail_count = None  # ops past the image (auto-checkpoint trigger)
 
-    # --- edit log ------------------------------------------------------------
-    def log(self, op: dict):
+    # --- edit log + image checkpoint -----------------------------------------
+    # The journal is the FE EditLog/image pair (fe persist/EditLog.java:133 +
+    # leader/CheckpointController.java:85): every op carries a monotone seq;
+    # checkpoint() snapshots catalog-level metadata into image.json and
+    # truncates the log to the ops after the image, so startup replays
+    # image + tail instead of the whole history.
+    def _scan_seq(self) -> int:
+        img = self.read_image()
+        base = img["seq"] if img else 0
+        seq = base
+        n_tail = 0
+        for op in self.replay():
+            seq = max(seq, op.get("seq", seq + 1))
+            if op.get("seq", 0) > base:
+                n_tail += 1
+        self.tail_count = n_tail
+        return seq
+
+    def ensure_seq(self):
+        """Force the lazy journal scan (startup paths want tail_count)."""
+        if self._next_seq is None:
+            self._next_seq = self._scan_seq()
+
+    def log(self, op: dict) -> int:
+        if self._next_seq is None:
+            self._next_seq = self._scan_seq()
+        self.tail_count = (self.tail_count or 0) + 1
+        self._next_seq += 1
+        op = {"seq": self._next_seq, **op}
         with open(self.log_path, "a") as f:
             f.write(json.dumps(op) + "\n")
+        return self._next_seq
 
-    def replay(self):
-        """Yield logged ops in order (catalog rebuild)."""
+    def replay(self, after_seq: int = -1):
+        """Yield logged ops in order (catalog rebuild). Ops without an
+        explicit seq (pre-image logs) get their 1-based line number."""
         if not os.path.exists(self.log_path):
             return
         with open(self.log_path) as f:
-            for line in f:
+            for i, line in enumerate(f, 1):
                 line = line.strip()
                 if line:
-                    yield json.loads(line)
+                    op = json.loads(line)
+                    op.setdefault("seq", i)
+                    if op["seq"] > after_seq:
+                        yield op
+
+    def read_image(self):
+        """The newest catalog image, or None (never checkpointed)."""
+        if not os.path.exists(self.image_path):
+            return None
+        try:
+            with open(self.image_path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None  # torn image: fall back to full log replay
+
+    def checkpoint(self, catalog_image: dict) -> int:
+        """Write the catalog image at the current journal position and
+        truncate the log. Image first (fsync'd tmp + atomic replace: the
+        truncation destroys the image's redundant copy, so the image must
+        be durable before the log shrinks), then the log — a crash between
+        the two leaves covered ops in the log, and replay of an
+        already-applied catalog op is idempotent."""
+        if self._next_seq is None:
+            self._next_seq = self._scan_seq()
+        seq = self._next_seq
+        tmp = self.image_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"seq": seq, "catalog": catalog_image}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.image_path)
+        dfd = os.open(self.root, os.O_RDONLY)
+        try:
+            os.fsync(dfd)  # the rename itself must survive power loss
+        finally:
+            os.close(dfd)
+        keep = [op for op in self.replay(after_seq=seq)]
+        tmp = self.log_path + ".tmp"
+        with open(tmp, "w") as f:
+            for op in keep:
+                f.write(json.dumps(op) + "\n")
+        os.replace(tmp, self.log_path)
+        self.tail_count = len(keep)
+        return seq
 
     # --- table lifecycle ------------------------------------------------------
     def _tdir(self, name: str) -> str:

@@ -190,6 +190,50 @@ def test_intersect_except_null_semantics():
         (1, "p")]
 
 
+def test_intersect_except_all_multiplicity():
+    # bag semantics: INTERSECT ALL keeps min(cl, cr) copies, EXCEPT ALL
+    # keeps max(cl - cr, 0); NULLs compare equal (window-partition rewrite,
+    # reference: be/src/exec/intersect_node.h hash-counting semantics)
+    s = Session()
+    s.sql("create table ba (x int, s varchar)")
+    s.sql("create table bb (x int, s varchar)")
+    s.sql("insert into ba values (1,'a'),(1,'a'),(1,'a'),(2,'b'),"
+          "(3,null),(3,null),(null,null)")
+    s.sql("insert into bb values (1,'a'),(1,'a'),(3,null),(null,null),"
+          "(null,null),(9,'z')")
+    assert s.sql(
+        "select x, s from ba intersect all select x, s from bb "
+        "order by x nulls last, s"
+    ).rows() == [(1, "a"), (1, "a"), (3, None), (None, None)]
+    assert s.sql(
+        "select x, s from ba except all select x, s from bb "
+        "order by x nulls last, s"
+    ).rows() == [(1, "a"), (2, "b"), (3, None)]
+    # n-ary chain folds left-associatively
+    assert s.sql(
+        "select x, s from ba intersect all select x, s from bb "
+        "intersect all select x, s from ba order by x nulls last, s"
+    ).rows() == [(1, "a"), (1, "a"), (3, None), (None, None)]
+    with pytest.raises(Exception, match="mixing"):
+        s.sql("select x, s from ba intersect all select x, s from bb "
+              "intersect select x, s from ba")
+
+
+def test_explain_group_concat_distinct_order_by():
+    # EXPLAIN must never raise on executable SQL: the group_concat two-plan
+    # orchestration is mirrored into EXPLAIN (regression: the DISTINCT
+    # rewrite refused ORDER BY extras and EXPLAIN crashed)
+    s = Session()
+    s.sql("create table gct (g int, v varchar)")
+    s.sql("insert into gct values (1,'b'),(1,'a'),(1,'a'),(2,'c')")
+    q = "select g, group_concat(distinct v order by v) gc from gct group by g"
+    txt = s.sql("explain " + q)
+    assert "group_concat" in txt and "Agg" in txt
+    assert s.sql(q + " order by g").rows() == [(1, "a,b"), (2, "c")]
+    txt2 = s.sql("explain analyze " + q)
+    assert "Agg" in txt2
+
+
 def test_views_and_materialized_views():
     s = Session()
     s.sql("create table vb (g varchar, v int)")
