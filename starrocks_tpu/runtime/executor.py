@@ -452,10 +452,26 @@ class Executor:
                         "correlated scalar subquery not rewritten by optimizer"
                     )
                 sub = self.execute_logical(e.plan)
-                rows = sub.table.to_pylist()
+                ht = sub.table
+                rows = ht.to_pylist()
                 if len(rows) > 1 or (rows and len(rows[0]) != 1):
                     raise ExecError("scalar subquery returned more than one value")
                 val = rows[0][0] if rows else None
+                f = ht.schema.fields[0]
+                # DECIMAL128 results still round-trip through float (their
+                # raw form is 4x32 limbs; reconstructing the exact value
+                # here isn't worth it for a 38-digit scalar compare)
+                if val is not None and f.type.is_decimal:
+                    # embed the EXACT scaled value with its decimal type:
+                    # round-tripping through the python float (to_pylist)
+                    # and comparing it against the decimal column as DOUBLE
+                    # misses by an ULP (TPC-H Q15's total_revenue = (select
+                    # max(total_revenue)...) returned empty at SF1)
+                    import decimal
+
+                    raw = int(np.asarray(ht.arrays[f.name])[0])
+                    return Lit(decimal.Decimal(raw).scaleb(-f.type.scale),
+                               f.type)
                 return Lit(val)
             if isinstance(e, Call):
                 return Call(e.fn, *[fix_expr(a) for a in e.args])

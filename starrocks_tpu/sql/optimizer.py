@@ -27,7 +27,7 @@ from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit
 from .analyzer import ScalarSubquery, SemiJoinMark, _conjuncts
 from .logical import (
     LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion,
-    LUnnest, LWindow, LogicalPlan,
+    LUnnest, LWindow, LogicalPlan, walk_plan,
 )
 
 
@@ -681,7 +681,29 @@ def _apply_marker(outer_plan: LogicalPlan, conjunct: Expr, catalog) -> LogicalPl
             return LProject(filtered, keep)
         raise NotImplementedError(f"unsupported subquery pattern: {conjunct!r}")
     if not marker.correlated:
-        # uncorrelated scalar: leave in place; the executor evaluates it first
+        sub = rewrite_full_joins(marker.plan)
+        sub = rewrite_subqueries(sub, catalog)
+        # Single-program inline for guaranteed-one-row subqueries (a global
+        # aggregate never returns 0 or 2+ rows): CROSS-join the one-row
+        # result and substitute its column for the marker. One compiled
+        # program instead of a separate host-resolved execution — and a CTE
+        # shared between the subquery and the outer side (TPC-H Q15's
+        # revenue0) emits ONCE via the emitter's by-value memo, which also
+        # makes float equality against the re-computed aggregate exact.
+        # Other shapes keep the host-resolved path (0-row -> NULL and
+        # >1-row errors need runtime checks).
+        if (isinstance(sub, LProject) and isinstance(sub.child, LAggregate)
+                and not sub.child.group_by and len(sub.exprs) == 1):
+            sub = rewrite_distinct_aggs(sub)
+            val = LProject(sub, (("subq_val", Col(sub.output_names()[0])),))
+            joined = LJoin(outer_plan, val, "cross", None)
+            new_pred = _replace_scalar_marker(conjunct, marker,
+                                              Col("subq_val"))
+            filtered = LFilter(joined, new_pred)
+            keep = tuple((n, Col(n)) for n in outer_plan.output_names())
+            return LProject(filtered, keep)
+        # uncorrelated non-aggregate scalar: leave in place; the executor
+        # evaluates it first
         return LFilter(outer_plan, conjunct)
 
     # NOTE: no distinct-agg rewrite here — the pattern match below needs the
@@ -702,8 +724,43 @@ def _apply_marker(outer_plan: LogicalPlan, conjunct: Expr, catalog) -> LogicalPl
     agg = sub.child
     inner_cols = tuple(ic for _, ic in marker.correlated)
     outer_cols = tuple(oc for oc, _ in marker.correlated)
+    agg_input = agg.child
+    # Magic-set reduction (reference analog: the CBO's runtime-filter
+    # pushdown across exchanges, be/src/exec/pipeline RF; here a
+    # compile-time plan rewrite): the LEFT join below only consumes groups
+    # whose correlation keys exist on the outer side, so when the outer
+    # side is much smaller than the subquery input, SEMI-join the input
+    # down to the outer key set BEFORE aggregating (TPC-H Q2/Q17/Q20: the
+    # min/avg/sum runs over a few thousand surviving keys instead of the
+    # whole fact table). The duplicated outer subtree costs ~nothing: the
+    # physical emitter memoizes node emission by value. Safe because
+    # semi-dropped groups could never join (their keys are absent on the
+    # outer side) and NULL keys never satisfy the eq join condition.
+    inner_aliases = {n.split(".", 1)[0] for n in agg_input.output_names()}
+    outer_aliases = {oc.split(".", 1)[0] for oc in outer_cols}
+    if not (outer_aliases & inner_aliases):
+        outer_rows = estimate_rows(outer_plan, catalog)
+        # the agg's cost scales with its input CAPACITY — under static
+        # shapes that is the largest base table in the subtree, not the
+        # (unreliable pre-join-ordering) join-size estimate
+        inner_mass = max(
+            (float(catalog.get_table(n.table).row_count)
+             for n in walk_plan(agg_input)
+             if isinstance(n, LScan) and catalog.get_table(n.table)),
+            default=0.0,
+        )
+        if inner_mass > 50_000 and outer_rows < 0.1 * inner_mass:
+            seen = set()
+            uniq = tuple(oc for oc in outer_cols
+                         if not (oc in seen or seen.add(oc)))
+            keys = LProject(outer_plan, tuple((oc, Col(oc)) for oc in uniq))
+            semi_cond = and_all(
+                Call("eq", Col(ic), Col(oc))
+                for ic, oc in zip(inner_cols, outer_cols)
+            )
+            agg_input = LJoin(agg_input, keys, "semi", semi_cond)
     group_by = tuple((f"corr_{i}", Col(ic)) for i, ic in enumerate(inner_cols))
-    grouped = rewrite_distinct_aggs(LAggregate(agg.child, group_by, agg.aggs))
+    grouped = rewrite_distinct_aggs(LAggregate(agg_input, group_by, agg.aggs))
     val_name = "subq_val"
     proj = LProject(
         grouped,
@@ -1336,8 +1393,41 @@ def _greedy_order(rels, conjuncts, catalog) -> LogicalPlan:
 
 
 def prune_columns(plan: LogicalPlan, required: frozenset | None = None) -> LogicalPlan:
+    """Column pruning. Duplicated subtrees (CTE expansions, magic-set /
+    scalar-inline copies) must prune IDENTICALLY — the physical emitter
+    memoizes emission by node value, so two occurrences pruned to different
+    column sets would compute twice. Top-level entry therefore records the
+    union of requirements per duplicated subtree first, then prunes every
+    occurrence with that union (requirement propagation distributes over
+    unions, so descendants stay consistent)."""
     if required is None:
         required = frozenset(plan.output_names())
+        from collections import Counter
+
+        counts = Counter(
+            node for node in walk_plan(plan)
+            if isinstance(node, (LJoin, LAggregate, LWindow, LUnnest))
+        )
+        dups = frozenset(p for p, c in counts.items() if c >= 2)
+        if dups:
+            reqs: dict = {}
+            _prune(plan, required, dups, reqs, record=True)
+            return _prune(plan, required, dups,
+                          {k: frozenset(v) for k, v in reqs.items()},
+                          record=False)
+    return _prune(plan, required, frozenset(), {}, record=False)
+
+
+def _prune(plan: LogicalPlan, required: frozenset, dups, reqs, record: bool
+           ) -> LogicalPlan:
+    def prune_columns(child, req):  # shadow: thread the shared-prune state
+        return _prune(child, req, dups, reqs, record)
+
+    if plan in dups:
+        if record:
+            reqs.setdefault(plan, set()).update(required)
+        else:
+            required = reqs[plan]
 
     if isinstance(plan, LScan):
         keep = tuple(

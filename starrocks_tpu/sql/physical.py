@@ -91,11 +91,34 @@ def unique_sets(plan: LogicalPlan, catalog) -> set:
     if isinstance(plan, LJoin):
         if plan.kind in ("semi", "anti"):
             return unique_sets(plan.left, catalog)
+        if plan.kind in ("inner", "left") and plan.condition is not None:
+            # joining AGAINST a side that is unique on its join keys never
+            # duplicates the other side's rows (FK -> PK lookup), so the
+            # other side's unique sets survive — e.g. orders stays unique
+            # on o_orderkey through the customer join, letting the next
+            # join upstream keep the 1:1 gather path (TPC-H Q18).
+            # Residual conjuncts only remove rows, which preserves
+            # uniqueness.
+            probe_keys, build_keys, _ = join_equi_keys(plan)
+            lsets = unique_sets(plan.left, catalog)
+            rsets = unique_sets(plan.right, catalog)
+            out = set()
+            if probe_keys and all(isinstance(k, Col) for k in build_keys):
+                ks = frozenset(k.name for k in build_keys)
+                if any(u <= ks for u in rsets):
+                    out |= lsets
+            if probe_keys and all(isinstance(k, Col) for k in probe_keys):
+                ks = frozenset(k.name for k in probe_keys)
+                if any(u <= ks for u in lsets):
+                    out |= rsets
+            return out
         return set()
     return set()
 
 
-DENSE_RF_MAX_RANGE = 1 << 22  # dense presence bitmaps up to 4M slots
+DENSE_RF_MAX_RANGE = 1 << 23  # dense presence bitmaps up to 8M slots
+# (covers l_orderkey's 6M domain at SF1: TPC-H Q18's orders-semi-subquery
+# presence test rides one scatter + one gather instead of a 1.5M-row sort)
 LUT_JOIN_MAX_RANGE = 1 << 24  # dense row-lookup tables up to 16M slots
 
 
@@ -360,17 +383,20 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
             idx = aux_index.get(desc)
             return idx
 
-        def maybe_compact(child_plan, c, tag: str):
+        def maybe_compact(child_plan, c, tag: str, est: float | None = None):
             """Shrink a sparse chunk before a sort-heavy op: selective
             filters/joins leave most capacity dead, and sort/agg/window cost
             scales with CAPACITY, not live rows. Seeded from the cardinality
-            estimate; the overflow check recompiles on underestimates (same
-            contract as every other capacity)."""
+            estimate (callers override `est` when they know better, e.g. a
+            probe side just masked by an exact runtime filter); the overflow
+            check recompiles on underestimates (same contract as every other
+            capacity)."""
             if c.capacity < 8192:
                 return c
             from ..ops.common import compact
 
-            est = estimate_rows(child_plan, catalog)
+            if est is None:
+                est = estimate_rows(child_plan, catalog)
             default = pad_capacity(int(est * 1.5) + 1024)
             if default >= c.capacity:
                 return c
@@ -415,6 +441,18 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                 from ..runtime.config import config as _acfg
 
                 dom = bounded_domain(c0, p.group_by)
+                if dom is not None and p.group_by:
+                    # the dense path's accumulators (and the agg's OUTPUT
+                    # capacity, which downstream sorts/joins inherit) are
+                    # domain-sized — a pessimization when the input shrank
+                    # far below the domain (e.g. a magic-set-reduced
+                    # correlated subquery aggregating ~1k surviving rows
+                    # against a 200k key domain). Generous 32x slack: only
+                    # clearly-pathological dense choices fall back to the
+                    # compacted lexsort path.
+                    est = estimate_rows(p.child, catalog)
+                    if dom > 32 * max(est, 1024.0):
+                        dom = None
                 if dom is not None and dom <= _dense_agg_domain_max(_acfg):
                     # dense bounded domain: capacity covers it outright, the
                     # sort-free packed-gid path applies at any cardinality
@@ -487,6 +525,18 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
             # runtime filter (the LUT is already an exact membership test)
             from ..ops.join import hash_join_lut
 
+            if unique and p.kind == "inner" and lc.capacity >= (1 << 20):
+                # selective inner join over a BIG probe: the 1:1 gather/LUT
+                # joins materialize every payload column at probe capacity,
+                # while the expansion join emits a compacted output sized by
+                # the estimate (TPC-H Q10: 6M lineitem probe against a
+                # 57k-row build — expansion's 146k output beats 6M-wide
+                # gathers). 24x bar: only clearly-selective joins downgrade
+                # (borderline ratios like TPC-H Q5's 1.2M-of-6M keep the
+                # gather — expansion's cumsum + ladder loses there).
+                if estimate_rows(p, catalog) * 24 < lc.capacity:
+                    unique = False
+
             lut_range = None
             if (unique and len(probe_keys) == 1
                     and p.kind in ("inner", "left", "semi", "anti")
@@ -524,6 +574,7 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
             # LEFT OUTER/ANTI must keep non-matching probe rows)
             from ..ops.join import runtime_filter_mask
 
+            exact_rf = False
             if p.kind in ("inner", "semi", "cross") and probe_keys and not (
                 len(probe_keys) == 1 and isinstance(probe_keys[0], Lit)
             ) and _cfg.get("enable_runtime_filters"):
@@ -533,8 +584,23 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                                         tuple(build_keys), bit_widths,
                                         dense_range=dr)
                 )
+                # only the dense bitmap is an EXACT membership test; the
+                # min/max fallback may keep every probe row, so compacting
+                # to the join estimate after it would guarantee an
+                # overflow recompile on wide build key ranges
+                exact_rf = dr is not None
 
-            lc = maybe_compact(p.left, lc, f"{ordinal(p)}l")
+            # a runtime-filtered probe holds ~join-output-many live rows,
+            # not plan-estimate-many: compact it to the JOIN estimate so the
+            # expansion machinery (search ladder, cumsum) runs at matched
+            # size instead of raw probe capacity (TPC-H Q18: 6M lineitem
+            # probe vs a 57-order build). Overflow checks recover if the
+            # estimate lied.
+            est_l = None
+            if exact_rf and p.kind == "inner":
+                est_l = min(estimate_rows(p.left, catalog),
+                            estimate_rows(p, catalog))
+            lc = maybe_compact(p.left, lc, f"{ordinal(p)}l", est=est_l)
             # the sorted join paths argsort the BUILD side at full capacity —
             # compact it first when it is sparse (filtered dimension chains)
             rc = maybe_compact(p.right, rc, f"{ordinal(p)}r")
