@@ -87,7 +87,7 @@ config = ConfigRegistry()
 # --- engine options (the session-variable / config.h analog subset) ----------
 config.define("chunk_align", 1024, False, "row-capacity alignment for device chunks")
 config.define("default_agg_groups", 1024, True, "initial group capacity before adaptive recompile")
-config.define("max_recompiles", 6, True, "adaptive capacity recompile limit per query")
+config.define("max_recompiles", 10, True, "adaptive capacity recompile limit per query")
 config.define("join_expand_headroom", 1.2, True, "growth factor applied on capacity overflow")
 config.define("enable_zonemap_pruning", True, True, "prune parquet rowsets by zonemap stats")
 config.define("compaction_trigger_rowsets", 8, True,

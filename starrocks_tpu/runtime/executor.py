@@ -545,23 +545,44 @@ class Executor:
         max_recompiles = config.get("max_recompiles")
         headroom = config.get("join_expand_headroom")
         fail_point("executor::before_run")
+        prev_counts: dict = {}  # last attempt's observed true counts
         for attempt in range(max_recompiles):
             p = profile.child(f"attempt_{attempt}")
             with p.timer("compile_and_run"):
                 out, keyed_checks = attempt_fn(caps, p)
             p.set_info("capacities", dict(caps.values))
+            floors = {k[len("~floor_"):]: int(v) for k, v in keyed_checks
+                      if k.startswith("~floor_")}
+            keyed_checks = [(k, v) for k, v in keyed_checks
+                            if not k.startswith("~floor_")]
             overflow = False
             for key, v in keyed_checks:
                 if v > caps.values.get(key, -1):
-                    new_cap = pad_capacity(int(v * headroom) + 1)
-                    if new_cap >= (1 << 31):
+                    # deep plans reveal capacities one stage per attempt:
+                    # an upstream fix uncovers the next stage's true count,
+                    # which was truncated until then. Extrapolate each
+                    # key's observed GROWTH RATE between attempts so a
+                    # cascade converges in a couple of recompiles with
+                    # near-true final caps (TPC-DS Q67's ROLLUP chain
+                    # needed one recompile per stage otherwise)
+                    pv = prev_counts.get(key)
+                    # clamp: a truncated early observation can make the
+                    # ratio enormous; 8x per recompile still converges a
+                    # deep cascade in a couple of attempts without
+                    # tripping the hard cap on plans that fit fine
+                    rate = min(max(1.0, v / pv), 8.0) if pv else 1.0
+                    base_cap = pad_capacity(int(v * headroom) + 1)
+                    if base_cap >= (1 << 31):
                         raise ExecError(
                             f"operator {key} needs capacity {v} rows — the "
                             "plan is likely missing a join predicate "
                             "(cartesian blowup)"
                         )
+                    new_cap = min(pad_capacity(int(v * headroom * rate) + 1),
+                                  1 << 30)
                     caps.values[key] = new_cap
                     overflow = True
+            prev_counts.update(keyed_checks)
             if not overflow:
                 profile.add_counter("recompiles", attempt)
                 # tighten grossly over-seeded capacities for the NEXT run
@@ -570,13 +591,14 @@ class Executor:
                 # capacity and then reuses that program. Overflow checks
                 # keep correctness if the data grows back.
                 for key, v in keyed_checks:
-                    if key.startswith("agg_"):
-                        # agg capacities may be dense-domain seeds (capacity
-                        # = key domain so the sort-free path applies);
-                        # tightening to the true group count would knock the
-                        # plan back onto the lexsort path
+                    if key.startswith("agg_") and key not in floors:
+                        # agg capacities without dense-floor metadata (the
+                        # distributed compiler doesn't report it) may be
+                        # dense-domain seeds; tightening to the true group
+                        # count would knock the plan onto the lexsort path
                         continue
-                    tight = pad_capacity(int(v * headroom) + 1)
+                    tight = max(pad_capacity(int(v * headroom) + 1),
+                                floors.get(key, 0))
                     if tight * 2 <= caps.values.get(key, 0):
                         caps.values[key] = tight
                 return out

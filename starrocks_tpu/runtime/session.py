@@ -69,6 +69,7 @@ class Session:
         self.last_profile = None  # most recent query's RuntimeProfile
         self.store = None
         self.current_user = "root"  # front doors set this per connection
+        self.resource_group = None  # SET resource_group = '...'
         self.dist_shards = dist_shards
         self._dist_executor = None
         if data_dir is not None:
@@ -108,10 +109,14 @@ class Session:
                 "grants": {u: {t: sorted(p) for t, p in g.items()}
                            for u, g in a.grants.items()},
             }
+        wm = getattr(self.catalog, "workgroups", None)
         img = {
             "views": dict(self.catalog.views),
             "mv_defs": dict(self.catalog.mv_defs),
             "auth": auth,
+            "resource_groups": (
+                {n: g.to_props() for n, g in wm.groups.items()}
+                if wm is not None else {}),
         }
         return self.store.checkpoint(img)
 
@@ -133,9 +138,18 @@ class Session:
                        for u, h in auth_img["users"].items()}
             a.grants = {u: {t: set(p) for t, p in g.items()}
                         for u, g in auth_img["grants"].items()}
+        for name, props in cat.get("resource_groups", {}).items():
+            from .workgroup import ResourceGroup
+
+            self.workgroups().groups[name] = ResourceGroup.from_props(props)
         for op in self.store.replay(after_seq=base):
             k = op["op"]
-            if k == "create_view":
+            if k == "create_rg":
+                self.workgroups().create(op["name"], op["props"],
+                                         replace=True)
+            elif k == "drop_rg":
+                self.workgroups().drop(op["name"], if_exists=True)
+            elif k == "create_view":
                 self.catalog.views[op["name"]] = op["text"]
             elif k == "drop_view":
                 self.catalog.views.pop(op["name"], None)
@@ -396,8 +410,26 @@ class Session:
         if isinstance(stmt, ast.SetVar):
             from .config import config
 
+            if stmt.name.lower() == "resource_group":
+                name = str(stmt.value or "").lower()
+                if name and self.workgroups().get(name) is None:
+                    raise ValueError(f"unknown resource group {name!r}")
+                self.resource_group = name or None
+                return None
             config.set(stmt.name, stmt.value)
             return None
+        if isinstance(stmt, ast.CreateResourceGroup):
+            self.workgroups().create(stmt.name, dict(stmt.props),
+                                     replace=stmt.replace)
+            self._log_meta({"op": "create_rg", "name": stmt.name.lower(),
+                            "props": dict(stmt.props)})
+            return None
+        if isinstance(stmt, ast.DropResourceGroup):
+            self.workgroups().drop(stmt.name, stmt.if_exists)
+            self._log_meta({"op": "drop_rg", "name": stmt.name.lower()})
+            return None
+        if isinstance(stmt, ast.ShowResourceGroups):
+            return self.workgroups().snapshot()
         if isinstance(stmt, ast.CreateView):
             name = stmt.name.lower()
             if (
@@ -623,6 +655,15 @@ class Session:
             self.catalog.auth = AuthManager()
         return self.catalog.auth
 
+    def workgroups(self):
+        """The catalog-wide admission manager (sessions sharing a catalog
+        share slots — the process is the BE; runtime/workgroup.py)."""
+        from .workgroup import WorkgroupManager
+
+        if getattr(self.catalog, "workgroups", None) is None:
+            self.catalog.workgroups = WorkgroupManager()
+        return self.catalog.workgroups
+
     def _enforce_privileges(self, stmt):
         """Statement-level checks (reference: authorization/Authorizer.java
         checks in StmtExecutor). SELECT privileges are checked per base
@@ -642,7 +683,9 @@ class Session:
                                ast.CreateUser, ast.DropUser, ast.Grant,
                                ast.Revoke, ast.AlterTable,
                                ast.CreateFunction, ast.DropFunction,
-                               ast.CreateExternalTable)):
+                               ast.CreateExternalTable,
+                               ast.CreateResourceGroup,
+                               ast.DropResourceGroup)):
             raise PermissionError(
                 f"user {user!r} lacks the admin privileges for DDL")
 
@@ -719,6 +762,31 @@ class Session:
         with profile.timer("analyze"):
             plan = Analyzer(self.catalog).analyze(sel)
         self._check_select_privs(plan)
+        release = self._admit(plan)
+        try:
+            return self._query_admitted(plan, profile)
+        finally:
+            release()
+
+    def _admit(self, plan):
+        """Resource-group admission (runtime/workgroup.py): estimate the
+        query's scan mass from the catalog and pass the gate. Queries
+        without a SET resource_group run unthrottled (default group)."""
+        if self.resource_group is None:
+            return lambda: None
+        from ..sql.logical import LScan, walk_plan
+
+        est_rows = est_bytes = 0
+        for node in walk_plan(plan):
+            if isinstance(node, LScan) and not node.table.startswith("__"):
+                h = self.catalog.get_table(node.table)
+                if h is not None:
+                    est_rows += h.row_count
+                    est_bytes += h.row_count * 8 * max(len(node.columns), 1)
+        return self.workgroups().admit(self.resource_group, est_rows,
+                                       est_bytes)
+
+    def _query_admitted(self, plan, profile) -> QueryResult:
         if self.dist_shards:
             from .dist_executor import DistExecutor
 

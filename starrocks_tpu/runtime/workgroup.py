@@ -1,0 +1,171 @@
+"""Resource groups + admission control.
+
+Reference behavior: BE workgroups (be/src/compute_env/workgroup/
+work_group.h:145 — per-group CPU weight / memory limit / big-query limits)
+and the FE's query-queue slot manager
+(fe-core/.../qe/scheduler/slot/SlotManager.java: queries wait for a slot,
+time out, or are rejected). Re-designed for the single-process TPU engine:
+
+- a ResourceGroup carries declarative limits (concurrency slots, big-query
+  scan-row cap, estimated-scan-memory cap, advisory cpu_weight);
+- the WorkgroupManager is the admission gate every Session passes through
+  before executing a query: big-query limits reject immediately
+  (the reference's big_query_scan_rows_limit kill), slot exhaustion QUEUES
+  the query on a condition variable until a slot frees or the queue
+  timeout expires (SlotManager's pending queue);
+- groups live on the catalog (shared by every session of this process —
+  the process is the BE) and persist through the metadata image/journal.
+
+cpu_weight is recorded but advisory: one process, one device — there is no
+second scheduler underneath to weight. The enforced isolation axes are
+admission (slots) and the big-query caps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from .config import config
+
+config.define("query_queue_timeout_s", 10.0, True,
+              "seconds a query waits for a resource-group slot before "
+              "failing admission (the FE slot-queue timeout analog)")
+
+
+class AdmissionError(RuntimeError):
+    """Query rejected or timed out by resource-group admission control."""
+
+
+@dataclasses.dataclass
+class ResourceGroup:
+    name: str
+    concurrency_limit: int = 0      # 0 = unlimited slots
+    max_scan_rows: int = 0          # 0 = no big-query row cap
+    mem_limit_bytes: int = 0        # 0 = no estimated-scan-memory cap
+    cpu_weight: int = 0             # advisory (recorded, surfaced in SHOW)
+
+    def to_props(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_props(cls, props: dict) -> "ResourceGroup":
+        return cls(**{k: props[k] for k in (
+            "name", "concurrency_limit", "max_scan_rows", "mem_limit_bytes",
+            "cpu_weight") if k in props})
+
+
+_ALLOWED_PROPS = {"concurrency_limit", "max_scan_rows", "mem_limit_bytes",
+                  "cpu_weight"}
+
+
+class WorkgroupManager:
+    """Process-wide admission gate (one per catalog = one per 'BE')."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self.groups: dict[str, ResourceGroup] = {}
+        self.running: dict[str, int] = {}
+        self.queued: dict[str, int] = {}
+        self.rejected_total = 0
+        self.timeout_total = 0
+
+    # --- DDL -----------------------------------------------------------------
+    def create(self, name: str, props: dict, replace: bool = False):
+        name = name.lower()
+        bad = set(props) - _ALLOWED_PROPS
+        if bad:
+            raise ValueError(
+                f"unknown resource group properties {sorted(bad)}; "
+                f"allowed: {sorted(_ALLOWED_PROPS)}")
+        with self._lock:
+            if name in self.groups and not replace:
+                raise ValueError(f"resource group {name!r} already exists")
+            self.groups[name] = ResourceGroup(
+                name=name, **{k: int(v) for k, v in props.items()})
+
+    def drop(self, name: str, if_exists: bool = False):
+        name = name.lower()
+        with self._lock:
+            if name not in self.groups:
+                if if_exists:
+                    return
+                raise ValueError(f"unknown resource group {name!r}")
+            del self.groups[name]
+            self._lock.notify_all()
+
+    def get(self, name: str) -> Optional[ResourceGroup]:
+        return self.groups.get(name.lower())
+
+    # --- admission -----------------------------------------------------------
+    def admit(self, group_name: Optional[str], est_scan_rows: int = 0,
+              est_scan_bytes: int = 0):
+        """Admission check for one query. Returns a zero-arg release
+        callable (always call it from a finally). Raises AdmissionError on
+        big-query rejection or slot-queue timeout."""
+        if not group_name:
+            return lambda: None
+        g = self.get(group_name)
+        if g is None:
+            # group dropped mid-session: behave like the default group
+            return lambda: None
+        if g.max_scan_rows and est_scan_rows > g.max_scan_rows:
+            with self._lock:
+                self.rejected_total += 1
+            raise AdmissionError(
+                f"query scans ~{est_scan_rows} rows, over resource group "
+                f"{g.name!r} big-query limit {g.max_scan_rows} "
+                "(reference: big_query_scan_rows_limit)")
+        if g.mem_limit_bytes and est_scan_bytes > g.mem_limit_bytes:
+            with self._lock:
+                self.rejected_total += 1
+            raise AdmissionError(
+                f"query reads ~{est_scan_bytes} bytes, over resource group "
+                f"{g.name!r} memory limit {g.mem_limit_bytes}")
+        if not g.concurrency_limit:
+            return lambda: None
+        deadline = time.monotonic() + float(
+            config.get("query_queue_timeout_s"))
+        name = g.name
+        with self._lock:
+            self.queued[name] = self.queued.get(name, 0) + 1
+            try:
+                while self.running.get(name, 0) >= g.concurrency_limit:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or name not in self.groups:
+                        if name in self.groups:
+                            self.timeout_total += 1
+                            raise AdmissionError(
+                                f"admission queue timeout: resource group "
+                                f"{name!r} held all "
+                                f"{g.concurrency_limit} slot(s) for "
+                                f"{config.get('query_queue_timeout_s')}s")
+                        break  # group dropped while queued: run free
+                    self._lock.wait(timeout=remaining)
+            finally:
+                self.queued[name] = self.queued.get(name, 1) - 1
+            self.running[name] = self.running.get(name, 0) + 1
+
+        released = [False]
+
+        def release():
+            with self._lock:
+                if not released[0]:
+                    released[0] = True
+                    self.running[name] = max(
+                        self.running.get(name, 1) - 1, 0)
+                    self._lock.notify_all()
+
+        return release
+
+    # --- introspection -------------------------------------------------------
+    def snapshot(self):
+        with self._lock:
+            return [
+                (g.name, g.concurrency_limit, g.max_scan_rows,
+                 g.mem_limit_bytes, g.cpu_weight,
+                 self.running.get(g.name, 0), self.queued.get(g.name, 0))
+                for g in sorted(self.groups.values(), key=lambda g: g.name)
+            ]

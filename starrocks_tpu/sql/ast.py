@@ -221,6 +221,24 @@ class ShowTables:
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateResourceGroup:
+    name: str
+    props: tuple  # tuple[(prop_name, int_value)]
+    replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropResourceGroup:
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowResourceGroups:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowPartitions:
     table: str
 

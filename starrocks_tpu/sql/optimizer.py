@@ -41,6 +41,7 @@ def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
     plan = rewrite_subqueries(plan, catalog)
     plan = pushdown_filters(plan)
     plan = pushdown_semi_joins(plan, catalog)
+    plan = pushdown_aggregation(plan, catalog)
     plan = reorder_joins(plan, catalog)
     plan = pushdown_filters(plan)
     plan = prune_columns(plan)
@@ -1387,6 +1388,88 @@ def _greedy_order(rels, conjuncts, catalog) -> LogicalPlan:
     if pending:
         plan = LFilter(plan, and_all(pending))
     return plan
+
+
+# --- 4b. eager aggregation (group-by pushdown below a join) ------------------
+
+
+def pushdown_aggregation(plan: LogicalPlan, catalog) -> LogicalPlan:
+    """Eager aggregation (reference analog: the CBO's
+    PushDownAggregateRule family): an Agg over a LEFT/INNER join whose
+    single group key IS the probe-side join key — provably unique there —
+    with every aggregate reading only build-side columns, becomes
+    agg-below-join: group the build side by its join key first, then join
+    1:1 and patch NULL counts to 0. TPC-H Q13: count(o_orderkey) per
+    customer stops joining 1.5M order rows and instead dense-counts orders
+    by o_custkey, then gather-joins 150k groups."""
+    new_children = tuple(
+        pushdown_aggregation(c, catalog) for c in plan.children)
+    plan = _replace_children(plan, new_children)
+    if not isinstance(plan, LAggregate) or len(plan.group_by) != 1:
+        return plan
+    j = plan.child
+    if (not isinstance(j, LJoin) or j.kind not in ("left", "inner")
+            or j.condition is None):
+        return plan
+    lcols = frozenset(j.left.output_names())
+    rcols = frozenset(j.right.output_names())
+    equi = None
+    right_extras = []
+    for c in _conjuncts(j.condition):
+        pair = None
+        if (isinstance(c, Call) and c.fn == "eq" and len(c.args) == 2
+                and isinstance(c.args[0], Col)
+                and isinstance(c.args[1], Col)):
+            a, b = c.args
+            if a.name in lcols and b.name in rcols:
+                pair = (a.name, b.name)
+            elif b.name in lcols and a.name in rcols:
+                pair = (b.name, a.name)
+        if pair is not None and equi is None:
+            equi = pair
+        elif expr_cols(c) <= rcols:
+            # right-only ON conjunct: for LEFT joins it only disqualifies
+            # build rows from matching, so it pushes into the build input
+            right_extras.append(c)
+        else:
+            return plan
+    if equi is None:
+        return plan
+    lk, rk = equi
+    gname, gexpr = plan.group_by[0]
+    if not (isinstance(gexpr, Col) and gexpr.name == lk):
+        return plan
+    origin = col_origin(j.left, lk)
+    if origin is None:
+        return plan
+    t = catalog.get_table(origin[0])
+    if t is None or (origin[1],) not in {tuple(k) for k in t.unique_keys}:
+        return plan
+    n = j.left  # the probe must not duplicate rows (scan/filter chain)
+    while isinstance(n, (LFilter, LProject)):
+        n = n.child
+    if not isinstance(n, LScan):
+        return plan
+    mapped, post = [], {}
+    for name, a in plan.aggs:
+        if a.distinct or a.fn not in ("count", "sum", "min", "max"):
+            return plan
+        if a.arg is None:
+            # count(*) counts preserved unmatched left rows — not
+            # expressible as a build-side aggregate
+            return plan
+        cols = expr_cols(a.arg)
+        if not cols or not cols <= rcols:
+            return plan
+        mapped.append((name, AggExpr(a.fn, a.arg)))
+        if a.fn == "count":
+            post[name] = Call("coalesce", Col(name), Lit(0))
+    rin = LFilter(j.right, and_all(right_extras)) if right_extras else j.right
+    sub = LAggregate(rin, ((rk, Col(rk)),), tuple(mapped))
+    joined = LJoin(j.left, sub, j.kind, Call("eq", Col(lk), Col(rk)))
+    out = [(gname, Col(lk))] + [
+        (name, post.get(name, Col(name))) for name, _ in plan.aggs]
+    return LProject(joined, tuple(out))
 
 
 # --- 5. column pruning -------------------------------------------------------

@@ -253,6 +253,14 @@ class Parser:
                 self.next()
                 self.accept_op(";")
                 return ast.ShowProfile()
+            if (self.peek().kind == "ident"
+                    and self.peek().value.lower() == "resource"):
+                self.next()
+                g = self.next()
+                if g.value.lower() != "groups":
+                    raise ParseError("expected GROUPS after SHOW RESOURCE")
+                self.accept_op(";")
+                return ast.ShowResourceGroups()
             full = self.accept_kw("full")
             self.expect_kw("tables")
             self.accept_op(";")
@@ -1147,6 +1155,35 @@ class Parser:
             self.accept_op(";")
             return ast.CreateFunction(name, tuple(params), ret, src.value,
                                       replace)
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "resource"):
+            # CREATE [OR REPLACE] RESOURCE GROUP name
+            #   WITH (concurrency_limit = 2, max_scan_rows = 100000, ...)
+            self.next()
+            g = self.next()
+            if g.value.lower() != "group":
+                raise ParseError("expected GROUP after CREATE RESOURCE")
+            name = self.expect_ident()
+            props = []
+            if self.accept_kw("with"):
+                self.expect_op("(")
+                while True:
+                    pname = self.expect_ident().lower()
+                    self.expect_op("=")
+                    t = self.next()
+                    if t.kind == "number":
+                        val = int(t.value)
+                    elif t.kind == "string":
+                        val = int(t.value)
+                    else:
+                        raise ParseError(
+                            "resource group property values are integers")
+                    props.append((pname, val))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            self.accept_op(";")
+            return ast.CreateResourceGroup(name, tuple(props), replace)
         if replace:
             raise ParseError("OR REPLACE is only supported for FUNCTION")
         if (self.peek().kind == "ident"
@@ -1324,6 +1361,19 @@ class Parser:
             name = self.expect_ident()
             self.accept_op(";")
             return ast.DropFunction(name, if_exists)
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "resource"):
+            self.next()
+            g = self.next()
+            if g.value.lower() != "group":
+                raise ParseError("expected GROUP after DROP RESOURCE")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            name = self.expect_ident()
+            self.accept_op(";")
+            return ast.DropResourceGroup(name, if_exists)
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

@@ -478,6 +478,13 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                               "aux_checks": agg_aux}
                 out, ng = hash_aggregate(c, p.group_by, p.aggs, cap, **kwargs)
                 checks[key] = ng
+                # dense floor metadata for the adaptive loop: a cap equal
+                # to a dense domain seed must never tighten below it (that
+                # would knock the plan onto the lexsort path); floor 0
+                # means the lexsort path is in use and the cap may tighten
+                # to the true group count like any other capacity
+                checks["~floor_" + key] = (
+                    dom if (dom is not None and dom <= cap) else 0)
                 if kwargs:
                     checks[akey] = agg_aux["array_agg_max"]
                 return out
@@ -547,6 +554,10 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                 )
             if lut_range is not None:
                 lo, hi = lut_range
+                # a selective probe-side filter (e.g. Q14's one-month
+                # lineitem window) leaves most probe capacity dead — the
+                # LUT gathers cost per SLOT, so compact first
+                lc = maybe_compact(p.left, lc, f"{ordinal(p)}l")
                 out = hash_join_lut(
                     lc, rc, tuple(probe_keys), tuple(build_keys),
                     lo, int(hi - lo + 1), kind, payload=payload,

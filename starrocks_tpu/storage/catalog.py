@@ -142,6 +142,8 @@ class Catalog:
         self.versions: dict = {}
         # users + table-level grants (runtime/auth.py); created on demand
         self.auth = None
+        # resource groups / admission (runtime/workgroup.py); on demand
+        self.workgroups = None
         # recent statements (sessions append; information_schema.query_log)
         self.query_log: list = []
 
@@ -216,6 +218,18 @@ class Catalog:
                 ("table_name", T.VARCHAR, [r[0] for r in rows]),
                 ("table_rows", T.BIGINT, [r[1] for r in rows]),
                 ("table_type", T.VARCHAR, [r[2] for r in rows]),
+            ])
+        if view == "resource_groups":
+            wm = getattr(self, "workgroups", None)
+            rows = wm.snapshot() if wm is not None else []
+            return vtable([
+                ("name", T.VARCHAR, [r[0] for r in rows]),
+                ("concurrency_limit", T.BIGINT, [r[1] for r in rows]),
+                ("max_scan_rows", T.BIGINT, [r[2] for r in rows]),
+                ("mem_limit_bytes", T.BIGINT, [r[3] for r in rows]),
+                ("cpu_weight", T.BIGINT, [r[4] for r in rows]),
+                ("running", T.BIGINT, [r[5] for r in rows]),
+                ("queued", T.BIGINT, [r[6] for r in rows]),
             ])
         if view == "schemata":
             return vtable([
