@@ -6,6 +6,7 @@ from . import functions_wave3  # noqa: F401  (wave-3 builtins)
 from . import functions_array  # noqa: F401  (ARRAY builtins)
 from . import functions_sketch  # noqa: F401  (HLL/BITMAP builtins)
 from . import functions_wave4  # noqa: F401  (wave-4 builtins)
+from . import functions_lambda  # noqa: F401  (lambda/MAP/STRUCT builtins)
 from .ir import (
     AggExpr,
     Call,

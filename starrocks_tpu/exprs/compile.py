@@ -40,6 +40,7 @@ from .. import types as T
 from ..column.column import Chunk
 from ..column.dict_encoding import StringDict
 from .ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit
+from .ir import Lambda as IrLambda
 
 
 @dataclasses.dataclass
@@ -300,7 +301,12 @@ class ExprCompiler:
                     return eval_udf(self, udef,
                                     [self.eval(a) for a in e.args])
                 raise KeyError(f"unknown function {e.fn!r}")
-            return fn(self, *[self.eval(a) for a in e.args])
+            # Lambda arguments stay UNevaluated: the higher-order builtin
+            # compiles the body itself over the flattened lane view
+            return fn(self, *[
+                a if isinstance(a, IrLambda) else self.eval(a)
+                for a in e.args
+            ])
         if isinstance(e, EVal):
             return e  # pre-evaluated argument (cc.call composition)
         if isinstance(e, AggExpr):

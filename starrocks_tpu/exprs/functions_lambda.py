@@ -1,0 +1,495 @@
+"""Higher-order (lambda) functions, MAP and STRUCT builtins.
+
+Reference behavior: the lambda-function family in
+gensrc/script/functions.py (array_map / array_filter / all_match /
+any_match / map_apply / transform_keys / transform_values / map_filter)
+evaluated by be/src/exprs/lambda_function.h + map_column.h /
+struct_column.h. TPU-first re-design:
+
+- a Lambda body compiles over the FLATTENED (rows x lanes) view of its
+  array operand: lane values reshape to ONE virtual column of capacity
+  n*k, captured outer columns broadcast per-lane, and the ENTIRE scalar
+  builtin surface (arithmetic, string LUT ops, date math, CASE) works
+  inside lambdas unchanged — no per-element interpreter, one fused XLA
+  program (the reference walks a sub-expr tree per array element);
+- MAP values are trace-time pairs of aligned ARRAY EVals (keys, values).
+  Maps live in expressions (built, transformed, subscripted, reduced);
+  materializing a raw MAP column to the result surface is rejected with
+  a clear error rather than silently stringified;
+- STRUCT values are trace-time named tuples of EVals (named_struct/row +
+  struct_field access).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from .. import types as T
+from .compile import _FUNCTIONS, EVal, ExprCompiler, _and_valid, function
+from .ir import Call, Col, Lambda as IrLambda, Lit
+from .functions_array import _arr
+from .functions_wave4 import _arr_out, _scalar_into_dict
+
+# the ARRAY forms registered by functions_array; this module extends both
+# names to MAP operands and delegates everything else back
+_ORIG_ELEMENT_AT = _FUNCTIONS["element_at"]
+_ORIG_CARDINALITY = _FUNCTIONS["cardinality"]
+
+
+# --- composite trace-time values ---------------------------------------------
+
+
+@dataclasses.dataclass
+class MapEVal(EVal):
+    """MAP<K,V> as two aligned ARRAY EVals sharing one length column."""
+
+    keys: EVal = None
+    values: EVal = None
+
+
+@dataclasses.dataclass
+class StructEVal(EVal):
+    """STRUCT as named trace-time fields."""
+
+    fields: tuple = ()  # tuple[(name, EVal)]
+
+
+def _map_of(keys: EVal, values: EVal) -> MapEVal:
+    return MapEVal(
+        data=jnp.asarray(keys.data)[:, :1],  # length column (shape keeper)
+        valid=_and_valid(keys.valid, values.valid),
+        type=T.LogicalType(T.TypeKind.NULL),  # composite: never materialized
+        keys=keys, values=values,
+    )
+
+
+# --- lambda evaluation over flattened lanes ----------------------------------
+
+
+class _FlatChunk:
+    """Capacity shim for the flattened lane view (n rows x k lanes)."""
+
+    def __init__(self, capacity):
+        self.capacity = capacity
+
+
+class LambdaCompiler(ExprCompiler):
+    """Evaluates a lambda body. Param Cols (@lam.x) bind to the flattened
+    lane arrays; every other Col resolves through the BASE compiler and
+    broadcasts per-lane."""
+
+    def __init__(self, base: ExprCompiler, binds: dict, n: int, k: int):
+        super().__init__(_FlatChunk(n * k))
+        self.base = base
+        self.binds = binds
+        self.n, self.k = n, k
+
+    def _spread(self, v: EVal) -> EVal:
+        d = jnp.asarray(v.data)
+        if d.ndim == 0:
+            return v  # scalar literals broadcast naturally
+        # rank-polymorphic: a captured ARRAY/DECIMAL128 column is 2-D
+        # (n, w) — every lane sees the whole row value, so nested
+        # higher-order calls inside the body just run over a bigger batch
+        d = jnp.broadcast_to(
+            d[:, None, ...], (self.n, self.k) + d.shape[1:]
+        ).reshape((self.n * self.k,) + d.shape[1:])
+        valid = v.valid
+        if valid is not None:
+            valid = jnp.broadcast_to(
+                valid[:, None], (self.n, self.k)).reshape(-1)
+        return dataclasses.replace(v, data=d, valid=valid)
+
+    def eval(self, e):
+        if isinstance(e, Col):
+            b = self.binds.get(e.name)
+            if b is not None:
+                return b
+            if e.name.startswith("@lam.") and not isinstance(
+                    self.base, LambdaCompiler):
+                raise KeyError(f"unbound lambda parameter {e.name!r}")
+            # captured outer column — or, in a NESTED lambda, the
+            # enclosing lambda's parameter — spreads per-lane
+            return self._spread(self.base.eval(e))
+        return super().eval(e)
+
+
+def _pad_lanes(arr: EVal, kmax: int) -> EVal:
+    """Widen an ARRAY operand to kmax value lanes (extra lanes dead)."""
+    d = jnp.asarray(arr.data)
+    k = d.shape[1] - 1
+    if k >= kmax:
+        return arr
+    pad = jnp.zeros((d.shape[0], kmax - k), d.dtype)
+    return dataclasses.replace(arr, data=jnp.concatenate([d, pad], axis=1))
+
+
+def _flat_param(arr: EVal) -> tuple:
+    """(flattened EVal, n, k, lane_mask) for one ARRAY operand. Lanes past
+    the row's length are NULL inside the body (their outputs are dead)."""
+    length, vals, mask, elem = _arr(arr)
+    n, k = vals.shape
+    ev = EVal(vals.reshape(-1), mask.reshape(-1),
+              elem if not elem.is_string else T.VARCHAR, arr.dict)
+    return ev, n, k, mask, length, elem
+
+
+def eval_lambda(cc, lam: IrLambda, arrays: list) -> tuple:
+    """Compile `lam` over one or more ARRAY operands. Returns
+    (body EVal flattened, n, k, mask, length) — caller reshapes.
+
+    Multi-array semantics are ZIP: the live lanes are the intersection of
+    the operands' lengths (result length = min). DEVIATION: the reference
+    raises on mismatched element counts per row; a compiled program can't
+    raise data-dependently, so trailing unmatched elements drop instead."""
+    if len(lam.params) != len(arrays):
+        raise ValueError(
+            f"lambda takes {len(lam.params)} params, got "
+            f"{len(arrays)} arrays")
+    if len(arrays) > 1:
+        # align lane capacities: pad the narrower operands with dead lanes
+        kmax = max(jnp.asarray(a.data).shape[1] - 1 for a in arrays)
+        arrays = [_pad_lanes(a, kmax) for a in arrays]
+    flats = [_flat_param(a) for a in arrays]
+    n, k = flats[0][1], flats[0][2]
+    for f in flats[1:]:
+        if (f[1], f[2]) != (n, k):
+            raise NotImplementedError(
+                "multi-array lambda needs same-capacity arrays")
+    mask = flats[0][3]
+    length = flats[0][4]
+    for f in flats[1:]:
+        mask = mask & f[3]
+        length = jnp.minimum(length, f[4])
+    binds = {
+        f"@lam.{p}": f[0] for p, f in zip(lam.params, flats)
+    }
+    sub = LambdaCompiler(cc, binds, n, k)
+    out = sub.eval(lam.body)
+    return out, n, k, mask, length
+
+
+def _split_lambda(args, fname):
+    """StarRocks accepts both array_map(lambda, arr...) and
+    array_map(arr..., lambda); normalize to (lambda, [arrays])."""
+    lams = [a for a in args if isinstance(a, IrLambda)]
+    arrs = [a for a in args if not isinstance(a, IrLambda)]
+    if len(lams) != 1 or not arrs:
+        raise ValueError(f"{fname} takes one lambda and >=1 array")
+    for a in arrs:
+        if not a.type.is_array:
+            raise TypeError(f"{fname}: expected ARRAY, got {a.type}")
+    return lams[0], arrs
+
+
+def _body_grid(out: EVal, n: int, k: int):
+    """(values(n,k), valid(n,k)|None) of a flattened body result."""
+    d = jnp.asarray(out.data)
+    vals = jnp.broadcast_to(d, (n * k,)).reshape(n, k)
+    valid = None
+    if out.valid is not None:
+        valid = jnp.broadcast_to(out.valid, (n * k,)).reshape(n, k)
+    return vals, valid
+
+
+@function("array_map")
+def _f_array_map(cc, *args):
+    lam, arrs = _split_lambda(args, "array_map")
+    out, n, k, mask, length = eval_lambda(cc, lam, arrs)
+    vals, bvalid = _body_grid(out, n, k)
+    # NULL body results inside live lanes: arrays carry no per-element
+    # validity, so they surface as the element type's zero (documented
+    # deviation; the reference keeps per-element nulls)
+    vals = jnp.where(mask, vals, 0)
+    if bvalid is not None:
+        vals = jnp.where(bvalid, vals, 0)
+    elem = out.type if not out.type.is_string else T.VARCHAR
+    row_valid = _and_valid(*[a.valid for a in arrs])
+    return _arr_out(vals, length, elem, row_valid, out.dict)
+
+
+@function("transform")
+def _f_transform(cc, *args):
+    return _f_array_map(cc, *args)
+
+
+def compact_lanes(keep, arr_ev: EVal) -> EVal:
+    """Stable per-row lane compaction: keep[n, k] selects elements of
+    `arr_ev`; survivors pack left, the length shrinks to the kept count
+    (the array_remove scatter recipe — THE single copy, shared by
+    array_filter / map_filter / distinct_map_keys)."""
+    _, vals, _, elem = _arr(arr_ev)
+    n, k = vals.shape
+    pos = jnp.cumsum(jnp.asarray(keep, jnp.int32), axis=1) - 1
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k))
+    dest = jnp.where(keep, rows * k + pos, n * k)
+    outv = jnp.zeros((n * k,), vals.dtype).at[dest.reshape(-1)].set(
+        vals.reshape(-1), mode="drop").reshape(n, k)
+    new_len = jnp.sum(jnp.asarray(keep, jnp.int32), axis=1)
+    return _arr_out(outv, new_len, elem, arr_ev.valid, arr_ev.dict)
+
+
+@function("array_filter")
+def _f_array_filter(cc, *args):
+    lam, arrs = _split_lambda(args, "array_filter")
+    out, n, k, mask, length = eval_lambda(cc, lam, arrs)
+    pred, bvalid = _body_grid(out, n, k)
+    keep = mask & jnp.asarray(pred, jnp.bool_)
+    if bvalid is not None:
+        keep = keep & bvalid  # NULL predicate drops the element (SQL WHERE)
+    return compact_lanes(keep, arrs[0])
+
+
+def _match_fold(cc, args, fname, is_any: bool):
+    """Empty-row semantics fall out of the fold identity: any over
+    (pred & mask) is False on empty rows, all over (pred | ~mask) is
+    True."""
+    lam, arrs = _split_lambda(args, fname)
+    out, n, k, mask, length = eval_lambda(cc, lam, arrs)
+    pred, bvalid = _body_grid(out, n, k)
+    pred = jnp.asarray(pred, jnp.bool_)
+    if bvalid is not None:
+        pred = pred & bvalid  # NULL matches count as false (deviation:
+        # the reference yields NULL when a null body value is decisive)
+    res = (jnp.any(pred & mask, axis=1) if is_any
+           else jnp.all(pred | ~mask, axis=1))
+    row_valid = _and_valid(*[a.valid for a in arrs])
+    return EVal(res, row_valid, T.BOOLEAN)
+
+
+@function("all_match")
+def _f_all_match(cc, *args):
+    return _match_fold(cc, args, "all_match", is_any=False)
+
+
+@function("any_match")
+def _f_any_match(cc, *args):
+    return _match_fold(cc, args, "any_match", is_any=True)
+
+
+@function("array_sortby")
+def _f_array_sortby(cc, *args):
+    """Sort the FIRST array's elements by the lambda's value per element
+    (dead lanes sort last; stable)."""
+    lam, arrs = _split_lambda(args, "array_sortby")
+    a = arrs[0]
+    out, n, k, mask, length = eval_lambda(cc, lam, arrs)
+    keyv, bvalid = _body_grid(out, n, k)
+    keyf = jnp.asarray(keyv, jnp.float64)
+    big = jnp.inf
+    keyf = jnp.where(mask, keyf, big)
+    if bvalid is not None:
+        keyf = jnp.where(bvalid, keyf, big)  # NULL keys last
+    order = jnp.argsort(keyf, axis=1)
+    _, vals, _, elem = _arr(a)
+    sortedv = jnp.take_along_axis(vals, order, axis=1)
+    return _arr_out(sortedv, length, elem, a.valid, a.dict)
+
+
+# --- MAP builtins -------------------------------------------------------------
+
+
+def _as_map(m) -> MapEVal:
+    if not isinstance(m, MapEVal):
+        raise TypeError("expected a MAP value (map_from_arrays/map literal)")
+    return m
+
+
+@function("map_from_arrays")
+def _f_map_from_arrays(cc, karr, varr):
+    if not (karr.type.is_array and varr.type.is_array):
+        raise TypeError("map_from_arrays takes two arrays")
+    return _map_of(karr, varr)
+
+
+@function("map_keys")
+def _f_map_keys(cc, m):
+    return _as_map(m).keys
+
+
+@function("map_values")
+def _f_map_values(cc, m):
+    return _as_map(m).values
+
+
+@function("map_size")
+def _f_map_size(cc, m):
+    m = _as_map(m)
+    length, _, _, _ = _arr(m.keys)
+    return EVal(jnp.asarray(length, jnp.int64), m.valid, T.BIGINT)
+
+
+@function("cardinality")
+def _f_cardinality(cc, x):
+    if isinstance(x, MapEVal):
+        return _f_map_size(cc, x)
+    return _ORIG_CARDINALITY(cc, x)
+
+
+@function("map_contains_key")
+def _f_map_contains_key(cc, m, k):
+    m = _as_map(m)
+    return cc.call("array_contains", m.keys, k)
+
+
+@function("element_at")
+def _f_element_at(cc, x, k):
+    """element_at(map, key) -> value (NULL when absent);
+    element_at(array, idx) -> 1-based element."""
+    if isinstance(x, MapEVal):
+        keys, kv = _scalar_into_dict(x.keys, k)
+        length, kvals, mask, _ = _arr(keys)
+        _, vvals, _, velem = _arr(x.values)
+        n, kk = kvals.shape
+        target = jnp.asarray(kv.data, kvals.dtype)
+        hit = mask & (kvals == target)
+        idx = jnp.argmax(hit, axis=1)
+        found = jnp.any(hit, axis=1)
+        got = jnp.take_along_axis(vvals, idx[:, None], axis=1)[:, 0]
+        valid = _and_valid(x.valid, kv.valid, found)
+        return EVal(got, valid, velem if not velem.is_string else T.VARCHAR,
+                    x.values.dict)
+    return _ORIG_ELEMENT_AT(cc, x, k)
+
+
+@function("map_filter")
+def _f_map_filter(cc, *args):
+    """map_filter(map, (k, v) -> pred): keep entries where pred holds."""
+    lams = [a for a in args if isinstance(a, IrLambda)]
+    maps = [a for a in args if isinstance(a, MapEVal)]
+    if len(lams) != 1 or len(maps) != 1:
+        raise ValueError("map_filter takes a map and one (k, v) lambda")
+    m, lam = maps[0], lams[0]
+    out, n, k, mask, length = eval_lambda(cc, lam, [m.keys, m.values])
+    pred, bvalid = _body_grid(out, n, k)
+    keep = mask & jnp.asarray(pred, jnp.bool_)
+    if bvalid is not None:
+        keep = keep & bvalid
+    return _map_of(compact_lanes(keep, m.keys),
+                   compact_lanes(keep, m.values))
+
+
+def _transform_side(cc, args, fname, which):
+    lams = [a for a in args if isinstance(a, IrLambda)]
+    maps = [a for a in args if isinstance(a, MapEVal)]
+    if len(lams) != 1 or len(maps) != 1:
+        raise ValueError(f"{fname} takes a map and one (k, v) lambda")
+    m, lam = maps[0], lams[0]
+    mapped = _f_array_map(cc, lam, m.keys, m.values) \
+        if len(lam.params) == 2 else _f_array_map(
+            cc, lam, m.keys if which == "keys" else m.values)
+    if which == "keys":
+        return _map_of(mapped, m.values)
+    return _map_of(m.keys, mapped)
+
+
+@function("transform_keys")
+def _f_transform_keys(cc, *args):
+    return _transform_side(cc, args, "transform_keys", "keys")
+
+
+@function("transform_values")
+def _f_transform_values(cc, *args):
+    return _transform_side(cc, args, "transform_values", "values")
+
+
+@function("map_apply")
+def _f_map_apply(cc, *args):
+    # map_apply((k, v) -> v2, m): the value-transforming form
+    return _transform_side(cc, args, "map_apply", "values")
+
+
+@function("map_concat")
+def _f_map_concat(cc, a, b):
+    """Union of two maps; on duplicate keys the SECOND map's value wins
+    (reference semantics). Entries store a-then-b and duplicates dedupe
+    keeping the LAST stored occurrence, so element_at / map_size /
+    map_keys / distinct_map_keys all agree."""
+    a, b = _as_map(a), _as_map(b)
+    keys = cc.call("array_concat", a.keys, b.keys)
+    vals = cc.call("array_concat", a.values, b.values)
+    return _f_distinct_map_keys(cc, _map_of(keys, vals))
+
+
+@function("map_entries_values")
+def _f_map_entries_values(cc, m):
+    # helper surface while STRUCT columns can't materialize: the values
+    # of each entry in key order (map_entries itself would need a
+    # STRUCT<k, v> ARRAY result column)
+    return _as_map(m).values
+
+
+# --- STRUCT builtins ----------------------------------------------------------
+
+
+@function("named_struct")
+def _f_named_struct(cc, *args):
+    if len(args) % 2 != 0:
+        raise ValueError("named_struct takes name/value pairs")
+    fields = []
+    for i in range(0, len(args), 2):
+        nm = args[i]
+        if not isinstance(nm.data, str):
+            raise ValueError("named_struct field names must be literals")
+        fields.append((nm.data.lower(), args[i + 1]))
+    return StructEVal(
+        data=jnp.asarray(0, jnp.int32), valid=None,
+        type=T.LogicalType(T.TypeKind.NULL), fields=tuple(fields),
+    )
+
+
+@function("row")
+def _f_row(cc, *args):
+    return StructEVal(
+        data=jnp.asarray(0, jnp.int32), valid=None,
+        type=T.LogicalType(T.TypeKind.NULL),
+        fields=tuple((f"col{i + 1}", a) for i, a in enumerate(args)),
+    )
+
+
+@function("struct")
+def _f_struct(cc, *args):
+    return _f_row(cc, *args)
+
+
+@function("array_sort_lambda")
+def _f_array_sort_lambda(cc, *args):
+    return _f_array_sortby(cc, *args)
+
+
+@function("array_top_n")
+def _f_array_top_n(cc, a, n):
+    """Largest n elements, descending (reference: array_top_n)."""
+    lam = IrLambda(("__e",), Call("multiply", Col("@lam.__e"), Lit(-1)))
+    sorted_desc = _f_array_sortby(cc, lam, a)
+    return cc.call("array_slice", sorted_desc, EVal(1, None, T.BIGINT), n)
+
+
+@function("distinct_map_keys")
+def _f_distinct_map_keys(cc, m):
+    """Drop duplicate-key entries, keeping the LAST occurrence (reference
+    semantics: later keys overwrite). Lanes compare pairwise (k x k) —
+    map widths are small by construction."""
+    m = _as_map(m)
+    length, kvals, mask, _ = _arr(m.keys)
+    n, k = kvals.shape
+    later_eq = (kvals[:, :, None] == kvals[:, None, :]) \
+        & mask[:, :, None] & mask[:, None, :] \
+        & (jnp.arange(k)[None, None, :] > jnp.arange(k)[None, :, None])
+    keep = mask & ~jnp.any(later_eq, axis=2)
+    return _map_of(compact_lanes(keep, m.keys),
+                   compact_lanes(keep, m.values))
+
+
+@function("struct_field")
+def _f_struct_field(cc, s, name):
+    if not isinstance(s, StructEVal):
+        raise TypeError("struct_field expects a STRUCT value")
+    nm = str(name.data).lower()
+    for fn_, v in s.fields:
+        if fn_ == nm:
+            return v
+    raise KeyError(f"no struct field {nm!r} "
+                   f"(has {[f for f, _ in s.fields]})")

@@ -980,3 +980,32 @@ def _f_session_user(cc):
 @function("typeof")
 def _f_typeof(cc, a):
     return _const_str(cc, str(a.type).lower())
+
+
+@function("ngram_search_case_insensitive")
+def _f_ngram_search_ci(cc, a, b, *rest):
+    return cc.call("ngram_search", cc.call("lower", a),
+                   cc.call("lower", b), *rest)
+
+
+@function("json_value")
+def _f_json_value(cc, j, path):
+    # the scalar-extraction form of the JSON-path family
+    return cc.call("get_json_string", j, path)
+
+
+@function("grouping")
+def _f_grouping(cc, *args):
+    # the analyzer lowers grouping()/grouping_id() over ROLLUP/CUBE/SETS
+    # keys into __grouping_i marker columns; reaching the registry means
+    # the call sat outside a grouping-sets aggregate
+    raise ValueError(
+        "grouping() is only valid over GROUP BY ROLLUP/CUBE/GROUPING SETS "
+        "keys")
+
+
+@function("grouping_id")
+def _f_grouping_id(cc, *args):
+    raise ValueError(
+        "grouping_id() is only valid over GROUP BY ROLLUP/CUBE/GROUPING "
+        "SETS keys")

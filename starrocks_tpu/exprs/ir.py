@@ -86,6 +86,25 @@ class InList(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class Lambda(Expr):
+    """A lambda argument of a higher-order function: `x -> x * 2 + y`.
+    Inside `body`, each parameter appears as Col("@lam.<name>") (the
+    analyzer rewrites shadowed references); every other Col is a captured
+    outer column. Reference behavior: the lambda-function family of
+    gensrc/script/functions.py (array_map/map_apply/...) evaluated by
+    be/src/exprs/lambda_function.h — here the body compiles over the
+    FLATTENED (rows x lanes) view of the array operand, so the whole
+    scalar builtin surface works inside lambdas unchanged."""
+
+    params: tuple  # tuple[str]
+    body: Expr
+
+    def __repr__(self):
+        ps = ", ".join(self.params)
+        return f"({ps}) -> {self.body!r}"
+
+
+@dataclasses.dataclass(frozen=True)
 class WindowExpr(Expr):
     """fn(arg) OVER (PARTITION BY ... ORDER BY ...). fn is an aggregate name
     or row_number/rank/dense_rank/lead/lag/first_value/last_value/ntile;
@@ -187,6 +206,8 @@ def walk(e: Expr):
         yield from walk(e.arg)
     elif isinstance(e, InList):
         yield from walk(e.arg)
+    elif isinstance(e, Lambda):
+        yield from walk(e.body)
     elif isinstance(e, AggExpr):
         if e.arg is not None:
             yield from walk(e.arg)

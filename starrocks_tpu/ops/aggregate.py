@@ -127,6 +127,8 @@ def _key_domain(k) -> Optional[tuple]:
     if k.type.kind is T.TypeKind.BOOLEAN:
         return 2, 0
     if (k.bounds is not None
+            and jnp.asarray(k.data).ndim == 1  # wide (ARRAY/DEC128) keys
+            # can't pack: their bounds describe ELEMENTS, not the value
             and jnp.issubdtype(jnp.asarray(k.data).dtype, jnp.integer)):
         # stats-bounded integer/date domain (bounds propagate through the
         # expr compiler, e.g. extract(year FROM ...)): codes are value - lo

@@ -17,7 +17,10 @@ import dataclasses
 import itertools
 from typing import Optional
 
-from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit, WindowExpr
+from ..exprs.ir import (
+    AggExpr, Call, Case, Cast, Col, Expr, InList, Lit, WindowExpr,
+    Lambda as IrLambda,
+)
 from . import ast
 from .logical import (
     LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion,
@@ -475,7 +478,24 @@ class Analyzer:
 
     # --- expressions ---------------------------------------------------------
     def _lower(self, e: Expr, scope: Scope, ctes, allow_agg: bool) -> Expr:
+        if isinstance(e, ast.LambdaExpr):
+            # params shadow relation columns inside the body; captured
+            # outer columns resolve through the normal scope
+            stack = getattr(self, "_lam_params", None)
+            if stack is None:
+                stack = self._lam_params = []
+            stack.append(frozenset(p.lower() for p in e.params))
+            try:
+                body = self._lower(e.body, scope, ctes, allow_agg=False)
+            finally:
+                stack.pop()
+            return IrLambda(tuple(p.lower() for p in e.params), body)
         if isinstance(e, ast.RawCol):
+            stack = getattr(self, "_lam_params", None)
+            if stack and e.table is None:
+                nm = e.name.lower()
+                if any(nm in frame for frame in reversed(stack)):
+                    return Col(f"@lam.{nm}")
             q, depth = scope.resolve(e.table, e.name)
             if depth > 0:
                 # correlated outer reference: mark with special prefix; the
@@ -661,6 +681,10 @@ class Analyzer:
                     tuple((replace(o), a, nf) for o, a, nf in e.order_by),
                     e.offset, e.default, e.frame,
                 )
+            if isinstance(e, IrLambda):
+                # captured outer columns must resolve through group keys
+                # like any other reference; params (@lam.*) pass through
+                return IrLambda(e.params, replace(e.body))
             if isinstance(e, (ScalarSubquery, SemiJoinMark)):
                 return e
             raise AnalyzerError(f"cannot use {e!r} in aggregate query")
@@ -868,7 +892,10 @@ def _contains_agg(e: Expr) -> bool:
 
 def _cols_of(e: Expr):
     if isinstance(e, Col):
-        yield e.name
+        if not e.name.startswith("@lam."):
+            yield e.name
+    elif isinstance(e, IrLambda):
+        yield from _cols_of(e.body)
     elif isinstance(e, Call):
         for a in e.args:
             yield from _cols_of(a)

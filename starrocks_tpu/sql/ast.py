@@ -40,6 +40,14 @@ class RawFunc(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class LambdaExpr(Expr):
+    """`x -> body` / `(x, y) -> body` argument of a higher-order function."""
+
+    params: tuple  # tuple[str]
+    body: object  # unresolved expr
+
+
+@dataclasses.dataclass(frozen=True)
 class Star(Expr):
     table: Optional[str] = None
 

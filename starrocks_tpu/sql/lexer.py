@@ -108,7 +108,7 @@ def tokenize(sql: str) -> list:
             out.append(Token("ident", sql[i + 1 : j], i))
             i = j + 1
             continue
-        for op in ("<=", ">=", "<>", "!=", "||"):
+        for op in ("<=", ">=", "<>", "!=", "||", "->"):
             if sql.startswith(op, i):
                 out.append(Token("op", "<>" if op == "!=" else op, i))
                 i += 2

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 
-from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lit
+from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lambda, Lit
 from .analyzer import ScalarSubquery, SemiJoinMark, _conjuncts
 from .logical import (
     LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion,
@@ -227,7 +227,10 @@ def expr_cols(e: Expr) -> frozenset:
 
     def rec(x):
         if isinstance(x, Col):
-            out.add(x.name)
+            if not x.name.startswith("@lam."):
+                out.add(x.name)  # lambda params are not plan columns
+        elif isinstance(x, Lambda):
+            rec(x.body)  # captured outer columns ARE requirements
         elif isinstance(x, Call):
             for a in x.args:
                 rec(a)
@@ -260,6 +263,8 @@ def substitute(e: Expr, mapping: dict) -> Expr:
     """Replace Col(name) by mapping[name] expressions."""
     if isinstance(e, Col):
         return mapping.get(e.name, e)
+    if isinstance(e, Lambda):
+        return Lambda(e.params, substitute(e.body, mapping))
     if isinstance(e, Call):
         return Call(e.fn, *[substitute(a, mapping) for a in e.args])
     if isinstance(e, Case):

@@ -585,7 +585,43 @@ class Parser:
 
     # --- expressions (precedence climbing) ------------------------------------
     def parse_expr(self) -> Expr:
+        lam = self._try_parse_lambda()
+        if lam is not None:
+            return lam
         return self.parse_or()
+
+    def _try_parse_lambda(self):
+        """`x -> expr` / `(x, y) -> expr` (higher-order function arguments;
+        reference: the lambda grammar of array_map/map_apply). Pure
+        lookahead first, so ordinary expressions never backtrack."""
+        t = self.peek()
+        if (t.kind == "ident" and self.peek(1).kind == "op"
+                and self.peek(1).value == "->"):
+            name = self.next().value
+            self.next()  # ->
+            return ast.LambdaExpr((name,), self.parse_or())
+        if t.kind == "op" and t.value == "(":
+            j = 1
+            names = []
+            while True:
+                tk = self.peek(j)
+                if tk.kind != "ident":
+                    return None
+                names.append(tk.value)
+                nxt = self.peek(j + 1)
+                if nxt.kind == "op" and nxt.value == ",":
+                    j += 2
+                    continue
+                if nxt.kind == "op" and nxt.value == ")":
+                    j += 2
+                    break
+                return None
+            arrow = self.peek(j)
+            if not (arrow.kind == "op" and arrow.value == "->"):
+                return None
+            self.i += j + 1  # consume ( params ) ->
+            return ast.LambdaExpr(tuple(names), self.parse_or())
+        return None
 
     def parse_or(self) -> Expr:
         e = self.parse_and()
@@ -811,7 +847,13 @@ class Parser:
         ):
             # func call / qualified col / bare col
             if self.peek(1).kind == "op" and self.peek(1).value == "(":
-                return self.parse_func_call(self.next().value)
+                e = self.parse_func_call(self.next().value)
+                # postfix struct-field access: named_struct(...).a.b —
+                # only after call forms, so t.c stays a qualified column
+                while (self.at_op(".") and self.peek(1).kind == "ident"):
+                    self.next()
+                    e = Call("struct_field", e, Lit(self.expect_ident()))
+                return e
             name = self.next().value
             if self.accept_op("."):
                 col2 = self.expect_ident()

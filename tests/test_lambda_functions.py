@@ -1,0 +1,177 @@
+"""Lambda (higher-order), MAP and STRUCT builtins — differential tests vs
+hand-computed python semantics (exprs/functions_lambda.py; reference:
+be/src/exprs/lambda_function.h + map_column.h)."""
+
+import pytest
+
+from starrocks_tpu.runtime.session import Session
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session()
+    s.sql("create table lt (g int, arr array<int>, x int)")
+    s.sql("insert into lt values (1, array(1,2,3), 10), (2, array(5), 100),"
+          " (3, array(), 7), (4, null, 1)")
+    s.sql("create table st (g int, names array<varchar>, nums array<int>)")
+    s.sql("insert into st values "
+          "(1, array('a','b','c'), array(3,1,2)), "
+          "(2, array('z'), array(9))")
+    return s
+
+
+def _py_rows(rows):
+    return [tuple(r) for r in rows]
+
+
+def test_array_map_with_capture(sess):
+    got = sess.sql(
+        "select g, array_map(e -> e * 2 + x, arr) m from lt order by g"
+    ).rows()
+    assert got == [(1, [12, 14, 16]), (2, [110]), (3, []), (4, None)]
+    # both argument orders parse (reference accepts either)
+    got2 = sess.sql(
+        "select array_map(arr, e -> e + 1) m from lt where g = 1").rows()
+    assert got2 == [([2, 3, 4],)]
+
+
+def test_array_map_two_arrays_and_strings(sess):
+    got = sess.sql(
+        "select array_map((a, b) -> a * b, arr, arr) m from lt order by g"
+    ).rows()
+    assert got == [([1, 4, 9],), ([25],), ([],), (None,)]
+    # string LUT ops work inside lambda bodies (flattened-lane design)
+    got2 = sess.sql(
+        "select array_map(s -> length(s) + g, names) m from st order by g"
+    ).rows()
+    assert got2 == [([2, 2, 2],), ([3],)]
+
+
+def test_array_filter_and_matches(sess):
+    assert sess.sql(
+        "select g, array_filter(arr, e -> e % 2 = 1) f from lt order by g"
+    ).rows() == [(1, [1, 3]), (2, [5]), (3, []), (4, None)]
+    assert sess.sql(
+        "select g, all_match(arr, e -> e > 0) a, any_match(arr, e -> e > 2) y"
+        " from lt order by g"
+    ).rows() == [(1, True, True), (2, True, True), (3, True, False),
+                 (4, None, None)]
+
+
+def test_array_sortby(sess):
+    assert sess.sql(
+        "select array_sortby(names, s -> length(s)) s from st where g = 1"
+    ).rows() == [(["a", "b", "c"],)]
+    assert sess.sql(
+        "select array_sortby(arr, e -> -e) s from lt where g = 1"
+    ).rows() == [([3, 2, 1],)]
+    # sort one array by ANOTHER's values via a two-param lambda over zip
+    assert sess.sql(
+        "select array_sortby((s, n) -> n, names, nums) s "
+        "from st where g = 1"
+    ).rows() == [(["b", "c", "a"],)]
+
+
+def test_map_family(sess):
+    q = "map_from_arrays(arr, array_map(e -> e * 10, arr))"
+    assert sess.sql(
+        f"select g, map_size({q}) z from lt where g <= 3 order by g"
+    ).rows() == [(1, 3), (2, 1), (3, 0)]
+    assert sess.sql(
+        f"select element_at({q}, 2) v, map_contains_key({q}, 5) c "
+        "from lt where g <= 2 order by g"
+    ).rows() == [(20, False), (None, True)]
+    assert sess.sql(
+        f"select map_keys({q}) k, map_values({q}) v from lt where g = 1"
+    ).rows() == [([1, 2, 3], [10, 20, 30])]
+    assert sess.sql(
+        f"select cardinality({q}) c from lt where g = 1"
+    ).rows() == [(3,)]
+
+
+def test_map_lambdas(sess):
+    q = "map_from_arrays(arr, array_map(e -> e * 10, arr))"
+    assert sess.sql(
+        f"select map_values(transform_values({q}, (k, v) -> v + k)) tv "
+        "from lt where g = 1"
+    ).rows() == [([11, 22, 33],)]
+    assert sess.sql(
+        f"select map_keys(transform_keys({q}, (k, v) -> k * 100)) tk "
+        "from lt where g = 1"
+    ).rows() == [([100, 200, 300],)]
+    assert sess.sql(
+        f"select map_keys(map_filter({q}, (k, v) -> v >= 20)) mk "
+        "from lt where g = 1"
+    ).rows() == [([2, 3],)]
+
+
+def test_map_concat_last_wins(sess):
+    m = ("map_concat(map_from_arrays(array(1, 2), array(10, 20)), "
+         "map_from_arrays(array(2, 3), array(200, 300)))")
+    assert sess.sql(
+        f"select element_at({m}, 2) v, map_size({m}) z from lt where g = 1"
+    ).rows() == [(200, 3)]
+    # dedup is consistent across every introspection surface
+    assert sess.sql(
+        f"select map_keys({m}) k, map_values({m}) v from lt where g = 1"
+    ).rows() == [([1, 2, 3], [10, 200, 300])]
+
+
+def test_grouped_lambda_and_nested(sess):
+    # lambdas in grouped projections (the _build_aggregate replace() path):
+    # the lambda's captured refs resolve through group keys
+    assert sess.sql(
+        "select g, array_map(e -> e + g, array(g, g * 2)) m, count(*) c "
+        "from lt where g <= 2 group by g order by g"
+    ).rows() == [(1, [2, 3], 1), (2, [4, 6], 1)]
+    assert sess.sql(
+        "select g from lt where g <= 3 group by g "
+        "having any_match(array(g, g * 2), e -> e > 3) order by g"
+    ).rows() == [(2,), (3,)]
+    # nested lambda capturing the outer param AND an outer array column
+    assert sess.sql(
+        "select array_map(e -> cardinality(array_filter(arr, f -> f > e)),"
+        " arr) m from lt where g = 1"
+    ).rows() == [([2, 1, 0],)]
+
+
+def test_multi_array_zip_semantics(sess):
+    # DEVIATION (documented in eval_lambda): mismatched per-row lengths
+    # zip to the SHORTER length instead of raising like the reference
+    sess.sql("create table zz (a array<int>, b array<int>)")
+    sess.sql("insert into zz values (array(1,2,3), array(7))")
+    assert sess.sql(
+        "select array_map((x, y) -> x + y, a, b) m from zz"
+    ).rows() == [([8],)]
+    with pytest.raises(Exception, match="params"):
+        sess.sql("select array_filter(a, b, x -> x > 1) m from zz")
+
+
+def test_struct_family(sess):
+    assert sess.sql(
+        "select named_struct('a', x, 'b', g * 2).a sa, "
+        "named_struct('a', x, 'b', g * 2).b sb from lt where g = 2"
+    ).rows() == [(100, 4)]
+    assert sess.sql(
+        "select struct_field(row(x, g), 'col2') c2 from lt where g = 1"
+    ).rows() == [(1,)]
+    with pytest.raises(Exception, match="no struct field"):
+        sess.sql("select named_struct('a', 1).zz from lt where g = 1")
+
+
+def test_lambda_in_where_and_agg(sess):
+    # lambdas compose with the rest of the engine: filters and aggregates
+    assert sess.sql(
+        "select g from lt where any_match(arr, e -> e >= 5) order by g"
+    ).rows() == [(2,)]
+    assert sess.sql(
+        "select sum(cardinality(array_filter(arr, e -> e > 1))) s "
+        "from lt where g <= 3"
+    ).rows() == [(3,)]
+
+
+def test_lambda_shadowing_and_nesting(sess):
+    # the param shadows a real column name (x); inner lambda shadows outer
+    assert sess.sql(
+        "select array_map(x -> x + 1, arr) m from lt where g = 1"
+    ).rows() == [([2, 3, 4],)]
