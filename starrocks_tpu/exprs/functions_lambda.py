@@ -277,12 +277,20 @@ def _f_array_sortby(cc, *args):
     a = arrs[0]
     out, n, k, mask, length = eval_lambda(cc, lam, arrs)
     keyv, bvalid = _body_grid(out, n, k)
-    keyf = jnp.asarray(keyv, jnp.float64)
-    big = jnp.inf
-    keyf = jnp.where(mask, keyf, big)
+    keyv = jnp.asarray(keyv)
+    # native-dtype sort (a float64 cast would collapse int64 keys beyond
+    # 2^53); dead/NULL lanes pin to the dtype maximum so they sort last
+    if jnp.issubdtype(keyv.dtype, jnp.integer):
+        big = jnp.iinfo(keyv.dtype).max
+    elif keyv.dtype == jnp.bool_:
+        keyv = keyv.astype(jnp.int8)
+        big = jnp.int8(2)
+    else:
+        big = jnp.inf
+    keyv = jnp.where(mask, keyv, big)
     if bvalid is not None:
-        keyf = jnp.where(bvalid, keyf, big)  # NULL keys last
-    order = jnp.argsort(keyf, axis=1)
+        keyv = jnp.where(bvalid, keyv, big)  # NULL keys last
+    order = jnp.argsort(keyv, axis=1)
     _, vals, _, elem = _arr(a)
     sortedv = jnp.take_along_axis(vals, order, axis=1)
     return _arr_out(sortedv, length, elem, a.valid, a.dict)
@@ -301,7 +309,20 @@ def _as_map(m) -> MapEVal:
 def _f_map_from_arrays(cc, karr, varr):
     if not (karr.type.is_array and varr.type.is_array):
         raise TypeError("map_from_arrays takes two arrays")
-    return _map_of(karr, varr)
+    # zip semantics on mismatched per-row lengths: entries beyond the
+    # SHORTER side drop (DEVIATION: the reference raises; a compiled
+    # program can't raise data-dependently) — without the clamp,
+    # element_at would read dead value lanes as live data
+    lk = jnp.asarray(karr.data)[:, 0]
+    lv = jnp.asarray(varr.data)[:, 0]
+    lmin = jnp.minimum(lk, lv)
+
+    def clamp(a):
+        d = jnp.asarray(a.data)
+        return dataclasses.replace(a, data=jnp.concatenate(
+            [jnp.asarray(lmin, d.dtype)[:, None], d[:, 1:]], axis=1))
+
+    return _map_of(clamp(karr), clamp(varr))
 
 
 @function("map_keys")

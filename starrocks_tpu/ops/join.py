@@ -239,6 +239,25 @@ def _merge_schemas(left: Chunk, right: Chunk, right_names) -> tuple:
     return tuple(out_fields)
 
 
+def _probe_searchsorted(bk_sorted, pk):
+    """The unique-join probe ladder, flag-routable onto the explicit
+    Pallas kernel (`SET join_probe_strategy = 'pallas'`;
+    ops/pallas_kernels.probe_searchsorted_pallas — interpret mode on CPU,
+    compiled on TPU). Default: jnp.searchsorted (XLA's own ladder)."""
+    from ..runtime.config import config as _cfg
+
+    if _cfg.get("join_probe_strategy") == "pallas":
+        from .pallas_kernels import probe_searchsorted_pallas
+
+        n = int(pk.shape[0])
+        block = 2048 if n % 2048 == 0 else (
+            1024 if n % 1024 == 0 else n)
+        interpret = jax.default_backend() != "tpu"
+        return probe_searchsorted_pallas(
+            bk_sorted, pk, block=block, interpret=interpret)
+    return jnp.searchsorted(bk_sorted, pk)
+
+
 def hash_join_unique(
     probe: Chunk,
     build: Chunk,
@@ -263,7 +282,7 @@ def hash_join_unique(
     bk_sorted = bk[order]
     bcap = build.capacity
 
-    pos = jnp.searchsorted(bk_sorted, pk)
+    pos = _probe_searchsorted(bk_sorted, pk)
     pos_c = jnp.clip(pos, 0, bcap - 1)
     match = (bk_sorted[pos_c] == pk) & p_ok & (pk != _I64MAX)
     build_row = order[pos_c]

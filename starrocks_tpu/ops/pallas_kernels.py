@@ -78,3 +78,54 @@ def segment_sum_pallas(gid, values, num_groups: int, block: int = 2048,
         interpret=interpret,
     )(gid, values)
     return out[:num_groups]
+
+
+# --- join probe: the searchsorted ladder as an explicit kernel ---------------
+
+
+def _probe_block_kernel(build_ref, probe_ref, pos_ref, *, k: int,
+                        iters: int):
+    """Vectorized binary search of one probe block against the SORTED
+    build keys resident in VMEM: `iters` halving steps, each a masked
+    gather over the whole block (the searchsorted ladder of the sorted
+    join probe, be/src/exec/join_hash_map.h's probe loop re-designed as a
+    branch-free ladder the VPU runs in lockstep)."""
+    build = build_ref[...]          # [K] int64, sorted, padded with +inf
+    probe = probe_ref[...]          # [B] int64
+    lo = jnp.zeros(probe.shape, jnp.int32)
+    hi = jnp.full(probe.shape, k, jnp.int32)
+    for _ in range(iters):          # static unroll: log2(K) steps
+        mid = (lo + hi) // 2
+        mv = build[jnp.clip(mid, 0, k - 1)]
+        active = lo < hi            # converged lanes must stop moving
+        go_right = (mv < probe) & active
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    pos_ref[...] = lo               # first index with build[idx] >= probe
+
+
+def probe_searchsorted_pallas(sorted_build, probe, block: int = 2048,
+                              interpret: bool = False):
+    """jnp.searchsorted(sorted_build, probe, side='left') as a Pallas grid
+    kernel: the build side stays resident in VMEM while probe blocks
+    stream through (one HBM pass over the probe). Flag-gated behind
+    `SET join_probe_strategy = 'pallas'` (ops/join.py) — interpret mode on
+    CPU for correctness tests, compiled on TPU."""
+    import jax.experimental.pallas as pl
+
+    n = probe.shape[0]
+    k = int(sorted_build.shape[0])
+    assert n % block == 0, f"probe {n} must be a multiple of block {block}"
+    iters = max(k, 1).bit_length()
+    kernel = functools.partial(_probe_block_kernel, k=k, iters=iters)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((k,), lambda i: (0,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((block,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        interpret=interpret,
+    )(sorted_build, probe)

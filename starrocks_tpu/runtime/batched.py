@@ -602,6 +602,247 @@ def _np_mix64(x):
     return z
 
 
+_STREAM_FNS = frozenset(
+    {"row_number", "rank", "dense_rank", "sum", "count", "min", "max",
+     "first_value"})
+
+
+def _stream_window_eligible(sp: SpillWindowPlan):
+    """The carried-running-state streaming path covers ONE window whose
+    functions all have default frames in the running family and whose
+    ORDER BY keys are plain scan columns (the host must find peer
+    boundaries without re-implementing expression semantics)."""
+    if len(sp.windows) != 1:
+        return None
+    w = sp.windows[0]
+    if not w.order_by:
+        return None
+    okeys = []
+    for e, _asc, _nf in w.order_by:
+        if not isinstance(e, Col):
+            return None
+        okeys.append(e.name.split(".", 1)[-1])
+    for spec in w.funcs:
+        fn = spec[1]
+        frame = spec[5] if len(spec) > 5 else None
+        if fn not in _STREAM_FNS or frame is not None:
+            return None
+    if any(c not in sp.scan.columns for c in okeys):
+        return None
+    return okeys
+
+
+def _host_key_cols(ht, cols):
+    """(value, validity) pairs for host sorting/equality where NULLs form
+    their own group (matching the device window's NULL-equal rule)."""
+    import numpy as np
+
+    out = []
+    for c in cols:
+        d = np.asarray(ht.arrays[c])
+        v = ht.valids.get(c)
+        if v is not None:
+            d = np.where(v, d, d.dtype.type(0))
+            out.append(np.asarray(v, np.int8))
+        out.append(d)
+    return out
+
+
+def _np_descending(d):
+    """Host analog of ops/window._descending (sort-key negation)."""
+    import numpy as np
+
+    if d.dtype.kind == "f":
+        return -d
+    return -np.asarray(d, np.int64)
+
+
+def execute_streaming_window(sp: SpillWindowPlan, catalog, batch_rows: int,
+                             programs_cache: dict, profile_node, okeys):
+    """Beyond-HBM windows whose PARTITIONS don't fit the device budget
+    (the skewed-partition spill case): ONE global host sort by
+    (partition keys, order keys), then sequential device chunks CUT AT
+    PEER BOUNDARIES, with each function's running state carried across
+    chunks. The carry for every supported function is simply its own
+    OUTPUT at the last surviving row of the partition that continues into
+    the next chunk (peers never straddle a cut, so running aggregates are
+    complete at the boundary). Reference behavior: be/src/exec/analytor.h
+    streaming window evaluation + compute_env/spill/spiller.h:161.
+
+    DEVIATION from the hash-split recipe: a single PEER group (identical
+    partition+order keys) must still fit one chunk; that is far weaker
+    than one PARTITION fitting HBM."""
+    import numpy as np
+
+    from ..column import HostTable
+
+    w = sp.windows[0]
+    handle = catalog.get_table(sp.scan.table)
+    ht = handle.table
+    total = ht.num_rows
+    pkeys = sp.hash_cols
+
+    # global sort: (partition keys, order keys asc/desc + nulls) — mirror
+    # ops/window.py's lexsort operand construction on the host
+    ops = []
+    for (e, asc, nf), name in zip(reversed(list(w.order_by)),
+                                  reversed(okeys)):
+        d = np.asarray(ht.arrays[name])
+        if d.dtype == np.bool_:
+            d = d.astype(np.int8)
+        v = ht.valids.get(name)
+        ops.append(d if asc else _np_descending(d))
+        if v is not None:
+            ops.append(np.asarray(v if nf else ~v, np.int8))
+    for c in reversed(pkeys):
+        for a in reversed(_host_key_cols(ht, [c])):
+            ops.append(a)
+    order = np.lexsort(tuple(ops))
+
+    # peer boundaries in sorted order (same partition AND order keys)
+    peer_cols = [a[order] for a in _host_key_cols(ht, pkeys + okeys)]
+    is_new_peer = np.ones(total, np.bool_)
+    if total > 1:
+        same = np.ones(total - 1, np.bool_)
+        for a in peer_cols:
+            same &= a[1:] == a[:-1]
+        is_new_peer[1:] = ~same
+    part_cols = [a[order] for a in _host_key_cols(ht, pkeys)]
+
+    peer_starts = np.flatnonzero(is_new_peer)
+    # chunk cuts: greedy fill up to batch_rows, backing up to a peer start
+    cuts = [0]
+    while cuts[-1] < total:
+        want = cuts[-1] + batch_rows
+        if want >= total:
+            cuts.append(total)
+            break
+        j = np.searchsorted(peer_starts, want, side="right") - 1
+        nxt = int(peer_starts[j])
+        if nxt <= cuts[-1]:  # one peer group larger than the batch
+            j2 = np.searchsorted(peer_starts, cuts[-1], side="right")
+            nxt = int(peer_starts[j2]) if j2 < len(peer_starts) else total
+        cuts.append(nxt)
+    cap = pad_capacity(max(b - a for a, b in zip(cuts, cuts[1:])))
+
+    from ..ops.window import window_op
+
+    prog_key = ("stream_window", tuple(sp.windows), tuple(sp.scan_chain),
+                cap)
+    if prog_key not in programs_cache:
+        def prog(chunk: Chunk):
+            c = chunk
+            for node in reversed(sp.scan_chain):
+                if isinstance(node, LFilter):
+                    c = filter_chunk(c, node.predicate)
+                else:
+                    c = project(c, [e for _, e in node.exprs],
+                                [n for n, _ in node.exprs])
+            return window_op(c, w.partition_by, w.order_by, w.funcs)
+
+        programs_cache[prog_key] = jax.jit(prog)
+    jprog = programs_cache[prog_key]
+
+    profile_node.set_info("stream_chunks", len(cuts) - 1)
+    alias, cols = sp.scan.alias, sp.scan.columns
+    fnames = [spec[0] for spec in w.funcs]
+    fkinds = [spec[1] for spec in w.funcs]
+    carry_key = None   # tuple of host part-key values of the open partition
+    carries = None     # per-fn carried output value (peer-complete at cut)
+    cont_rows = 0      # emitted rows of the open partition so far
+    outs = []
+    for a, b in zip(cuts, cuts[1:]):
+        idx = order[a:b]
+        out = HostTable.from_chunk(jprog(
+            slice_scan_chunk(ht, alias, cols, idx, cap)))
+        if out.num_rows:
+            # identify output rows of the partition continuing from the
+            # previous chunk; chunk-local part keys read from the OUTPUT
+            # (filters may have dropped rows)
+            opart = _host_key_cols(out, pkeys_out(out, alias, pkeys))
+            cont = np.zeros(out.num_rows, np.bool_)
+            if carry_key is not None:
+                cont[:] = True
+                for arr, kv in zip(opart, carry_key):
+                    cont &= arr == kv
+                for name, kind, (cv, cval) in zip(fnames, fkinds, carries):
+                    if not cont.any():
+                        continue
+                    colv = np.array(out.arrays[name])  # device buffers are
+                    # read-only through np.asarray; patch a copy
+                    lval = out.valids.get(name)
+                    lval = (np.array(lval) if lval is not None
+                            else np.ones(out.num_rows, np.bool_))
+                    if kind in ("row_number", "rank"):
+                        # positional: offset by the ROWS the partition
+                        # already emitted (its last peer group may span
+                        # several rows, so the carried value itself is
+                        # not the row count for rank)
+                        colv[cont] = colv[cont] + cont_rows
+                    elif kind in ("dense_rank", "sum", "count"):
+                        if cval:
+                            # locally-NULL running values (no live inputs
+                            # in this chunk yet) become the carried state
+                            both = cont & lval
+                            colv[both] = colv[both] + cv
+                            only_carry = cont & ~lval
+                            colv[only_carry] = cv
+                            lval[cont] = True
+                    elif kind in ("min", "max"):
+                        if cval:
+                            both = cont & lval
+                            colv[both] = (np.minimum if kind == "min"
+                                          else np.maximum)(colv[both], cv)
+                            only_carry = cont & ~lval
+                            colv[only_carry] = cv
+                            lval[cont] = True
+                    elif kind == "first_value":
+                        # the partition's REAL first value came from an
+                        # earlier chunk — including a NULL one
+                        colv[cont] = cv
+                        lval[cont] = bool(cval)
+                    out.arrays[name] = colv
+                    if name in out.valids or not lval.all():
+                        out.valids[name] = lval
+            last = out.num_rows - 1
+            last_key = tuple(arr[last] for arr in opart)
+            in_last = np.ones(out.num_rows, np.bool_)
+            for arr, kv in zip(opart, last_key):
+                in_last &= arr == kv
+            if carry_key is not None and last_key == carry_key:
+                cont_rows += int(in_last.sum())
+            else:
+                cont_rows = int(in_last.sum())
+            carry_key = last_key
+            carries = [
+                (out.arrays[n][last],
+                 bool(out.valids[n][last]) if n in out.valids else True)
+                for n in fnames
+            ]
+        outs.append(_top_chain_host(out, sp.top_chain, cap))
+
+    schema, arrays, valids = host_concat_tables(outs)
+    return HostTable(schema, arrays, valids)
+
+
+def pkeys_out(out, alias, pkeys):
+    """Partition-key column names as they appear in the window OUTPUT."""
+    names = set(out.arrays)
+    return [f"{alias}.{c}" if f"{alias}.{c}" in names else c for c in pkeys]
+
+
+def _top_chain_host(out, top_chain, cap: int):
+    """Apply the Project/Filter chain above the window to an ADJUSTED host
+    chunk (the carries are patched on the host, so the top chain must run
+    after them)."""
+    if not top_chain:
+        return out
+    from ..column import HostTable
+
+    c = out.to_chunk(capacity=cap)
+    return HostTable.from_chunk(_apply_top_chain(c, top_chain))
+
+
 def execute_spill_window(sp: SpillWindowPlan, catalog, batch_rows: int,
                          programs_cache: dict, profile_node):
     """Host-partition rows by the window's PARTITION BY keys, run the full
@@ -634,6 +875,16 @@ def execute_spill_window(sp: SpillWindowPlan, catalog, batch_rows: int,
     order = np.argsort(bucket, kind="stable")
     counts = np.bincount(bucket, minlength=n_groups)
     cap = pad_capacity(int(counts.max()) if total else 1)
+
+    # a SKEWED partition can exceed the hash-split budget (every rows of
+    # one PARTITION BY group land in one bucket): switch to the streaming
+    # evaluator with carried running state when the window family allows
+    if cap > pad_capacity(batch_rows * 4):
+        okeys = _stream_window_eligible(sp)
+        if okeys is not None:
+            return execute_streaming_window(
+                sp, catalog, batch_rows, programs_cache, profile_node,
+                okeys)
 
     prog_key = ("spill_window", tuple(sp.windows), tuple(sp.scan_chain),
                 tuple(sp.top_chain), cap)

@@ -1,0 +1,188 @@
+"""Multi-host cluster plumbing: liveness (heartbeat + failure detection,
+restart hooks) and the cross-process device-mesh bootstrap.
+
+Reference behavior:
+- heartbeat plane: the FE heartbeat RPC every BE answers
+  (be/src/agent/heartbeat_server.h:55) and the FE-side node liveness
+  tracking that marks backends dead and reroutes work;
+- data plane: the BE<->BE exchange RPCs (gensrc/proto/
+  internal_service.proto:802-851) carrying shuffled chunks over the
+  network with async send buffers (be/src/exec/pipeline/exchange/
+  sink_buffer.h:79).
+
+TPU-first re-design: the DATA plane is not RPC at all — cross-host
+exchange compiles into the SAME XLA collectives used in-slice
+(all_to_all / all_gather / psum over a GLOBAL jax.sharding.Mesh spanning
+processes via jax.distributed). In-slice hops ride ICI; cross-host hops
+ride DCN (TPU pods) or gloo (CPU fleets) — picked by the runtime, not by
+engine code, so one compiled program covers both. Backpressure, framing
+and retry live inside the XLA collective runtime, replacing the
+reference's hand-built sink buffers.
+
+What remains engine-side is the CONTROL plane this module provides:
+  * init_multihost(...)    — join the global mesh (jax.distributed);
+  * ClusterMonitor         — coordinator-side heartbeat registry,
+                             failure detection, on_failure restart hooks;
+  * Heartbeater            — worker-side periodic beat.
+See tests/test_cluster.py (kill-a-worker detection + restart) and
+tests/dcn_worker.py (a real two-process shuffle step over the global
+mesh, driven by test_cluster.py as subprocesses).
+"""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+ALIVE = "ALIVE"
+DEAD = "DEAD"
+
+
+def init_multihost(coordinator_address: str, num_processes: int,
+                   process_id: int, local_device_count: int | None = None):
+    """Join the cross-process device runtime and return the GLOBAL device
+    list. On CPU fleets set local_device_count to fan each process out to
+    N virtual devices (the multi-chip-per-host analog)."""
+    import os
+
+    if local_device_count:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={local_device_count}"
+        ).strip()
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+    return jax.devices()
+
+
+class ClusterMonitor:
+    """Coordinator-side liveness registry (the FE heartbeat mgr analog).
+
+    Workers POST /heartbeat {"id": ...}; a watchdog marks a worker DEAD
+    once its last beat is older than interval_s * miss_limit and fires
+    on_failure(worker_id) EXACTLY ONCE per down transition — the restart
+    hook (respawn the worker, reassign its shards). A worker that beats
+    again after being marked DEAD transitions back to ALIVE."""
+
+    def __init__(self, port: int = 0, interval_s: float = 0.2,
+                 miss_limit: int = 3,
+                 on_failure: Optional[Callable[[str], None]] = None):
+        self.interval_s = interval_s
+        self.miss_limit = miss_limit
+        self.on_failure = on_failure
+        self._lock = threading.Lock()
+        self._beats: dict = {}   # id -> last beat monotonic
+        self._state: dict = {}   # id -> ALIVE | DEAD
+        mon = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/heartbeat" and "id" in body:
+                    mon.beat(str(body["id"]))
+                    self.send_response(200)
+                else:
+                    self.send_response(404)
+                self.end_headers()
+
+            def do_GET(self):
+                if self.path == "/members":
+                    out = json.dumps(mon.members()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    self.wfile.write(out)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._srv = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                    Handler)
+        self.port = self._srv.server_address[1]
+        self._threads = [
+            threading.Thread(target=self._srv.serve_forever, daemon=True),
+            threading.Thread(target=self._watchdog, daemon=True),
+        ]
+        self._stop = threading.Event()
+        for t in self._threads:
+            t.start()
+
+    # --- registry ------------------------------------------------------------
+    def beat(self, worker_id: str):
+        with self._lock:
+            self._beats[worker_id] = time.monotonic()
+            self._state[worker_id] = ALIVE
+
+    def members(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            return {
+                w: {"state": self._state[w],
+                    "age_s": round(now - self._beats[w], 3)}
+                for w in sorted(self._beats)
+            }
+
+    def _watchdog(self):
+        while not self._stop.wait(self.interval_s / 2):
+            deadline = self.interval_s * self.miss_limit
+            fire = []
+            with self._lock:
+                now = time.monotonic()
+                for w, last in self._beats.items():
+                    if now - last > deadline and self._state[w] == ALIVE:
+                        self._state[w] = DEAD
+                        fire.append(w)
+            for w in fire:  # hooks run outside the lock
+                if self.on_failure is not None:
+                    try:
+                        self.on_failure(w)
+                    except Exception:  # noqa: BLE001 — liveness must survive
+                        pass
+
+    def close(self):
+        self._stop.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class Heartbeater:
+    """Worker-side periodic beat (the BE heartbeat answer analog)."""
+
+    def __init__(self, host: str, port: int, worker_id: str,
+                 interval_s: float = 0.2):
+        self.host, self.port = host, port
+        self.worker_id = worker_id
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        body = json.dumps({"id": self.worker_id})
+        while not self._stop.is_set():
+            try:
+                conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=2)
+                conn.request("POST", "/heartbeat", body,
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+                conn.close()
+            except OSError:
+                pass  # coordinator briefly away: keep beating
+            self._stop.wait(self.interval_s)
+
+    def stop(self):
+        """Silence the worker (the crash simulation in tests)."""
+        self._stop.set()
+        self._t.join(timeout=2)

@@ -137,6 +137,11 @@ config.define("spill_batch_rows", 0, True,
               "activation threshold as the batch size)")
 config.define("bench_sf", 1.0, True, "scale factor used by bench.py")
 config.define("profile_queries", True, True, "collect RuntimeProfile for every query")
+config.define("join_probe_strategy", "auto", True,
+              "auto | pallas: route the unique-join probe searchsorted "
+              "ladder through the explicit Pallas kernel "
+              "(ops/pallas_kernels.probe_searchsorted_pallas; interpret "
+              "mode off-TPU) instead of jnp.searchsorted")
 config.define("compilation_cache_dir", "", False,
               "persistent XLA compilation cache directory (survives process "
               "restarts; big win for TPU first-compiles). Set via "

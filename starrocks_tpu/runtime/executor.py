@@ -66,8 +66,12 @@ class DeviceCache:
 
         # UDF create/replace/drop must invalidate EVERY session's compiled
         # plans (callbacks close over the registered callable): the epoch
-        # rides in the cache key so stale programs simply miss
-        key = (key, registry_epoch())
+        # rides in the cache key so stale programs simply miss. Kernel-
+        # strategy flags are baked at TRACE time, so they key too — a SET
+        # segment_strategy/join_probe_strategy must not serve stale traces
+        key = (key, registry_epoch(),
+               config.get("segment_strategy"),
+               config.get("join_probe_strategy"))
         b = self.programs.get(key)
         if b is None:
             b = self.programs[key] = {"last": None, "progs": {}}

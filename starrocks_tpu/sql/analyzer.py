@@ -647,17 +647,29 @@ class Analyzer:
                     return Col(gname)
             if isinstance(e, AggExpr):
                 return Col(agg_name(e))
-            if isinstance(e, Call) and e.fn == "grouping":
+            if isinstance(e, Call) and e.fn in ("grouping", "grouping_id"):
                 if not grouping_mode:
                     return Lit(0)  # no ROLLUP/CUBE/SETS: always base level
-                arg = e.args[0]
-                for i, (gname, gexpr) in enumerate(group_named):
-                    if arg == gexpr or (isinstance(arg, Col)
-                                        and arg.name == gname):
-                        grouping_refs.add(f"__grouping_{i}")
-                        return Col(f"__grouping_{i}")
-                raise AnalyzerError(
-                    f"grouping() argument {arg!r} is not a GROUP BY key")
+
+                def marker(arg):
+                    for i, (gname, gexpr) in enumerate(group_named):
+                        if arg == gexpr or (isinstance(arg, Col)
+                                            and arg.name == gname):
+                            grouping_refs.add(f"__grouping_{i}")
+                            return Col(f"__grouping_{i}")
+                    raise AnalyzerError(
+                        f"{e.fn}() argument {arg!r} is not a GROUP BY key")
+
+                if e.fn == "grouping":
+                    return marker(e.args[0])
+                # grouping_id(a, b, ...) = the markers as a bit field,
+                # first argument most significant (reference semantics)
+                out = None
+                for j, arg in enumerate(e.args):
+                    bit = Call("multiply", marker(arg),
+                               Lit(1 << (len(e.args) - 1 - j)))
+                    out = bit if out is None else Call("add", out, bit)
+                return out if out is not None else Lit(0)
             if isinstance(e, Call):
                 return Call(e.fn, *[replace(a) for a in e.args])
             if isinstance(e, Case):

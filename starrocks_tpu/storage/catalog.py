@@ -266,9 +266,12 @@ class Catalog:
                 h = self.tables[n]
                 # metadata-only contract: computing stats loads + scans the
                 # data — only report tables already resident (ANALYZE-style
-                # warmth); cold stored tables show analyzed=0
-                loaded = getattr(h, "_table", None) is not None \
-                    or getattr(h, "store", None) is None
+                # warmth); cold stored/external tables show analyzed=0
+                from .external import ExternalTableHandle as _Ext
+
+                loaded = getattr(h, "_table", None) is not None or (
+                    getattr(h, "store", None) is None
+                    and not isinstance(h, _Ext))
                 for f in h.schema:
                     if f.type.is_wide:
                         continue
@@ -339,6 +342,184 @@ class Catalog:
                 ("table_name", T.VARCHAR, tn),
                 ("partition_name", T.VARCHAR, pn),
                 ("rows", T.BIGINT, rws),
+            ])
+        if view == "materialized_views":
+            names = sorted(self.mv_defs)
+            fresh = []
+            for n in names:
+                meta = self.mv_meta.get(n)
+                if meta is None:
+                    fresh.append(0)
+                else:
+                    fresh.append(1 if all(
+                        self.versions.get(tb, 0) == v
+                        for tb, v in meta["bases"].items()) else 0)
+            return vtable([
+                ("name", T.VARCHAR, names),
+                ("definition", T.VARCHAR,
+                 [self.mv_defs[n].strip()[:512] for n in names]),
+                ("rows", T.BIGINT,
+                 [self.tables[n].row_count if n in self.tables else 0
+                  for n in names]),
+                ("is_fresh", T.INT, fresh),
+            ])
+        if view == "routines":
+            from ..runtime.udf import get_udf, list_udfs
+
+            names = list_udfs()
+            defs = [get_udf(n) for n in names]
+            return vtable([
+                ("routine_name", T.VARCHAR, names),
+                ("routine_type", T.VARCHAR, ["FUNCTION"] * len(names)),
+                ("data_type", T.VARCHAR, [repr(d.ret) for d in defs]),
+                ("routine_definition", T.VARCHAR,
+                 [d.source[:512] for d in defs]),
+            ])
+        if view in ("session_variables", "global_variables"):
+            from ..runtime.config import config as cfg
+
+            items = cfg.items()
+            return vtable([
+                ("variable_name", T.VARCHAR, [i[0] for i in items]),
+                ("variable_value", T.VARCHAR, [str(i[1]) for i in items]),
+            ])
+        if view in ("table_privileges", "user_privileges"):
+            a = self.auth
+            gr, te, pr = [], [], []
+            if a is not None:
+                for user in sorted(a.grants):
+                    for table, privs in sorted(a.grants[user].items()):
+                        want_global = view == "user_privileges"
+                        if (table == "*") != want_global:
+                            continue
+                        for p in sorted(privs):
+                            gr.append(f"'{user}'@'%'")
+                            te.append(table)
+                            pr.append(p.upper())
+            cols = [("grantee", T.VARCHAR, gr)]
+            if view == "table_privileges":
+                cols.append(("table_name", T.VARCHAR, te))
+            cols.append(("privilege_type", T.VARCHAR, pr))
+            return vtable(cols)
+        if view in ("key_column_usage", "table_constraints"):
+            tn, cn, ct = [], [], []
+            for n in sorted(self.tables):
+                for keys in self.tables[n].unique_keys:
+                    for c in keys:
+                        tn.append(n)
+                        cn.append(c)
+                        ct.append("UNIQUE")
+            if view == "table_constraints":
+                seen = sorted({(t, "UNIQUE") for t in tn})
+                return vtable([
+                    ("table_name", T.VARCHAR, [s[0] for s in seen]),
+                    ("constraint_type", T.VARCHAR, [s[1] for s in seen]),
+                ])
+            return vtable([
+                ("table_name", T.VARCHAR, tn),
+                ("column_name", T.VARCHAR, cn),
+                ("constraint_name", T.VARCHAR, ct),
+            ])
+        if view == "referential_constraints":
+            # no FOREIGN KEY DDL surface: present, empty, typed
+            return vtable([
+                ("constraint_name", T.VARCHAR, []),
+                ("table_name", T.VARCHAR, []),
+                ("referenced_table_name", T.VARCHAR, []),
+            ])
+        if view == "engines":
+            return vtable([
+                ("engine", T.VARCHAR, ["OLAP_TPU"]),
+                ("support", T.VARCHAR, ["DEFAULT"]),
+                ("comment", T.VARCHAR,
+                 ["columnar chunks compiled to one XLA program per query"]),
+            ])
+        if view == "character_sets":
+            return vtable([
+                ("character_set_name", T.VARCHAR, ["utf8mb4"]),
+                ("default_collate_name", T.VARCHAR, ["utf8mb4_bin"]),
+                ("maxlen", T.BIGINT, [4]),
+            ])
+        if view == "collations":
+            return vtable([
+                ("collation_name", T.VARCHAR, ["utf8mb4_bin"]),
+                ("character_set_name", T.VARCHAR, ["utf8mb4"]),
+                ("is_default", T.VARCHAR, ["Yes"]),
+            ])
+        if view == "external_tables":
+            from .external import ExternalTableHandle
+
+            rows = [(n, h.location) for n, h in sorted(self.tables.items())
+                    if isinstance(h, ExternalTableHandle)]
+            return vtable([
+                ("table_name", T.VARCHAR, [r[0] for r in rows]),
+                ("location", T.VARCHAR, [r[1] for r in rows]),
+            ])
+        if view == "rowsets":
+            tn, rid, fn, rws, prt = [], [], [], [], []
+            for n in sorted(self.tables):
+                h = self.tables[n]
+                store = getattr(h, "store", None)
+                if store is None:
+                    continue
+                m = store.read_manifest(n)
+                for rs in m["rowsets"]:
+                    for f in rs["files"]:
+                        tn.append(n)
+                        rid.append(int(rs["id"]))
+                        fn.append(f.get("file", ""))
+                        rws.append(int(f.get("rows", 0)))
+                        prt.append(int(f.get("part", rs.get("part", 0))
+                                       or 0))
+            return vtable([
+                ("table_name", T.VARCHAR, tn),
+                ("rowset_id", T.BIGINT, rid),
+                ("file", T.VARCHAR, fn),
+                ("rows", T.BIGINT, rws),
+                ("partition_id", T.BIGINT, prt),
+            ])
+        if view in ("loads", "compactions"):
+            # the journal IS the history (op=insert/upsert vs op=compact)
+            ops = ({"insert", "upsert"} if view == "loads"
+                   else {"compact"})
+            store = next((getattr(h, "store", None)
+                          for h in self.tables.values()
+                          if getattr(h, "store", None) is not None), None)
+            sq, tn, rws, kind = [], [], [], []
+            if store is not None:
+                for op in store.replay():
+                    if op.get("op") in ops:
+                        sq.append(int(op.get("seq", 0)))
+                        tn.append(op.get("table", ""))
+                        rws.append(int(op.get("rows", 0)))
+                        kind.append(op["op"].upper())
+            return vtable([
+                ("seq", T.BIGINT, sq),
+                ("table_name", T.VARCHAR, tn),
+                ("rows", T.BIGINT, rws),
+                ("type", T.VARCHAR, kind),
+            ])
+        if view == "column_statistics":
+            from .external import ExternalTableHandle
+
+            tn, cn, ndv = [], [], []
+            for n in sorted(self.tables):
+                h = self.tables[n]
+                if getattr(h, "_table", None) is None and (
+                        getattr(h, "store", None) is not None
+                        or isinstance(h, ExternalTableHandle)):
+                    continue  # metadata-only contract (see "statistics"):
+                    # computing NDV would LOAD cold stored/external data
+                for f in h.schema:
+                    if f.type.is_wide:
+                        continue
+                    tn.append(n)
+                    cn.append(f.name)
+                    ndv.append(int(h.column_ndv(f.name) or 0))
+            return vtable([
+                ("table_name", T.VARCHAR, tn),
+                ("column_name", T.VARCHAR, cn),
+                ("ndv", T.BIGINT, ndv),
             ])
         if view == "query_log":
             log = self.query_log[-1000:]

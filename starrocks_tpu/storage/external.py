@@ -1,12 +1,15 @@
-"""Read-only external parquet tables (the connector framework's first axis).
+"""Read-only external tables: parquet and ORC files (the connector
+framework's file axis).
 
 Reference behavior: the connector SPI + file external tables
-(be/src/connector/, fe/fe-core/.../connector/ — federation over files the
-engine does not own). Re-designed to the engine's host-table model: an
-external table is a parquet directory/glob whose schema is read from file
-footers; data loads lazily through the same HostTable path as native
-tables, so every operator (joins, aggregates, MV definitions, sketches)
-works unchanged. Writes are rejected — the files belong to someone else.
+(be/src/connector/, fe/fe-core/.../connector/, the ORC reader
+be/src/formats/orc/ — federation over files the engine does not own).
+Re-designed to the engine's host-table model: an external table is a
+parquet/ORC directory/glob whose schema is read from file footers; data
+loads lazily through the same HostTable path as native tables, so every
+operator (joins, aggregates, MV definitions, sketches) works unchanged.
+Formats detect per file by extension. Writes are rejected — the files
+belong to someone else.
 """
 
 from __future__ import annotations
@@ -19,6 +22,8 @@ import numpy as np
 from ..column import HostTable, Schema
 from .catalog import TableHandle
 
+_EXTS = (".parquet", ".orc")
+
 
 def _resolve(path: str) -> list:
     if any(ch in path for ch in "*?["):
@@ -26,10 +31,41 @@ def _resolve(path: str) -> list:
     elif os.path.isdir(path):
         files = sorted(
             os.path.join(path, f) for f in os.listdir(path)
-            if f.endswith(".parquet"))
+            if f.endswith(_EXTS))
     else:
         files = [path]
     return [f for f in files if os.path.isfile(f)]
+
+
+def _file_schema(path: str):
+    """Arrow schema from the footer only (no data IO)."""
+    if path.endswith(".orc"):
+        import pyarrow.orc as po
+
+        return po.ORCFile(path).schema
+    import pyarrow.parquet as pq
+
+    return pq.read_schema(path)
+
+
+def _file_rows(path: str) -> int:
+    if path.endswith(".orc"):
+        import pyarrow.orc as po
+
+        return po.ORCFile(path).nrows
+    import pyarrow.parquet as pq
+
+    return pq.read_metadata(path).num_rows
+
+
+def _read_file(path: str):
+    if path.endswith(".orc"):
+        import pyarrow.orc as po
+
+        return po.ORCFile(path).read()
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path)
 
 
 class ExternalTableHandle(TableHandle):
@@ -38,7 +74,7 @@ class ExternalTableHandle(TableHandle):
 
     def __init__(self, name: str, location: str):
         if not _resolve(location):
-            raise ValueError(f"no parquet files match {location!r}")
+            raise ValueError(f"no parquet/ORC files match {location!r}")
         super().__init__(name, None)
         self.location = location
         self._schema: Schema | None = None
@@ -48,13 +84,11 @@ class ExternalTableHandle(TableHandle):
     def schema(self) -> Schema:
         if self._schema is None:
             # footers only: DESCRIBE/information_schema must not read data
-            import pyarrow.parquet as pq
-
             files = _resolve(self.location)
             if not files:
                 raise ValueError(
-                    f"no parquet files match {self.location!r}")
-            empty = pq.read_schema(files[0]).empty_table()
+                    f"no parquet/ORC files match {self.location!r}")
+            empty = _file_schema(files[0]).empty_table()
             self._schema = HostTable.from_arrow(empty).schema
         return self._schema
 
@@ -69,21 +103,17 @@ class ExternalTableHandle(TableHandle):
         if self._table is not None:
             return self._table.num_rows
         if self._meta_rows is None:  # cached: footer IO is per-file
-            import pyarrow.parquet as pq
-
             self._meta_rows = sum(
-                pq.read_metadata(f).num_rows
-                for f in _resolve(self.location))
+                _file_rows(f) for f in _resolve(self.location))
         return self._meta_rows
 
     def _load(self):
         import pyarrow as pa
-        import pyarrow.parquet as pq
 
         files = _resolve(self.location)  # fresh: the dir may have changed
         if not files:
-            raise ValueError(f"no parquet files match {self.location!r}")
-        tables = [pq.read_table(f) for f in files]
+            raise ValueError(f"no parquet/ORC files match {self.location!r}")
+        tables = [_read_file(f) for f in files]
         merged = pa.concat_tables(tables, promote_options="default")
         self._table = HostTable.from_arrow(merged)
         self._schema = self._table.schema

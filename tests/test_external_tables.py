@@ -107,3 +107,31 @@ def test_external_rejects_load_csv_and_alter(ext_dir, tmp_path):
         s.load_csv("lake", str(csv))
     with _pt.raises(ValueError, match="EXTERNAL"):
         s.sql("alter table lake add column extra int")
+
+
+def test_external_orc_table(tmp_path):
+    """ORC external tables (reference: be/src/formats/orc/) read through
+    the same lazy host-table path as parquet; mixed directories merge."""
+    import pyarrow as pa
+    import pyarrow.orc as po
+
+    d = tmp_path / "orcdir"
+    d.mkdir()
+    t1 = pa.table({"k": [1, 2, 3], "v": ["a", "b", "a"]})
+    t2 = pa.table({"k": [4, 5], "v": ["c", "a"]})
+    po.write_table(t1, str(d / "part1.orc"))
+    po.write_table(t2, str(d / "part2.orc"))
+
+    s = Session()
+    s.sql(f"create external table eorc from '{d}'")
+    # schema/rowcount from footers only
+    assert s.sql("describe eorc") == [
+        ("k", "BIGINT", "YES"), ("v", "VARCHAR", "YES")]
+    assert s.sql("select count(*) from eorc").rows() == [(5,)]
+    assert s.sql(
+        "select v, count(*) c from eorc group by v order by v").rows() == [
+        ("a", 3), ("b", 1), ("c", 1)]
+    assert s.sql(
+        "select sum(k) from eorc where v = 'a'").rows() == [(9,)]
+    with pytest.raises(ValueError, match="EXTERNAL"):
+        s.sql("insert into eorc values (9, 'z')")

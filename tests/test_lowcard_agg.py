@@ -119,3 +119,38 @@ def test_pallas_strategy_end_to_end(cat):
         assert br[0] == pr[0]
         for bv, pv in zip(br[1:], pr[1:]):
             assert pv == pytest.approx(bv, rel=1e-5)
+
+
+def test_pallas_join_probe_parity():
+    """The second Pallas kernel (probe_searchsorted_pallas) matches
+    jnp.searchsorted in interpret mode, standalone and through a full
+    SQL join flipped on via SET join_probe_strategy='pallas'."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from starrocks_tpu.ops.pallas_kernels import probe_searchsorted_pallas
+
+    rng = np.random.RandomState(3)
+    build = np.sort(rng.randint(0, 10_000, 512).astype(np.int64))
+    probe = rng.randint(-100, 10_100, 4096).astype(np.int64)
+    got = np.asarray(probe_searchsorted_pallas(
+        jnp.asarray(build), jnp.asarray(probe), block=1024, interpret=True))
+    exp = np.searchsorted(build, probe, side="left")
+    assert (got == exp).all()
+
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.session import Session
+
+    s = Session()
+    s.sql("create table dimp (k int, name varchar, primary key (k))")
+    s.sql("insert into dimp values (1, 'a'), (2, 'b'), (3, 'c')")
+    s.sql("create table facts (k int, v int)")
+    s.sql("insert into facts values (1, 10), (3, 30), (3, 31), (9, 90)")
+    q = ("select name, sum(v) sv from facts, dimp "
+         "where facts.k = dimp.k group by name order by name")
+    base = s.sql(q).rows()
+    s.sql("set join_probe_strategy = 'pallas'")
+    try:
+        assert s.sql(q).rows() == base == [("a", 10), ("c", 61)]
+    finally:
+        config.set("join_probe_strategy", "auto")

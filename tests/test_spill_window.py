@@ -79,3 +79,104 @@ def test_spill_window_null_partition_keys_one_group():
         config.set("batch_rows_threshold", 0)
         config.set("spill_batch_rows", 0)
     assert sorted(spill, key=str) == sorted(base, key=str)
+
+
+def test_streaming_window_skewed_partition_beyond_budget():
+    """One PARTITION BY group holds ~90% of rows — the Grace hash-split
+    would need the whole partition resident, so the STREAMING path
+    (global sort + peer-cut chunks + carried running state) must kick in
+    and still match pandas exactly (runtime/batched.py
+    execute_streaming_window)."""
+    import numpy as np
+    import pandas as pd
+
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.session import Session
+    from starrocks_tpu.column import HostTable
+
+    rng = np.random.RandomState(7)
+    n = 4000
+    g = np.where(rng.rand(n) < 0.9, 1, rng.randint(2, 6, n)).astype(np.int64)
+    o = rng.randint(0, 300, n).astype(np.int64)  # many peer ties
+    v = rng.randint(-50, 50, n).astype(np.int64)
+
+    s = Session()
+    s.catalog.register("skw", HostTable.from_pydict(
+        {"g": g, "o": o, "v": v}))
+    # one window spec (one LWindow node) of peer-deterministic functions:
+    # row_number over ties would differ between engines
+    q = ("select g, o, v, "
+         "rank() over (partition by g order by o) rk, "
+         "dense_rank() over (partition by g order by o) dk, "
+         "sum(v) over (partition by g order by o) rs, "
+         "min(v) over (partition by g order by o) rmin, "
+         "count(v) over (partition by g order by o) rc "
+         "from skw")
+
+    config.set("batch_rows_threshold", 512)
+    config.set("spill_batch_rows", 512)
+    try:
+        got = s.sql(q)
+        prof = s.last_profile.render()
+        assert "stream_chunks" in prof, prof[:800]
+        rows = sorted(got.rows())
+    finally:
+        config.set("batch_rows_threshold", 0)
+        config.set("spill_batch_rows", 0)
+
+    df = pd.DataFrame({"g": g, "o": o, "v": v})
+    df = df.sort_values(["g", "o"], kind="stable").reset_index(drop=True)
+    gb = df.groupby("g", sort=False)
+    df["rk"] = gb["o"].rank(method="min").astype(np.int64)
+    df["dk"] = gb["o"].rank(method="dense").astype(np.int64)
+    # default RANGE frame: peers included -> per (g, o) totals, cumulative
+    agg = df.groupby(["g", "o"])["v"].agg(["sum", "min", "count"])
+    cum = agg.groupby(level=0).cumsum()
+    df = df.join(cum.rename(columns={
+        "sum": "rs", "min": "rmin2", "count": "rc"}), on=["g", "o"])
+    df["rmin"] = df.join(agg.groupby(level=0)["min"].cummin().rename(
+        "rmin3"), on=["g", "o"])["rmin3"]
+    exp_rows = sorted(
+        tuple(r) for r in df[
+            ["g", "o", "v", "rk", "dk", "rs", "rmin", "rc"]].itertuples(
+            index=False))
+    assert len(rows) == len(exp_rows)
+    mismatch = [i for i, (a, b) in enumerate(zip(rows, exp_rows))
+                if tuple(a) != tuple(b)]
+    assert not mismatch, (mismatch[:5], rows[mismatch[0]],
+                          exp_rows[mismatch[0]]) if mismatch else None
+
+
+def test_streaming_window_null_carry():
+    """Locally-NULL running values in a later chunk must surface the
+    CARRIED state (the partition had live inputs in earlier chunks)."""
+    import numpy as np
+
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.session import Session
+    from starrocks_tpu.column import HostTable
+
+    n = 1200
+    g = np.zeros(n, np.int64)
+    o = np.arange(n, dtype=np.int64)
+    # the second half of the partition is all NULL (None -> NULL)
+    v = [float(i) if i < 600 else None for i in range(n)]
+    s = Session()
+    s.catalog.register("nls", HostTable.from_pydict(
+        {"g": g, "o": o, "v": v}))
+    config.set("batch_rows_threshold", 256)
+    config.set("spill_batch_rows", 256)
+    try:
+        rows = s.sql(
+            "select o, sum(v) over (partition by g order by o) rs, "
+            "min(v) over (partition by g order by o) rm from nls"
+        ).rows()
+    finally:
+        config.set("batch_rows_threshold", 0)
+        config.set("spill_batch_rows", 0)
+    got = {r[0]: (r[1], r[2]) for r in rows}
+    full = float(np.arange(600).sum())
+    assert got[599] == (full, 0.0)
+    # rows in the NULL tail carry the partition's running state forward
+    assert got[700] == (full, 0.0)
+    assert got[1199] == (full, 0.0)
