@@ -322,7 +322,10 @@ def _f_map_from_arrays(cc, karr, varr):
         return dataclasses.replace(a, data=jnp.concatenate(
             [jnp.asarray(lmin, d.dtype)[:, None], d[:, 1:]], axis=1))
 
-    return _map_of(clamp(karr), clamp(varr))
+    # duplicate keys dedupe at construction, keeping the LAST occurrence —
+    # so map_size/map_keys/element_at all agree with the last-wins rule
+    # map_concat and distinct_map_keys already implement
+    return _f_distinct_map_keys(cc, _map_of(clamp(karr), clamp(varr)))
 
 
 @function("map_keys")
@@ -365,9 +368,17 @@ def _f_element_at(cc, x, k):
         _, vvals, _, velem = _arr(x.values)
         n, kk = kvals.shape
         target = jnp.asarray(kv.data, kvals.dtype)
+        if target.ndim == 1:
+            # per-row COLUMN key: broadcast along the lane axis (a bare
+            # (n,) == (n, kk) compare would either raise or, when n == kk,
+            # silently match along the wrong axis)
+            target = target[:, None]
         hit = mask & (kvals == target)
-        idx = jnp.argmax(hit, axis=1)
+        # duplicate keys: LAST occurrence wins (reference semantics, and
+        # what map_concat/distinct_map_keys already implement)
+        idx = kk - 1 - jnp.argmax(hit[:, ::-1], axis=1)
         found = jnp.any(hit, axis=1)
+        idx = jnp.where(found, idx, 0)
         got = jnp.take_along_axis(vvals, idx[:, None], axis=1)[:, 0]
         valid = _and_valid(x.valid, kv.valid, found)
         return EVal(got, valid, velem if not velem.is_string else T.VARCHAR,

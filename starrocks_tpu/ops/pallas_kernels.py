@@ -80,6 +80,68 @@ def segment_sum_pallas(gid, values, num_groups: int, block: int = 2048,
     return out[:num_groups]
 
 
+# --- TopN partial select: per-block selection for threshold TopN -------------
+
+
+def _topn_block_kernel(neg_ref, vals_ref, idx_ref, *, k: int, block: int):
+    """Top-k selection over one row block: k rounds of (max, first-argmax,
+    mask out) — branch-free, ties resolve to the LOWEST index so the
+    candidate stream reproduces a stable ascending sort of the original
+    keys. The bitonic-network alternative sorts the whole block (log^2 B
+    stages); for k << B the selection ladder does k reductions instead,
+    which is the partial-select shape the reference's heap TopN
+    (chunks_sorter_topn.h) amortizes on CPU."""
+    import jax.experimental.pallas as pl
+    import jax.numpy as jnp
+
+    base = pl.program_id(0) * block
+    x = neg_ref[...]                      # [B] int64, bigger = better
+    lanes = jnp.arange(block, dtype=jnp.int32)
+    floor = jnp.iinfo(jnp.int64).min
+    vals, idxs = [], []
+    for _ in range(k):                    # static unroll
+        mv = jnp.max(x)
+        pos = jnp.argmax(x)               # first occurrence on ties
+        vals.append(mv)
+        idxs.append(base + pos)
+        x = jnp.where(lanes == pos, floor, x)
+    vals_ref[...] = jnp.stack(vals)
+    idx_ref[...] = jnp.stack(idxs).astype(jnp.int32)
+
+
+def topn_select_pallas(neg, k: int, block: int = 1024,
+                       interpret: bool = False):
+    """Per-block top-k candidates of `neg` ([N] int64, LARGEST-first):
+    returns (vals [nblocks*k], idx [nblocks*k]) — the caller reduces the
+    candidate set with one final top_k (k·nblocks rows instead of N ever
+    reaching it). Flag-gated behind `SET topn_strategy='pallas'`; interpret
+    mode off-TPU so correctness is testable without hardware."""
+    import functools
+
+    import jax.experimental.pallas as pl
+
+    n = neg.shape[0]
+    assert n % block == 0, f"rows {n} must be a multiple of block {block}"
+    assert k <= block, f"k {k} must fit one block {block}"
+    grid = (n // block,)
+    kernel = functools.partial(_topn_block_kernel, k=k, block=block)
+    vals, idx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((block,), lambda i: (i,))],
+        out_specs=[
+            pl.BlockSpec((k,), lambda i: (i,)),
+            pl.BlockSpec((k,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n // block * k,), jnp.int64),
+            jax.ShapeDtypeStruct((n // block * k,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(neg)
+    return vals, idx
+
+
 # --- join probe: the searchsorted ladder as an explicit kernel ---------------
 
 

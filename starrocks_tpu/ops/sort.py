@@ -3,17 +3,38 @@
 Reference behavior: be/src/exec/chunks_sorter.h:44 (full sort),
 chunks_sorter_topn.h:26 (heap TopN), and the merge-path parallel merge
 kernels (be/src/compute_env/sorting/merge_path.h). On TPU, XLA's lax.sort is
-already a parallel bitonic-class sort, so both full sort and TopN are one
-fused lexsort; the distributed merge phase lives in parallel/ (gather +
-re-sort, or all_gather of per-shard TopN).
+already a parallel bitonic-class sort; this module narrows what feeds it:
+
+- packed-key sort: bounded keys (dict codes, bools, stats-bounded ints —
+  the same domain machinery as the aggregate's packed-gid path) encode into
+  ONE order-preserving int64 (descending via complement, NULLS FIRST/LAST
+  via a sentinel bit per nullable key, dead rows -> INT64_MAX), so the
+  multi-operand lexsort comparator collapses to a single int64 compare;
+- threshold TopN: ORDER BY .. LIMIT k over a packed key runs a partial
+  select (lax.top_k, or the per-block Pallas selection kernel behind
+  `SET topn_strategy='pallas'`) — rows past the running k-th key never
+  reach a gather, and the output capacity SHRINKS to ~k (the reference's
+  heap-TopN runtime filter re-designed branch-free);
+- the distributed merge phase lives in parallel/ (gather + re-sort, or
+  all_gather of per-shard TopN).
 """
 
 from __future__ import annotations
 
+import time
+
+import jax
 import jax.numpy as jnp
 
-from ..column.column import Chunk
+from ..column.column import Chunk, pad_capacity
 from .common import eval_keys
+
+_I64MAX = jnp.iinfo(jnp.int64).max
+
+# threshold top-N only pays while k stays far below the input size; past
+# this the full packed argsort is at least as good (and top_k's k*log(n)
+# candidate handling stops winning)
+TOPN_MAX_K = 4096
 
 
 def sort_operands(keys, sort_keys) -> list:
@@ -45,21 +66,163 @@ def sort_operands(keys, sort_keys) -> list:
     return ops
 
 
-def sort_chunk(chunk: Chunk, sort_keys, limit: int | None = None) -> Chunk:
+def packed_order_key(keys, sort_keys, live):
+    """ONE order-preserving int64 per row encoding (live-first, key order),
+    or None when a key is unbounded / the widths overflow 62 bits.
+
+    Per key (most-significant first): value bits = (v - lo) for ASC,
+    (hi - v) for DESC; nullable keys prepend one sentinel bit placing the
+    NULL block first or last. Dead rows take INT64_MAX (always past every
+    live encoding: total live bits <= 62). Reuses the aggregate's
+    _key_domain so "packable" can never diverge between grouping and
+    ordering (sql/physical.py:choose_key_packing is the join-side analog
+    of the same bit-width discipline)."""
+    from ..runtime.config import config as _cfg
+
+    if not keys or not _cfg.get("enable_packed_sort_keys"):
+        return None
+    from .aggregate import _key_domain
+
+    parts = []
+    total_bits = 0
+    for k, (_, asc, nulls_first) in zip(keys, sort_keys):
+        dom = _key_domain(k)
+        if dom is None:
+            return None
+        base, lo = dom
+        base = max(int(base), 1)
+        w = max((base - 1).bit_length(), 1)
+        code = jnp.clip(jnp.asarray(k.data, jnp.int64) - lo, 0, base - 1)
+        if not asc:
+            code = (base - 1) - code
+        if k.valid is not None:
+            # sentinel bit above the value bits: NULLs form one block at
+            # the requested end, value bits of NULL rows zero out
+            null_bit = 0 if nulls_first else 1
+            bit = jnp.where(k.valid, 1 - null_bit, null_bit)
+            code = jnp.where(k.valid, code, 0) | (
+                jnp.asarray(bit, jnp.int64) << w)
+            w += 1
+        parts.append((code, w))
+        total_bits += w
+        if total_bits > 62:
+            return None
+    packed = jnp.zeros((live.shape[0],), jnp.int64)
+    for code, w in parts:
+        packed = (packed << w) | code
+    return jnp.where(live, packed, _I64MAX)
+
+
+# --- sort timing (diagnostics; see runtime/config.py enable_sort_timing) ----
+
+# host perf_counter stamps appended by ordered io_callbacks embedded in the
+# compiled program; the executor drains PAIRS (before, after) into the
+# query profile as 'sort_ms'
+SORT_STAMPS: list = []
+
+
+def drain_sort_stamps() -> float:
+    """Total seconds across (before, after) stamp pairs recorded since the
+    last drain (unpaired trailing stamp, if any, is dropped)."""
+    stamps, SORT_STAMPS[:] = SORT_STAMPS[:], []
+    total = 0.0
+    for i in range(0, len(stamps) - 1, 2):
+        total += stamps[i + 1] - stamps[i]
+    return total
+
+
+def _stamp(_):
+    SORT_STAMPS.append(time.perf_counter())
+    import numpy as np
+
+    return np.int32(0)
+
+
+def _timed(fn, operand):
+    """fn(operand) bracketed by ordered host timestamp callbacks when
+    enable_sort_timing is on. The stamps are data-dependent on the sort's
+    input and output, so the measured interval covers the sort (XLA may
+    still schedule neighbors inside it — this is a diagnostic, not a
+    profiler)."""
+    from ..runtime.config import config as _cfg
+
+    if not _cfg.get("enable_sort_timing"):
+        return fn(operand)
+    from jax.experimental import io_callback
+
+    probe = operand[0] if isinstance(operand, tuple) else operand
+    t0 = io_callback(_stamp, jax.ShapeDtypeStruct((), jnp.int32),
+                     probe[:1], ordered=True)
+    if isinstance(operand, tuple):
+        operand = (operand[0] + jnp.asarray(t0 * 0, operand[0].dtype),
+                   ) + operand[1:]
+    else:
+        operand = operand + jnp.asarray(t0 * 0, operand.dtype)
+    out = fn(operand)
+    t1 = io_callback(_stamp, jax.ShapeDtypeStruct((), jnp.int32),
+                     out[:1], ordered=True)
+    return out + jnp.asarray(t1 * 0, out.dtype)
+
+
+# --- TopN partial select -----------------------------------------------------
+
+
+def topn_order(packed, kk: int):
+    """Indices of the kk smallest packed keys, ascending, stable on ties
+    (lax.top_k breaks ties by lower index — the same order a stable
+    ascending argsort yields). `~packed` reverses int64 order exactly
+    (monotone bijection; negation would overflow on INT64_MIN)."""
+    from ..runtime.config import config as _cfg
+
+    neg = ~packed
+    if _cfg.get("topn_strategy") == "pallas" and packed.shape[0] % 1024 == 0 \
+            and kk <= 1024:
+        from .pallas_kernels import topn_select_pallas
+
+        cv, ci = topn_select_pallas(
+            neg, kk, interpret=jax.default_backend() != "tpu")
+        _, pos = jax.lax.top_k(cv, kk)
+        return ci[pos]
+    _, idx = jax.lax.top_k(neg, kk)
+    return idx
+
+
+def sort_chunk(chunk: Chunk, sort_keys, limit: int | None = None,
+               counters: dict | None = None) -> Chunk:
     """sort_keys: tuple of (expr, asc: bool, nulls_first: bool).
 
     Dead rows always sort last; output sel marks the first n (or limit) rows.
-    """
+    With a packable key and a small LIMIT the output capacity SHRINKS to
+    ~pad_capacity(limit) — the threshold top-N path never materializes
+    pruned rows. `counters` (when given) receives device scalars the
+    executor turns into profile counters ('topn_rows_pruned')."""
     cap = chunk.capacity
     live = chunk.sel_mask()
     keys = eval_keys(chunk, tuple(e for e, _, _ in sort_keys))
+    n = jnp.sum(live)
 
-    ops = sort_operands(keys, sort_keys)
-    ops.append(jnp.asarray(~live, jnp.int8))  # live rows first
-    order = jnp.lexsort(tuple(ops))
+    from ..runtime.config import config as _cfg
+
+    strategy = _cfg.get("topn_strategy")
+    packed = None if strategy == "lexsort" else packed_order_key(
+        keys, sort_keys, live)
+    if packed is not None:
+        if (limit is not None and 0 < limit <= TOPN_MAX_K
+                and pad_capacity(limit) < cap):
+            kk = pad_capacity(limit)
+            order = _timed(lambda p: topn_order(p, kk), packed)
+            out = chunk.take(order)
+            k = jnp.minimum(n, limit)
+            if counters is not None:
+                counters["topn_rows_pruned"] = jnp.maximum(n - limit, 0)
+            return out.with_sel(jnp.arange(kk) < k)
+        order = _timed(lambda p: jnp.argsort(p, stable=True), packed)
+    else:
+        ops = sort_operands(keys, sort_keys)
+        ops.append(jnp.asarray(~live, jnp.int8))  # live rows first
+        order = _timed(lambda t: jnp.lexsort(t), tuple(ops))
 
     out = chunk.take(order)
-    n = jnp.sum(live)
     k = n if limit is None else jnp.minimum(n, limit)
     sel = jnp.arange(cap) < k
     return out.with_sel(sel)

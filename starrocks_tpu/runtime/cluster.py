@@ -73,7 +73,12 @@ class ClusterMonitor:
 
     def __init__(self, port: int = 0, interval_s: float = 0.2,
                  miss_limit: int = 3,
-                 on_failure: Optional[Callable[[str], None]] = None):
+                 on_failure: Optional[Callable[[str], None]] = None,
+                 bind_host: str = "0.0.0.0"):
+        """bind_host defaults to all interfaces so workers on OTHER hosts
+        can reach /heartbeat (a 127.0.0.1 bind would silently limit the
+        failure detector to same-machine workers); pass '127.0.0.1' to
+        keep a test monitor loopback-only."""
         self.interval_s = interval_s
         self.miss_limit = miss_limit
         self.on_failure = on_failure
@@ -107,7 +112,7 @@ class ClusterMonitor:
             def log_message(self, *a):  # quiet
                 pass
 
-        self._srv = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+        self._srv = http.server.ThreadingHTTPServer((bind_host, port),
                                                     Handler)
         self.port = self._srv.server_address[1]
         self._threads = [

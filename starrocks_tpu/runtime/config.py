@@ -137,6 +137,28 @@ config.define("spill_batch_rows", 0, True,
               "activation threshold as the batch size)")
 config.define("bench_sf", 1.0, True, "scale factor used by bench.py")
 config.define("profile_queries", True, True, "collect RuntimeProfile for every query")
+config.define("enable_packed_sort_keys", True, True,
+              "pack bounded ORDER BY / window sort keys (dict codes, "
+              "bools, stats-bounded ints) into ONE order-preserving int64 "
+              "so multi-operand lexsorts become a single-key argsort "
+              "(descending via complement, NULLS FIRST/LAST via a "
+              "sentinel bit per nullable key)")
+config.define("topn_strategy", "auto", True,
+              "auto | lexsort | pallas: ORDER BY .. LIMIT k strategy for "
+              "packable keys. auto = threshold top-N (lax.top_k partial "
+              "select, prunes rows past the k-th key before any gather); "
+              "pallas routes the partial select through the explicit "
+              "per-block Pallas selection kernel (interpret mode off-TPU); "
+              "lexsort forces the full multi-operand sort")
+config.define("enable_window_topn", True, True,
+              "rewrite rank()/row_number()/dense_rank() <= k filters over "
+              "a window into per-partition segmented top-N pruning (the "
+              "TopN runtime-filter analog: downstream sorts run over "
+              "~k*partitions rows instead of the full window input)")
+config.define("enable_sort_timing", False, True,
+              "sandwich device sorts between ordered host callbacks and "
+              "report per-query 'sort_ms' profile counters (adds host "
+              "sync points: diagnostics only, keep off for benchmarks)")
 config.define("join_probe_strategy", "auto", True,
               "auto | pallas: route the unique-join probe searchsorted "
               "ladder through the explicit Pallas kernel "

@@ -13,11 +13,10 @@ from __future__ import annotations
 
 import jax
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..column import Chunk
-from ..parallel.mesh import make_mesh
+from ..parallel.mesh import make_mesh, shard_map
 from ..sql.distributed import REPLICATED, compile_distributed
 from .executor import Executor
 from .profile import RuntimeProfile

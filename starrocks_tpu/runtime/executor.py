@@ -71,7 +71,11 @@ class DeviceCache:
         # segment_strategy/join_probe_strategy must not serve stale traces
         key = (key, registry_epoch(),
                config.get("segment_strategy"),
-               config.get("join_probe_strategy"))
+               config.get("join_probe_strategy"),
+               # sort-subsystem knobs are likewise baked at trace time
+               config.get("topn_strategy"),
+               config.get("enable_packed_sort_keys"),
+               config.get("enable_sort_timing"))
         b = self.programs.get(key)
         if b is None:
             b = self.programs[key] = {"last": None, "progs": {}}
@@ -281,14 +285,17 @@ class Executor:
         QUERIES_TOTAL.inc()
         try:
             with profile.timer("optimize"):
-                opt = self.cache.opt_plans.get(plan)
+                # plan-shaping flags key the cache (SET enable_window_topn
+                # must not serve a plan rewritten under the old setting)
+                opt_key = (plan, config.get("enable_window_topn"))
+                opt = self.cache.opt_plans.get(opt_key)
                 if opt is None:
                     opt = optimize(plan, self.catalog)
-                    self.cache.opt_plans[plan] = opt
+                    self.cache.opt_plans[opt_key] = opt
                     while len(self.cache.opt_plans) > DeviceCache.MAX_CACHED_PLANS:
                         self.cache.opt_plans.popitem(last=False)
                 else:
-                    self.cache.opt_plans.move_to_end(plan)
+                    self.cache.opt_plans.move_to_end(opt_key)
                 # subquery resolution executes data-dependent sub-plans —
                 # never cached
                 plan = self._resolve_scalar_subqueries(opt)
@@ -530,6 +537,7 @@ class Executor:
                         (n, fn, fix_expr(a) if a is not None else None, *rest)
                         for n, fn, a, *rest in p.funcs
                     ),
+                    p.limit,
                 )
             # any other node (LUnion, LUnnest, ...): recurse structurally so
             # markers under e.g. a UNION branch's HAVING still resolve
@@ -550,15 +558,22 @@ class Executor:
         headroom = config.get("join_expand_headroom")
         fail_point("executor::before_run")
         prev_counts: dict = {}  # last attempt's observed true counts
+        from ..ops.sort import drain_sort_stamps
+
         for attempt in range(max_recompiles):
+            drain_sort_stamps()  # discard stamps of failed/other attempts
             p = profile.child(f"attempt_{attempt}")
             with p.timer("compile_and_run"):
                 out, keyed_checks = attempt_fn(caps, p)
             p.set_info("capacities", dict(caps.values))
             floors = {k[len("~floor_"):]: int(v) for k, v in keyed_checks
                       if k.startswith("~floor_")}
+            # "~ctr_<name>[@<node>]" entries are device-computed PROFILE
+            # counters riding the checks channel (rows pruned by top-N
+            # thresholding etc.) — never capacity overflows
+            ctrs = [(k, v) for k, v in keyed_checks if k.startswith("~ctr_")]
             keyed_checks = [(k, v) for k, v in keyed_checks
-                            if not k.startswith("~floor_")]
+                            if not k.startswith(("~floor_", "~ctr_"))]
             overflow = False
             for key, v in keyed_checks:
                 if v > caps.values.get(key, -1):
@@ -589,6 +604,12 @@ class Executor:
             prev_counts.update(keyed_checks)
             if not overflow:
                 profile.add_counter("recompiles", attempt)
+                for k, v in ctrs:  # only the surviving attempt's counters
+                    profile.add_counter(k[len("~ctr_"):].split("@")[0],
+                                        int(v))
+                sort_s = drain_sort_stamps()
+                if sort_s:
+                    profile.add_counter("sort_ms", sort_s * 1000.0, "ms")
                 # tighten grossly over-seeded capacities for the NEXT run
                 # (estimate-seeded shrink/join caps can be 100x the true
                 # count): the next execution compiles once at the tight

@@ -834,6 +834,15 @@ class Analyzer:
 
         full = tuple(range(n))
         levels = []
+        # ROLLUP levels are PREFIXES in decreasing order: level k can
+        # re-aggregate level k+1's (10-100x smaller) output instead of the
+        # base — sum/count/min/max merges are associative, and dropped-key
+        # ride-alongs are either the finer level's group keys or its own
+        # min() outputs. TPC-DS q67: 8 re-aggregations over the 440k-group
+        # base become one 440k re-agg plus 7 tiny ones. CUBE/GROUPING SETS
+        # subsets aren't nested, so they keep aggregating from the base.
+        chain = mode[0] == "rollup"
+        prev_lvl = None
         for subset in subsets:
             sset = frozenset(subset)
             if tuple(sorted(subset)) == full:
@@ -850,7 +859,9 @@ class Analyzer:
                 sub_aggs = tuple(
                     (nm, merge_of(nm, a)) for nm, a in base_aggs
                 ) + tuple((nm, AggExpr("min", Col(nm))) for nm in dropped)
-                lvl = LAggregate(base, sub_group, sub_aggs)
+                src = prev_lvl if (chain and prev_lvl is not None) else base
+                lvl = LAggregate(src, sub_group, sub_aggs)
+            prev_lvl = lvl
             proj = tuple(
                 (nm, Col(nm) if i in sset else Call("null_of", Col(nm)))
                 for i, (nm, _) in enumerate(agg.group_by)

@@ -257,6 +257,16 @@ def compile_distributed(
                 return emit_sort(p)
             if isinstance(p, LLimit):
                 c, m = emit(p.child)
+                if _is_dist(m) and p.limit is not None:
+                    # push the LIMIT through the exchange: any row in the
+                    # global first limit+offset is within its shard's first
+                    # limit+offset (holds for range-ordered shards too), so
+                    # pre-limit + compact and gather only ~k*shards rows
+                    k = p.limit + p.offset
+                    c = limit_chunk(c, k, 0)
+                    kcap = pad_capacity(k)
+                    if kcap < c.capacity:
+                        c, _ = compact(c, kcap)  # live <= k: no overflow
                 return limit_chunk(gather(c, m), p.limit, p.offset), REPLICATED
             if isinstance(p, LUnion):
                 from ..ops.setops import union_all
@@ -279,9 +289,18 @@ def compile_distributed(
             its own partitions locally — no whole-table gather. Unpartitioned
             windows (global ranks/running totals) still need the gather."""
             c, m = emit(p.child)
+
+            def win(chunk):
+                ctrs: dict = {}
+                out = window_op(chunk, p.partition_by, p.order_by, p.funcs,
+                                limit_spec=p.limit, counters=ctrs)
+                for nm, v in ctrs.items():
+                    checks[f"~ctr_{nm}@{ordinal(p)}"] = v[None]
+                return out
+
             if not p.partition_by or not _is_dist(m):
                 c = gather(c, m)
-                return window_op(c, p.partition_by, p.order_by, p.funcs), REPLICATED
+                return win(c), REPLICATED
             hc = _hash_col(m)
             # hash column among the partition keys => every partition is
             # wholly on one shard already (subset colocation rule)
@@ -298,18 +317,28 @@ def compile_distributed(
                 checks[key] = mxb[None]
                 if len(p.partition_by) == 1 and isinstance(p.partition_by[0], Col):
                     out_mode = ("hash", p.partition_by[0].name)
-            return window_op(c, p.partition_by, p.order_by, p.funcs), out_mode
+            return win(c), out_mode
 
         def emit_sort(p: LSort):
             c, m = emit(p.child)
+
+            def srt(chunk, limit):
+                ctrs: dict = {}
+                out = sort_chunk(chunk, p.keys, limit, counters=ctrs)
+                for nm, v in ctrs.items():
+                    checks[f"~ctr_{nm}@{ordinal(p)}"] = v[None]
+                return out
+
             if not _is_dist(m):
-                return sort_chunk(c, p.keys, p.limit), REPLICATED
+                return srt(c, p.limit), REPLICATED
             if p.limit is not None:
-                # distributed TopN: per-shard TopN, compact to ~limit rows,
-                # gather only those, final TopN (chunks_sorter_topn.h analog)
-                local = sort_chunk(c, p.keys, p.limit)
+                # distributed TopN: per-shard TopN (threshold-pruned when the
+                # keys pack), compact to ~limit rows, gather only k*shards
+                # rows, final TopN at the coordinator shard — the LIMIT+ORDER
+                # pushed through the exchange (chunks_sorter_topn.h analog)
+                local = srt(c, p.limit)
                 kcap = pad_capacity(p.limit)
-                if kcap < c.capacity:
+                if kcap < local.capacity:
                     local, _ = compact(local, kcap)  # live<=limit: no overflow
                 gathered = all_gather_chunk(local, axis)
                 return sort_chunk(gathered, p.keys, p.limit), REPLICATED

@@ -114,6 +114,10 @@ class LWindow(LogicalPlan):
     partition_by: tuple  # tuple[Expr]
     order_by: tuple  # tuple[(Expr, asc, nulls_first)]
     funcs: tuple  # tuple[(out_name, fn, arg|None, offset, default)]
+    # segmented per-partition TopN: (rank-func out_name, k) planted by the
+    # optimizer from a `rank() <= k` filter above (ops/window.py prunes
+    # rows ranked past k; the filter itself stays for exactness)
+    limit: Optional[tuple] = None
 
     @property
     def children(self):
@@ -123,7 +127,9 @@ class LWindow(LogicalPlan):
         return self.child.output_names() + tuple(n for n, *_ in self.funcs)
 
     def __repr__(self):
-        return f"Window[{[n for n, *_ in self.funcs]} part={list(self.partition_by)}]"
+        lim = f" topn={self.limit[1]}" if self.limit is not None else ""
+        return (f"Window[{[n for n, *_ in self.funcs]} "
+                f"part={list(self.partition_by)}{lim}]")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -44,8 +44,71 @@ def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
     plan = pushdown_aggregation(plan, catalog)
     plan = reorder_joins(plan, catalog)
     plan = pushdown_filters(plan)
+    plan = rewrite_window_topn(plan)
     plan = prune_columns(plan)
     return plan
+
+
+# --- 0b. window TopN rewrite -------------------------------------------------
+
+
+def rewrite_window_topn(plan: LogicalPlan) -> LogicalPlan:
+    """`rank()/row_number()/dense_rank() <= k` filters over a window become
+    per-partition segmented top-N pruning (reference analog: the TopN
+    runtime filter that feeds the current heap threshold back into
+    upstream scans, be/src/exec/topn_node + runtime_filter/; JSPIM's
+    skew-aware select pruning is the same threshold-mask idea). The filter
+    stays in place — the window node additionally DROPS rows ranked past k
+    from its selection, so every operator above (the q67 shape: a 10-key
+    ORDER BY LIMIT over the filtered window) sees ~k*partitions live rows
+    and the planner can compact capacities to match."""
+    from ..runtime.config import config as _cfg
+
+    new_children = tuple(rewrite_window_topn(c) for c in plan.children)
+    plan = _replace_children(plan, new_children)
+    if not isinstance(plan, LFilter) or not _cfg.get("enable_window_topn"):
+        return plan
+    # locate a window below, resolving rank-column renames through pure
+    # Col-passthrough projections
+    projs = []
+    node = plan.child
+    while isinstance(node, LProject):
+        projs.append(node)
+        node = node.child
+    if (not isinstance(node, LWindow) or not node.order_by
+            or node.limit is not None):
+        return plan
+    rank_funcs = {f[0] for f in node.funcs
+                  if f[1] in ("rank", "row_number", "dense_rank")}
+
+    def resolve(name):
+        for pr in projs:  # top-down renames back to window-level names
+            e = dict(pr.exprs).get(name)
+            if not isinstance(e, Col):
+                return None
+            name = e.name
+        return name
+
+    best = None
+    for c in _conjuncts(plan.predicate):
+        if not (isinstance(c, Call) and c.fn in ("le", "lt")
+                and len(c.args) == 2 and isinstance(c.args[0], Col)
+                and isinstance(c.args[1], Lit)
+                and isinstance(c.args[1].value, int)
+                and not isinstance(c.args[1].value, bool)):
+            continue
+        wname = resolve(c.args[0].name)
+        if wname not in rank_funcs:
+            continue
+        k = c.args[1].value - (1 if c.fn == "lt" else 0)
+        if k >= 0 and (best is None or k < best[1]):
+            best = (wname, k)
+    if best is None:
+        return plan
+    rebuilt = dataclasses.replace(node, limit=best)
+    for pr in reversed(projs):
+        rebuilt = LProject(rebuilt, pr.exprs)
+    return LFilter(rebuilt, plan.predicate)
 
 
 # --- 0a. FULL OUTER JOIN rewrite ---------------------------------------------
@@ -437,9 +500,7 @@ def _push(plan: LogicalPlan, preds: list) -> LogicalPlan:
         # conservative: filters stay above the window (pushing below would be
         # valid only for partition-key-only predicates)
         child = _push(plan.child, [])
-        return _wrap(
-            LWindow(child, plan.partition_by, plan.order_by, plan.funcs), preds
-        )
+        return _wrap(dataclasses.replace(plan, child=child), preds)
 
     if isinstance(plan, LUnnest):
         ccols = frozenset(plan.child.output_names())
@@ -528,7 +589,7 @@ def _replace_children(plan, new_children):
     if isinstance(plan, LAggregate):
         return LAggregate(new_children[0], plan.group_by, plan.aggs)
     if isinstance(plan, LWindow):
-        return LWindow(new_children[0], plan.partition_by, plan.order_by, plan.funcs)
+        return dataclasses.replace(plan, child=new_children[0])
     if isinstance(plan, LUnion):
         return LUnion(tuple(new_children))
     if isinstance(plan, LSort):
@@ -973,7 +1034,29 @@ def estimate_rows(plan: LogicalPlan, catalog) -> float:
     if isinstance(plan, LProject):
         return estimate_rows(plan.child, catalog)
     if isinstance(plan, LAggregate):
-        return max(1.0, estimate_rows(plan.child, catalog) / 10.0)
+        child_est = estimate_rows(plan.child, catalog)
+        if plan.group_by:
+            # NDV-product estimate capped by input rows (the standard
+            # group-count formula; the old flat /10 systematically
+            # undershot re-aggregations — a chained ROLLUP level would
+            # seed a too-small compaction and pay one overflow recompile
+            # per level)
+            prod = 1.0
+            resolvable = True
+            for _, e in plan.group_by:
+                if not isinstance(e, Col):
+                    resolvable = False
+                    break
+                ndv = _col_ndv_deep(plan.child, e.name, catalog)
+                if ndv is None:
+                    resolvable = False
+                    break
+                prod *= max(ndv, 1)
+                if prod >= child_est:
+                    break
+            if resolvable:
+                return max(1.0, min(prod, child_est))
+        return max(1.0, child_est / 10.0)
     if isinstance(plan, LJoin):
         l = estimate_rows(plan.left, catalog)
         r = estimate_rows(plan.right, catalog)
@@ -1044,12 +1127,87 @@ def estimate_rows(plan: LogicalPlan, catalog) -> float:
                 return est
         return max(l, r)
     if isinstance(plan, (LSort, LLimit, LWindow)):
-        return estimate_rows(plan.child, catalog)
+        est = estimate_rows(plan.child, catalog)
+        if isinstance(plan, (LSort, LLimit)) and plan.limit is not None:
+            est = min(est, float(plan.limit + getattr(plan, "offset", 0)))
+        if isinstance(plan, LWindow) and plan.limit is not None:
+            # segmented top-N keeps <= ~k rows per partition (rank ties can
+            # exceed k; maybe_compact's 1.5x headroom + overflow recompile
+            # absorb that)
+            _, k = plan.limit
+            ndv = _partition_ndv(plan.child, plan.partition_by, catalog)
+            if ndv is not None:
+                est = min(est, float((k + 1) * (ndv + 1)))
+            elif not plan.partition_by:
+                est = min(est, float(k + 1))
+        return est
     if isinstance(plan, LUnnest):
         return 4.0 * estimate_rows(plan.child, catalog)
     if isinstance(plan, LUnion):
         return sum(estimate_rows(c, catalog) for c in plan.inputs)
     return 1000.0
+
+
+def _col_ndv_deep(plan: LogicalPlan, name: str, catalog):
+    """Distinct-count estimate for a column that may pass through UNION
+    branches (which col_origin deliberately refuses — per-branch value
+    BOUNDS differ, so runtime-filter callers must not see through unions;
+    an NDV estimate may). ROLLUP/CUBE branches project dropped keys as
+    null_of(...) -> exactly one value. None = unresolvable."""
+    if isinstance(plan, LUnion):
+        total = 0
+        for c in plan.inputs:
+            n = _col_ndv_deep(c, name, catalog)
+            if n is None:
+                return None
+            total += n
+        return total
+    if isinstance(plan, LProject):
+        e = dict(plan.exprs).get(name)
+        if isinstance(e, Col):
+            return _col_ndv_deep(plan.child, e.name, catalog)
+        if isinstance(e, Lit) or (isinstance(e, Call) and e.fn == "null_of"):
+            return 1
+        return None
+    if isinstance(plan, (LFilter, LSort, LLimit, LWindow)):
+        return _col_ndv_deep(plan.child, name, catalog)
+    if isinstance(plan, LAggregate):
+        for n, e in plan.group_by:
+            if n == name and isinstance(e, Col):
+                return _col_ndv_deep(plan.child, e.name, catalog)
+        return None
+    if isinstance(plan, LJoin):
+        if name in plan.left.output_names():
+            return _col_ndv_deep(plan.left, name, catalog)
+        if plan.kind not in ("semi", "anti") and name in plan.right.output_names():
+            return _col_ndv_deep(plan.right, name, catalog)
+        return None
+    if isinstance(plan, LScan):
+        origin = col_origin(plan, name)
+        if origin is None:
+            return None
+        t = catalog.get_table(origin[0])
+        ndv = t.column_ndv(origin[1]) if t is not None else None
+        return int(ndv) if ndv else None
+    return None
+
+
+def _partition_ndv(plan: LogicalPlan, partition_by, catalog):
+    """Estimated distinct partition count of a window, or None: product of
+    per-key NDVs (union-aware), Col keys only."""
+    if not partition_by:
+        return None
+    total = 1
+    for e in partition_by:
+        if not isinstance(e, Col):
+            return None
+        ndv = _col_ndv_deep(plan, e.name, catalog)
+        if ndv is None:
+            return None
+        total *= max(ndv, 1)
+        if total > (1 << 40):
+            break
+    return total
 
 
 def pushdown_semi_joins(plan: LogicalPlan, catalog) -> LogicalPlan:
@@ -1582,10 +1740,8 @@ def _prune(plan: LogicalPlan, required: frozenset, dups, reqs, record: bool
                 need |= expr_cols(a)
         if not need:
             need = set(plan.child.output_names()[:1])
-        return LWindow(
-            prune_columns(plan.child, frozenset(need)),
-            plan.partition_by, plan.order_by, plan.funcs,
-        )
+        return dataclasses.replace(
+            plan, child=prune_columns(plan.child, frozenset(need)))
 
     if isinstance(plan, LUnnest):
         need = (required - {plan.out_name}) | expr_cols(plan.expr)

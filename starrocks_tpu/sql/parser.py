@@ -594,9 +594,16 @@ class Parser:
         """`x -> expr` / `(x, y) -> expr` (higher-order function arguments;
         reference: the lambda grammar of array_map/map_apply). Pure
         lookahead first, so ordinary expressions never backtrack."""
+        if not getattr(self, "_call_depth", 0):
+            return None  # not inside a function's argument list
         t = self.peek()
         if (t.kind == "ident" and self.peek(1).kind == "op"
                 and self.peek(1).value == "->"):
+            if self.peek(2).kind == "string":
+                # `col -> '$.a'` is the JSON arrow operator, not a lambda
+                # with a constant string body (parse_unary routes it to
+                # get_json_string)
+                return None
             name = self.next().value
             self.next()  # ->
             return ast.LambdaExpr((name,), self.parse_or())
@@ -764,7 +771,22 @@ class Parser:
             return Call("negate", e)
         if self.accept_op("+"):
             return self.parse_unary()
-        return self.parse_primary()
+        e = self.parse_primary()
+        # the JSON arrow operator: col -> '$.a' extracts a JSON path
+        # (reference: StarRocks' json -> path = json_query). Lambdas also
+        # use ->, but _try_parse_lambda only claims `ident ->` when the
+        # body is NOT a string literal, so the two cannot collide; a
+        # non-string rhs here is a clear error instead of a silent lambda.
+        while self.at_op("->"):
+            self.next()
+            pt = self.next()
+            if pt.kind != "string":
+                raise ParseError(
+                    "-> expects a JSON path string literal (lambdas are "
+                    f"only valid as higher-order function arguments) at "
+                    f"position {pt.pos}")
+            e = Call("get_json_string", e, Lit(pt.value))
+        return e
 
     def parse_primary(self) -> Expr:
         t = self.peek()
@@ -873,6 +895,16 @@ class Parser:
         name = name.lower()
         self.expect_op("(")
         distinct = self.accept_kw("distinct")
+        # lambdas are only grammatical as function arguments (the
+        # higher-order builtins); a bare `x -> expr` elsewhere is either
+        # the JSON arrow (string rhs, parse_unary) or a clear error
+        self._call_depth = getattr(self, "_call_depth", 0) + 1
+        try:
+            return self._parse_func_call_body(name, distinct)
+        finally:
+            self._call_depth -= 1
+
+    def _parse_func_call_body(self, name: str, distinct: bool) -> Expr:
         args = []
         if (name in self._UNIT_ARG_FNS and self.peek().kind in ("kw", "ident")
                 and self.peek().value.lower() in self._UNITS):

@@ -418,12 +418,49 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                 return project(c, [e for _, e in p.exprs], [n for n, _ in p.exprs])
             if isinstance(p, LSort):
                 c = maybe_compact(p.child, emit(p.child), str(ordinal(p)))
-                return sort_chunk(c, p.keys, p.limit)
+                ctrs: dict = {}
+                out = sort_chunk(c, p.keys, p.limit, counters=ctrs)
+                for nm, v in ctrs.items():
+                    checks[f"~ctr_{nm}@{ordinal(p)}"] = v
+                return out
             if isinstance(p, LLimit):
                 return limit_chunk(emit(p.child), p.limit, p.offset)
             if isinstance(p, LWindow):
-                c = maybe_compact(p.child, emit(p.child), str(ordinal(p)))
-                return window_op(c, p.partition_by, p.order_by, p.funcs)
+                c = emit(p.child)
+                ctrs = {}
+                pre = None
+                if p.limit is not None:
+                    # TopN runtime filter: mask rows past the per-partition
+                    # k-th key BEFORE the window's sort, then compact —
+                    # the expensive lexsort runs over ~k*partitions rows
+                    # instead of the whole window input (threshold ties
+                    # can exceed the seed; the overflow check recompiles)
+                    from ..ops.common import compact
+                    from ..ops.window import window_topn_prefilter
+
+                    pre = window_topn_prefilter(
+                        c, p.partition_by, p.order_by, p.limit[1])
+                    if pre is not None:
+                        keep, seed_rows = pre
+                        n_live = c.num_rows()
+                        c = c.and_sel(keep)
+                        ctrs["window_topn_prefiltered"] = (
+                            n_live - c.num_rows())
+                        key = f"wtop_{ordinal(p)}"
+                        cap = caps.get(key, pad_capacity(
+                            seed_rows * 2 + 1024))
+                        if cap < c.capacity:
+                            c, nk = compact(c, cap)
+                            checks[key] = nk
+                if pre is None:
+                    # no threshold path: the estimate-seeded shrink is the
+                    # only capacity reduction before the window sort
+                    c = maybe_compact(p.child, c, str(ordinal(p)))
+                out = window_op(c, p.partition_by, p.order_by, p.funcs,
+                                limit_spec=p.limit, counters=ctrs)
+                for nm, v in ctrs.items():
+                    checks[f"~ctr_{nm}@{ordinal(p)}"] = v
+                return out
             if isinstance(p, LUnion):
                 from ..ops.setops import union_all
 
@@ -437,6 +474,14 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                 # a global (no-group-key) aggregation always yields one row;
                 # a 1024-slot capacity would pay a 1024-wide segment reduce
                 default = 1024 if p.group_by else 1
+                if p.group_by and isinstance(p.child, LAggregate):
+                    # chained re-aggregation (ROLLUP level merges): group
+                    # count is bounded by the child agg's output rows, so
+                    # its capacity is a no-overflow seed — a deep chain
+                    # then converges without one recompile per level, and
+                    # the post-success tightening pass shrinks each level
+                    # to its true count for subsequent runs
+                    default = max(default, c0.capacity)
                 from ..ops.aggregate import bounded_domain
                 from ..runtime.config import config as _acfg
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 from typing import Optional
 
 import numpy as np
@@ -72,6 +73,13 @@ class TabletStore:
         self._pk_index: dict = {}  # table -> {pk tuple: (rowset, file, pos)}
         self._next_seq = None  # lazily scanned (image seq + log tail)
         self.tail_count = None  # ops past the image (auto-checkpoint trigger)
+        # serializes log() appends against checkpoint()'s snapshot+replace:
+        # sessions share one TabletStore and auto-checkpoint fires during
+        # statement logging, so an unguarded append between the tail
+        # snapshot and os.replace would land on the replaced inode and
+        # vanish from the journal (appends are short, checkpoints rare —
+        # one lock is cheaper than being right about interleavings)
+        self._journal_lock = threading.RLock()
 
     # --- edit log + image checkpoint -----------------------------------------
     # The journal is the FE EditLog/image pair (fe persist/EditLog.java:133 +
@@ -97,14 +105,15 @@ class TabletStore:
             self._next_seq = self._scan_seq()
 
     def log(self, op: dict) -> int:
-        if self._next_seq is None:
-            self._next_seq = self._scan_seq()
-        self.tail_count = (self.tail_count or 0) + 1
-        self._next_seq += 1
-        op = {"seq": self._next_seq, **op}
-        with open(self.log_path, "a") as f:
-            f.write(json.dumps(op) + "\n")
-        return self._next_seq
+        with self._journal_lock:
+            if self._next_seq is None:
+                self._next_seq = self._scan_seq()
+            self.tail_count = (self.tail_count or 0) + 1
+            self._next_seq += 1
+            op = {"seq": self._next_seq, **op}
+            with open(self.log_path, "a") as f:
+                f.write(json.dumps(op) + "\n")
+            return self._next_seq
 
     def replay(self, after_seq: int = -1):
         """Yield logged ops in order (catalog rebuild). Ops without an
@@ -137,28 +146,29 @@ class TabletStore:
         be durable before the log shrinks), then the log — a crash between
         the two leaves covered ops in the log, and replay of an
         already-applied catalog op is idempotent."""
-        if self._next_seq is None:
-            self._next_seq = self._scan_seq()
-        seq = self._next_seq
-        tmp = self.image_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"seq": seq, "catalog": catalog_image}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.image_path)
-        dfd = os.open(self.root, os.O_RDONLY)
-        try:
-            os.fsync(dfd)  # the rename itself must survive power loss
-        finally:
-            os.close(dfd)
-        keep = [op for op in self.replay(after_seq=seq)]
-        tmp = self.log_path + ".tmp"
-        with open(tmp, "w") as f:
-            for op in keep:
-                f.write(json.dumps(op) + "\n")
-        os.replace(tmp, self.log_path)
-        self.tail_count = len(keep)
-        return seq
+        with self._journal_lock:
+            if self._next_seq is None:
+                self._next_seq = self._scan_seq()
+            seq = self._next_seq
+            tmp = self.image_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"seq": seq, "catalog": catalog_image}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.image_path)
+            dfd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(dfd)  # the rename itself must survive power loss
+            finally:
+                os.close(dfd)
+            keep = [op for op in self.replay(after_seq=seq)]
+            tmp = self.log_path + ".tmp"
+            with open(tmp, "w") as f:
+                for op in keep:
+                    f.write(json.dumps(op) + "\n")
+            os.replace(tmp, self.log_path)
+            self.tail_count = len(keep)
+            return seq
 
     # --- table lifecycle ------------------------------------------------------
     def _tdir(self, name: str) -> str:

@@ -91,3 +91,23 @@ def test_two_process_mesh_shuffle():
         assert set(mon.members()) == {"worker-0", "worker-1"}
     finally:
         mon.close()
+
+
+def test_monitor_bind_host_configurable():
+    """The heartbeat server binds all interfaces by default (cross-host
+    workers must reach /heartbeat); a loopback-only bind stays available
+    for tests."""
+    mon = ClusterMonitor(interval_s=0.2, miss_limit=5)
+    try:
+        assert mon._srv.server_address[0] == "0.0.0.0"
+        w = Heartbeater("127.0.0.1", mon.port, "w1", interval_s=0.05)
+        assert _wait_for(lambda: "w1" in mon.members())
+        w.stop()
+    finally:
+        mon.close()
+    lo = ClusterMonitor(interval_s=0.2, miss_limit=5,
+                        bind_host="127.0.0.1")
+    try:
+        assert lo._srv.server_address[0] == "127.0.0.1"
+    finally:
+        lo.close()

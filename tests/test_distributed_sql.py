@@ -79,7 +79,8 @@ def test_distributed_adaptive_recompile(sessions):
 def _lowered_hlo(s8, cat, q, return_modes=False):
     """Compile a query through the distributed planner and return HLO text."""
     import jax
-    from jax import shard_map
+
+    from starrocks_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     from starrocks_tpu.sql.analyzer import Analyzer

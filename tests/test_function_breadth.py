@@ -396,3 +396,20 @@ def test_wave3_fix_regressions(sess):
     # date_format with time tokens on DATETIME refuses loudly
     with pytest.raises(Exception, match="time tokens"):
         sess.sql("select date_format(dt, '%H:%i') from t")
+
+
+def test_json_arrow_operator(sess):
+    # col -> '$.path' is JSON extraction (the reference arrow operator),
+    # NOT a lambda — lambdas only parse as higher-order function arguments
+    s2 = Session()
+    s2.sql("create table ja (js varchar)")
+    s2.sql("""insert into ja values ('{"a": {"b": "x"}}'), ('{"a": 2}')""")
+    assert [r[0] for r in s2.sql(
+        "select js -> '$.a.b' from ja").rows()] == ["x", ""]
+    # a non-string rhs outside a higher-order call is a clear parse error
+    import pytest as _pytest
+
+    from starrocks_tpu.sql.parser import ParseError
+
+    with _pytest.raises(ParseError, match="JSON path"):
+        s2.sql("select js -> 1 from ja")

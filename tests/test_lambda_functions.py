@@ -175,3 +175,26 @@ def test_lambda_shadowing_and_nesting(sess):
     assert sess.sql(
         "select array_map(x -> x + 1, arr) m from lt where g = 1"
     ).rows() == [([2, 3, 4],)]
+
+
+def test_map_duplicate_keys_last_wins(sess):
+    # all map builtins agree on last-occurrence-wins: maps dedupe at
+    # construction, and element_at picks the LAST hit either way
+    got = sess.sql(
+        "select element_at(map_from_arrays(array(1, 1), array(10, 20)), 1) v,"
+        " map_size(map_from_arrays(array(1, 1), array(10, 20))) z "
+        "from lt where g = 1").rows()
+    assert got == [(20, 1)]
+
+
+def test_element_at_column_key(sess):
+    # per-row COLUMN key: each row looks up its own g (1..4) in {g: g*10}
+    got = sess.sql(
+        "select g, element_at(map_from_arrays(array(g, 7), "
+        "array(g * 10, 70)), g) v from lt order by g").rows()
+    assert got == [(1, 10), (2, 20), (3, 30), (4, 40)]
+    # a missing per-row key is NULL, not a broadcast artifact
+    got2 = sess.sql(
+        "select g, element_at(map_from_arrays(array(7), array(70)), g) v "
+        "from lt order by g").rows()
+    assert got2 == [(1, None), (2, None), (3, None), (4, None)]

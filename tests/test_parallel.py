@@ -10,7 +10,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from starrocks_tpu.parallel.mesh import shard_map
 
 from starrocks_tpu.column import HostTable
 from starrocks_tpu.exprs import AggExpr, col, gt, lit
