@@ -168,6 +168,15 @@ def eval_lambda(cc, lam: IrLambda, arrays: list) -> tuple:
     }
     sub = LambdaCompiler(cc, binds, n, k)
     out = sub.eval(lam.body)
+    if isinstance(out.data, str):
+        # constant-string body (`x -> 'abc'`): literals stay python str
+        # until they meet a dictionary — mint a one-entry dict so every
+        # lane carries its code
+        from ..column.dict_encoding import StringDict
+
+        sd, codes = StringDict.from_strings([out.data])
+        out = dataclasses.replace(
+            out, data=jnp.asarray(codes[0]), type=T.VARCHAR, dict=sd)
     return out, n, k, mask, length
 
 

@@ -23,6 +23,28 @@ from .common import boundaries, eval_keys
 from .sort import _descending
 
 
+def window_topn_prefilter_safe(funcs, limit_spec) -> bool:
+    """Whether dropping rows BEFORE the window's sort is sound for this
+    function set. The threshold is the per-partition k-th ROW's score, so
+
+    - the limited function must count rows: rank()/row_number(). dense_rank
+      counts DISTINCT order keys, so its k-th rank can sit past the k-th
+      row (scores [10,10,9]: dense_rank 2 is the 9-row, but the 2nd row's
+      score is 10 — the threshold would drop it);
+    - every co-resident function (the analyzer merges all funcs sharing a
+      (partition, order) spec into one LWindow) must read only the sorted
+      prefix up to the current row's peer group. rank-like functions do;
+      lead/last_value/nth_value and frames reaching FOLLOWING would be
+      computed over the pruned subset and go wrong on surviving rows.
+
+    The in-window limit_rank mask is exact for every function, so unsafe
+    shapes simply skip the prefilter, not the rewrite."""
+    limited = next((f[1] for f in funcs if f[0] == limit_spec[0]), None)
+    if limited not in ("rank", "row_number"):
+        return False
+    return all(f[1] in ("rank", "row_number", "dense_rank") for f in funcs)
+
+
 def window_topn_prefilter(chunk: Chunk, partition_by, order_by, k: int,
                           max_domain: int = 1024,
                           max_cells: int = 1 << 25):
@@ -34,9 +56,11 @@ def window_topn_prefilter(chunk: Chunk, partition_by, order_by, k: int,
     (dict codes / bools / stats-bounded ints, the same _key_domain
     discipline as every other packing decision). Builds a [D, cap] masked
     score matrix, takes each partition's k-th best via lax.top_k, and
-    keeps rows scoring >= their partition's threshold — EXACTLY the
+    keeps rows scoring >= their partition's threshold — a superset of the
     rank() <= k row set (ties at the threshold stay, so the in-window
-    rank mask still applies). NULL keys score the ceiling (NULLS FIRST:
+    rank mask still applies; callers gate on window_topn_prefilter_safe —
+    the threshold is row-counting and prefix-only). NULL keys score the
+    ceiling (NULLS FIRST:
     the null peer group ranks 1, occupying top threshold slots) or the
     floor (NULLS LAST: kept only while the partition has fewer than k
     scored rows). Returns (keep_mask, seed_rows) — seed_rows is a
@@ -66,6 +90,14 @@ def window_topn_prefilter(chunk: Chunk, partition_by, order_by, k: int,
         score = jnp.where(okey.valid, score,
                           ceil if nulls_first else floor)
     score = jnp.where(live, score, floor)
+    if jnp.issubdtype(score.dtype, jnp.floating):
+        # NaN order keys: the engine's sort (argsort/lexsort; DESC via
+        # negation, which keeps NaN NaN) places them last in either
+        # direction, so they rank worst — score them the floor. Raw NaN
+        # would fail `>= kth` unconditionally (dropping NaN rows even in
+        # partitions with fewer than k rows), and k NaNs in one partition
+        # would make kth itself NaN, dropping the whole partition.
+        score = jnp.where(jnp.isnan(score), floor, score)
 
     if partition_by:
         from .aggregate import _mixed_radix_pack

@@ -6,7 +6,8 @@ bRPC exec_plan_fragment -> ResultSink). TPU version: one jitted SPMD program;
 "deployment" is jit + input sharding; the result arrives replicated.
 Shares the Session's DeviceCache (so DML invalidation covers this path) and
 the Executor's adaptive overflow-recompile loop; checks come back per-shard
-and the host takes the max.
+and the host takes the max (profile counters are psum'd on device by the
+sharded stages that emit them, so the max IS the cross-shard sum).
 """
 
 from __future__ import annotations

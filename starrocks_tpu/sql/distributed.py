@@ -30,6 +30,7 @@ shards.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .. import types as T
@@ -283,6 +284,18 @@ def compile_distributed(
                 return emit_join(p)
             raise PlanError(f"cannot compile {type(p).__name__} distributed")
 
+        def _emit_ctrs(p, ctrs, dist: bool):
+            """'~ctr_' profile counters ride the checks channel, whose host
+            merge takes the MAX across shards (overflow semantics). A
+            sharded stage's per-shard counts must SUM instead — psum them
+            here inside the traced program, so every shard reports the
+            global total and the host max is that total. Replicated stages
+            compute the same value on every shard; emit as-is."""
+            for nm, v in ctrs.items():
+                if dist:
+                    v = jax.lax.psum(v, axis)
+                checks[f"~ctr_{nm}@{ordinal(p)}"] = v[None]
+
         def emit_window(p: LWindow):
             """PARTITION BY windows are independent per partition, so a
             sharded input shuffles by partition key and each shard computes
@@ -290,17 +303,16 @@ def compile_distributed(
             windows (global ranks/running totals) still need the gather."""
             c, m = emit(p.child)
 
-            def win(chunk):
+            def win(chunk, dist: bool):
                 ctrs: dict = {}
                 out = window_op(chunk, p.partition_by, p.order_by, p.funcs,
                                 limit_spec=p.limit, counters=ctrs)
-                for nm, v in ctrs.items():
-                    checks[f"~ctr_{nm}@{ordinal(p)}"] = v[None]
+                _emit_ctrs(p, ctrs, dist)
                 return out
 
             if not p.partition_by or not _is_dist(m):
                 c = gather(c, m)
-                return win(c), REPLICATED
+                return win(c, False), REPLICATED
             hc = _hash_col(m)
             # hash column among the partition keys => every partition is
             # wholly on one shard already (subset colocation rule)
@@ -317,26 +329,25 @@ def compile_distributed(
                 checks[key] = mxb[None]
                 if len(p.partition_by) == 1 and isinstance(p.partition_by[0], Col):
                     out_mode = ("hash", p.partition_by[0].name)
-            return win(c), out_mode
+            return win(c, True), out_mode
 
         def emit_sort(p: LSort):
             c, m = emit(p.child)
 
-            def srt(chunk, limit):
+            def srt(chunk, limit, dist: bool):
                 ctrs: dict = {}
                 out = sort_chunk(chunk, p.keys, limit, counters=ctrs)
-                for nm, v in ctrs.items():
-                    checks[f"~ctr_{nm}@{ordinal(p)}"] = v[None]
+                _emit_ctrs(p, ctrs, dist)
                 return out
 
             if not _is_dist(m):
-                return srt(c, p.limit), REPLICATED
+                return srt(c, p.limit, False), REPLICATED
             if p.limit is not None:
                 # distributed TopN: per-shard TopN (threshold-pruned when the
                 # keys pack), compact to ~limit rows, gather only k*shards
                 # rows, final TopN at the coordinator shard — the LIMIT+ORDER
                 # pushed through the exchange (chunks_sorter_topn.h analog)
-                local = srt(c, p.limit)
+                local = srt(c, p.limit, True)
                 kcap = pad_capacity(p.limit)
                 if kcap < local.capacity:
                     local, _ = compact(local, kcap)  # live<=limit: no overflow

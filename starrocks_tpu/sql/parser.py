@@ -599,10 +599,12 @@ class Parser:
         t = self.peek()
         if (t.kind == "ident" and self.peek(1).kind == "op"
                 and self.peek(1).value == "->"):
-            if self.peek(2).kind == "string":
+            s = self.peek(2)
+            if s.kind == "string" and s.value.startswith("$"):
                 # `col -> '$.a'` is the JSON arrow operator, not a lambda
                 # with a constant string body (parse_unary routes it to
-                # get_json_string)
+                # get_json_string). Any other string rhs here is a lambda
+                # body — `array_map(x -> 'abc', arr)` is valid HOF SQL
                 return None
             name = self.next().value
             self.next()  # ->
@@ -774,9 +776,10 @@ class Parser:
         e = self.parse_primary()
         # the JSON arrow operator: col -> '$.a' extracts a JSON path
         # (reference: StarRocks' json -> path = json_query). Lambdas also
-        # use ->, but _try_parse_lambda only claims `ident ->` when the
-        # body is NOT a string literal, so the two cannot collide; a
-        # non-string rhs here is a clear error instead of a silent lambda.
+        # use ->, but _try_parse_lambda (only active inside a call's
+        # argument list) yields `ident ->` back here only for '$'-prefixed
+        # path literals, so the two cannot collide; a non-string rhs here
+        # is a clear error instead of a silent lambda.
         while self.at_op("->"):
             self.next()
             pt = self.next()

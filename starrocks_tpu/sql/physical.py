@@ -434,12 +434,19 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                     # k-th key BEFORE the window's sort, then compact —
                     # the expensive lexsort runs over ~k*partitions rows
                     # instead of the whole window input (threshold ties
-                    # can exceed the seed; the overflow check recompiles)
+                    # can exceed the seed; the overflow check recompiles).
+                    # Only when the function set tolerates pre-sort drops
+                    # (row-counting limit func, prefix-only co-residents);
+                    # otherwise window_op's exact in-window mask does all
+                    # the work
                     from ..ops.common import compact
-                    from ..ops.window import window_topn_prefilter
+                    from ..ops.window import (
+                        window_topn_prefilter, window_topn_prefilter_safe,
+                    )
 
-                    pre = window_topn_prefilter(
-                        c, p.partition_by, p.order_by, p.limit[1])
+                    if window_topn_prefilter_safe(p.funcs, p.limit):
+                        pre = window_topn_prefilter(
+                            c, p.partition_by, p.order_by, p.limit[1])
                     if pre is not None:
                         keep, seed_rows = pre
                         n_live = c.num_rows()

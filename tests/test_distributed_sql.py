@@ -64,6 +64,19 @@ def test_ssb_distributed(eight_devices):
         D.SHARD_THRESHOLD_ROWS = old
 
 
+def test_distributed_topn_counter_sums_shards(sessions):
+    s1, s8 = sessions
+    q = "select l_orderkey from lineitem order by l_orderkey limit 7"
+    assert s1.sql(q).rows() == s8.sql(q).rows()
+    c1 = s1.last_profile.counters.get("topn_rows_pruned", (0,))[0]
+    c8 = s8.last_profile.counters.get("topn_rows_pruned", (0,))[0]
+    assert c1 > 0
+    # per-shard pruned counts are psum'd in the traced program so the
+    # host's max-merge reports the cross-shard SUM; a plain max would
+    # report a single shard's count (~1/8 of the single-node total)
+    assert c8 > 0.55 * c1
+
+
 def test_distributed_adaptive_recompile(sessions):
     s1, s8 = sessions
     # high-cardinality group-by on an EXPRESSION (no NDV stats -> the planner

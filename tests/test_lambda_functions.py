@@ -89,6 +89,16 @@ def test_map_family(sess):
     ).rows() == [(3,)]
 
 
+def test_lambda_string_literal_body(sess):
+    # `x -> 'abc'` inside a call argument list is a lambda with a constant
+    # string body, NOT JSON extraction — only '$'-prefixed path literals
+    # take the arrow route (get_json_string)
+    got = sess.sql(
+        "select g, array_map(e -> 'k', arr) m from lt where g <= 2 order by g"
+    ).rows()
+    assert got == [(1, ["k", "k", "k"]), (2, ["k"])]
+
+
 def test_map_lambdas(sess):
     q = "map_from_arrays(arr, array_map(e -> e * 10, arr))"
     assert sess.sql(

@@ -206,6 +206,86 @@ def test_window_topn_rewrite_matches_unrewritten():
     assert "topn=5" in s.sql("explain " + RANK_TOPN_Q)
 
 
+DENSE_TOPN_Q = """
+select * from (
+  select p, v, dense_rank() over (partition by p order by v desc) dr from d
+) x where dr <= 2 order by p, v desc limit 1000
+"""
+
+
+def test_window_topn_dense_rank_duplicates():
+    # dense_rank counts DISTINCT order keys: with scores [10,10,9] and
+    # dense_rank()<=2 the 9-row must survive — a per-partition k-th ROW
+    # threshold (10) would drop it before the window ever ranks it
+    cat = Catalog()
+    cat.register("d", HostTable.from_pydict({
+        "p": [0, 0, 0, 0, 1, 1, 1],
+        "v": [10, 10, 9, 8, 7, 7, 6],
+    }))
+    config.set("enable_window_topn", False)
+    base = Session(cat).sql(DENSE_TOPN_Q).rows()
+    config.set("enable_window_topn", True)
+    got = Session(cat).sql(DENSE_TOPN_Q).rows()
+    assert got == base
+    assert (0, 9, 2) in got and (1, 6, 2) in got
+
+
+def test_window_topn_coresident_funcs_unpruned():
+    # the analyzer merges every window func sharing (partition, order)
+    # into one LWindow; lead() on a rank-limited node reads rows past
+    # rank k, so the pre-sort prefilter must stand down (the exact
+    # in-window mask still applies) and surviving rows keep the values
+    # computed over the FULL partition
+    rng = np.random.default_rng(5)
+    cat = _rank_catalog(rng, n=800)
+    q = """
+    select * from (
+      select p, v,
+             rank() over (partition by p order by v desc) rk,
+             lead(v, 1) over (partition by p order by v desc) nxt,
+             sum(v) over (partition by p order by v desc) run
+      from t
+    ) x where rk <= 3 order by p, v desc limit 10000
+    """
+    config.set("enable_window_topn", False)
+    base = Session(cat).sql(q).rows()
+    config.set("enable_window_topn", True)
+    got = Session(cat).sql(q).rows()
+    assert got == base
+    # lead() at the last kept rank must see the (filtered-out) rank-4 row
+    assert any(r[3] is not None for r in got)
+
+
+def test_window_topn_prefilter_nan_scores():
+    from starrocks_tpu.ops.window import window_topn_prefilter
+
+    nan = float("nan")
+    chunk = HostTable.from_pydict({
+        "p": [0, 0, 0, 0, 1, 1],
+        "v": [5.0, 4.0, 3.0, nan, 1.0, nan],
+    }).to_chunk()
+    chunk = _with_int_bounds(chunk, {"p": (0, 1)})
+    pre = window_topn_prefilter(
+        chunk, (col("p"),), ((col("v"), False, False),), 2)
+    assert pre is not None
+    keep = np.asarray(pre[0])[:6]
+    # partition 0: top-2 by v desc = {5,4}; 3 and the NaN row (the sort
+    # places NaN last in either direction) fall past the threshold.
+    # partition 1 has fewer than k non-NaN rows: its NaN row ranks 2 and
+    # must survive, not fail a NaN-poisoned `>= kth` compare
+    assert keep.tolist() == [True, True, False, False, True, True]
+
+    # >= k NaN scores in one partition must not poison the k-th key
+    # (a NaN threshold would drop the whole partition)
+    c2 = HostTable.from_pydict({"p": [0, 0, 0], "v": [nan, nan, nan]}
+                               ).to_chunk()
+    c2 = _with_int_bounds(c2, {"p": (0, 0)})
+    pre2 = window_topn_prefilter(
+        c2, (col("p"),), ((col("v"), True, False),), 2)
+    assert pre2 is not None
+    assert np.asarray(pre2[0])[:3].all()
+
+
 def test_sort_timing_counter():
     rng = np.random.default_rng(3)
     cat = _rank_catalog(rng, n=2000)
