@@ -105,6 +105,15 @@ def _bench_sql(session, text, rows_base, repeats, oracle=None):
         "device_ms": round(best * 1000, 2),
         "compile_s": round(compile_s, 1),
     }
+    # runtime-filter effectiveness (rf_rows_pruned / rf_segments_pruned /
+    # rf_bloom_bits) rides the per-query profile; record it so BENCH_r*
+    # rounds track pruning alongside timings
+    prof = getattr(session, "last_profile", None)
+    if prof is not None:
+        rf = {k: int(v) for k, (v, _) in prof.counters.items()
+              if k.startswith("rf_")}
+        if rf:
+            out["rf"] = rf
     if oracle is not None:
         t0 = time.time()
         first = oracle()
@@ -291,16 +300,35 @@ def run_q1_handplan(sf: float, repeats: int):
     }
 
 
-def run_suite(sf: float, repeats: int, probe_failed: bool = False):
+def _entry_selected(name: str, only, skip) -> bool:
+    """Query selection for --only/--skip: a token matches an entry by full
+    name ("tpch_q7"), bare TPC-H shorthand ("q7"), or family-suffix
+    ("q1.1" -> ssb_q1.1, "q67" -> tpcds_q67)."""
+
+    def matches(tok):
+        return name == tok or name == f"tpch_{tok}" or name.endswith("_" + tok)
+
+    if any(matches(t) for t in skip):
+        return False
+    return not only or any(matches(t) for t in only)
+
+
+def run_suite(sf: float, repeats: int, probe_failed: bool = False,
+              only=(), skip=()):
     """All BASELINE.json config families.  Headline JSON line prints right
     after Q1; the rest runs under the wall-clock budget with incremental
-    BENCH_DETAIL.json writes."""
+    BENCH_DETAIL.json writes.  --only/--skip narrow the query set (manual
+    A/B runs); a deselected entry is recorded, not timed."""
     import jax
 
     from starrocks_tpu.runtime.session import Session
 
     detail = {"backend": jax.default_backend(), "sf": sf,
               "budget_s": _budget_s()}
+    if only:
+        detail["only"] = list(only)
+    if skip:
+        detail["skip"] = list(skip)
     detail_path = os.path.join(os.path.dirname(__file__) or ".",
                                "BENCH_DETAIL.json")
 
@@ -308,21 +336,28 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False):
         with open(detail_path, "w") as f:
             json.dump(detail, f, indent=1)
 
-    q1d = run_q1_handplan(sf, repeats)
-    detail["tpch_q1_handplan"] = q1d
-    flush_detail()
-    speedups = [q1d["vs_pandas"]]
+    headline = None
+    speedups = []
+    if _entry_selected("q1", only, skip):
+        q1d = run_q1_handplan(sf, repeats)
+        detail["tpch_q1_handplan"] = q1d
+        flush_detail()
+        speedups.append(q1d["vs_pandas"])
 
-    # The round's metric, printed BEFORE any other family can stall/die.
-    headline = {
-        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
-        "value": q1d["rows_per_sec"],
-        "unit": "rows/sec/chip",
-        "vs_baseline": q1d["vs_pandas"],
-    }
-    print(json.dumps(headline), flush=True)
+        # The round's metric, printed BEFORE any other family can stall/die.
+        headline = {
+            "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
+            "value": q1d["rows_per_sec"],
+            "unit": "rows/sec/chip",
+            "vs_baseline": q1d["vs_pandas"],
+        }
+        print(json.dumps(headline), flush=True)
 
     def try_entry(name, fn):
+        if not _entry_selected(name, only, skip):
+            detail[name] = {"skipped": "deselected (--only/--skip)"}
+            flush_detail()
+            return
         if _remaining_s() <= 0:
             detail[name] = {"skipped": "wall-clock budget exhausted"}
             print(f"# {name}: SKIPPED (budget)", file=sys.stderr)
@@ -429,8 +464,16 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False):
             )
 
     geomean = round(
-        math.exp(sum(math.log(s) for s in speedups) / len(speedups)), 3)
+        math.exp(sum(math.log(s) for s in speedups) / len(speedups)), 3
+    ) if speedups else 0.0
     detail["suite_geomean_vs_pandas"] = geomean
+    # suite-wide runtime-filter effectiveness (sums of per-query rf_*)
+    rf_totals: dict = {}
+    for d in detail.values():
+        if isinstance(d, dict):
+            for k, v in (d.get("rf") or {}).items():
+                rf_totals[k] = rf_totals.get(k, 0) + v
+    detail["rf_totals"] = rf_totals
     # oracle MISMATCHes must be machine-readable, not a comment tail: any
     # nonzero `mismatches` marks the round's results wrong regardless of
     # how fast they were
@@ -467,16 +510,36 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False):
             flush_detail()
 
     # Enriched final line: same metric/value as the headline (either line
-    # satisfies the driver), plus the suite geomean.
+    # satisfies the driver), plus the suite geomean and runtime-filter
+    # pruning totals (rf_rows_pruned / rf_segments_pruned / rf_bloom_bits).
     print(json.dumps({
-        **headline,
+        **(headline or {"metric": f"bench_subset_sf{sf:g}", "value": 0,
+                        "unit": "", "vs_baseline": 0.0}),
         "suite_geomean_vs_pandas": geomean,
         "suite_queries": len(speedups),
         "mismatches": len(mismatches),
+        "rf_rows_pruned": rf_totals.get("rf_rows_pruned", 0),
+        "rf_segments_pruned": rf_totals.get("rf_segments_pruned", 0),
+        "rf_bloom_bits": rf_totals.get("rf_bloom_bits", 0),
     }))
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="starrocks_tpu benchmark suite (env knobs: "
+                    "SR_TPU_BENCH_SF/_REPEATS/_QUERY/_BUDGET_S)")
+    ap.add_argument("--only", default=os.environ.get("SR_TPU_BENCH_ONLY", ""),
+                    help="comma list of queries to run, e.g. q7,q9 or "
+                         "ssb_q1.1,q67 (q1 = the handplan headline)")
+    ap.add_argument("--skip", default=os.environ.get("SR_TPU_BENCH_SKIP", ""),
+                    help="comma list of queries to exclude")
+    args, _unknown = ap.parse_known_args()
+
+    def toks(s):
+        return tuple(t.strip() for t in s.split(",") if t.strip())
+
     sf = float(os.environ.get("SR_TPU_BENCH_SF", "1.0"))
     repeats = int(os.environ.get("SR_TPU_BENCH_REPEATS", "5"))
     query_key = os.environ.get("SR_TPU_BENCH_QUERY", "suite")
@@ -484,7 +547,8 @@ def main():
     global _T0
     _T0 = time.time()  # budget clock starts after the device probe
     if query_key == "suite":
-        return run_suite(sf, repeats, probe_failed=not probe_ok)
+        return run_suite(sf, repeats, probe_failed=not probe_ok,
+                         only=toks(args.only), skip=toks(args.skip))
     if query_key != "q1":
         return run_sql_bench(query_key, sf, repeats)
 

@@ -205,7 +205,73 @@ def runtime_filter_mask(
     if axis is not None:
         bmin = jax.lax.pmin(bmin, axis)
         bmax = jax.lax.pmax(bmax, axis)
+    # All-NULL (or empty) build side: bmin stays I64MAX and bmax stays
+    # I64MIN, so bmin > bmax and the conjunction below is ALL-FALSE. That is
+    # the intended INNER/LEFT-SEMI semantics — an empty build key set
+    # matches nothing, so every probe row may be dropped. A refactor that
+    # "fixes" the inverted range into an all-true mask would silently keep
+    # the whole probe (wrong only in performance for the filter itself, but
+    # callers compact to the join estimate trusting the mask is a SUBSET of
+    # matches). Regression-pinned by test_runtime_filters.py.
     return (pk >= bmin) & (pk <= bmax)
+
+
+_BLOOM_SALT = 0x9E3779B97F4A7C15  # golden-ratio odd constant (2nd probe)
+
+
+def bloom_build_bitset(bk, b_ok, bits: int, axis: str | None = None):
+    """Build-side half of the bloom runtime filter: hash packed keys into a
+    power-of-2 bit array (one uint8 lane per bit — the gather/pmax-friendly
+    layout the dense bitmap already uses) via TWO independent splitmix64
+    probes. With `axis` the bitsets OR-merge across shards (pmax), exactly
+    like the dense presence bitmap — the global-RF collective."""
+    assert bits & (bits - 1) == 0, "bloom bit count must be a power of 2"
+    mask = jnp.uint64(bits - 1)
+    h1 = mix64(jnp.asarray(bk, jnp.int64).view(jnp.uint64))
+    h2 = mix64(h1 ^ jnp.uint64(_BLOOM_SALT))
+    i1 = jnp.where(b_ok, jnp.asarray(h1 & mask, jnp.int64), bits)
+    i2 = jnp.where(b_ok, jnp.asarray(h2 & mask, jnp.int64), bits)
+    bitset = (
+        jnp.zeros((bits,), jnp.uint8)
+        .at[i1].set(1, mode="drop")
+        .at[i2].set(1, mode="drop")
+    )
+    if axis is not None:
+        bitset = jax.lax.pmax(bitset, axis)  # bitwise OR across shards
+    return bitset
+
+
+def bloom_probe_bitset(bitset, pk, p_ok):
+    """Probe-side half: a row survives iff BOTH of its key's bloom probes
+    are set. Same hash chain as the build side, so a probe key equal to any
+    build key ALWAYS hits both its bits — the filter can never false-
+    negative (drop a matching row); collisions only keep extra rows, which
+    the join itself re-verifies."""
+    bits = bitset.shape[0]
+    mask = jnp.uint64(bits - 1)
+    h1 = mix64(jnp.asarray(pk, jnp.int64).view(jnp.uint64))
+    h2 = mix64(h1 ^ jnp.uint64(_BLOOM_SALT))
+    g1 = bitset[jnp.asarray(h1 & mask, jnp.int64)]
+    g2 = bitset[jnp.asarray(h2 & mask, jnp.int64)]
+    return p_ok & (pk != _I64MAX) & (g1 == 1) & (g2 == 1)
+
+
+def bloom_filter_mask(
+    probe: Chunk, build: Chunk, probe_keys, build_keys, bit_widths=None,
+    axis: str | None = None, bits: int = 1 << 20,
+):
+    """Bloom-bitset runtime filter: near-exact membership for ANY key range
+    — the strengths the dense bitmap can't reach (wide/sparse keys, hash-
+    packed multi-key tuples, missing stats). Works on the SAME packed keys
+    the join compares (dictionaries aligned by pack_key_pair), so equal
+    keys hash equal on both sides and matching probe rows always survive.
+
+    Only valid for INNER/LEFT SEMI joins (probe rows may be dropped); NULL
+    probe keys never match and are dropped, per SQL equality semantics."""
+    pk, p_ok, bk, b_ok = pack_key_pair(
+        probe, build, probe_keys, build_keys, bit_widths)
+    bitset = bloom_build_bitset(bk, b_ok, bits, axis)
+    return bloom_probe_bitset(bitset, pk, p_ok)
 
 
 def dense_semi_anti_mask(probe: Chunk, build: Chunk, probe_keys, build_keys,

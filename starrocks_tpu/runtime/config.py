@@ -94,6 +94,20 @@ config.define("compaction_trigger_rowsets", 8, True,
               "compact a stored table when its rowset count reaches this "
               "(0 disables auto-compaction)")
 config.define("enable_runtime_filters", True, True, "build-side min/max filters applied to join probes")
+config.define("runtime_filter_strategy", "auto", True,
+              "auto | minmax | bloom | off: probe-side join runtime filter. "
+              "auto = exact dense bitmap when catalog stats bound the key "
+              "range, else a bloom bitset (2-probe multiply-shift hash into "
+              "a power-of-2 bit array — near-exact membership for ANY key "
+              "range), else min/max; minmax = range filter only (legacy "
+              "weak half); bloom = force the bloom bitset; off = no probe "
+              "filter (A/B anchor). Also gates two-phase scan-level "
+              "pruning (host build-key bounds -> probe zonemap pruning)")
+config.define("rf_bloom_max_bits", 1 << 23, True,
+              "bit-array size cap for bloom runtime filters (rounded down "
+              "to a power of 2; ~8 bits/build-row are allocated up to this "
+              "cap — past it the filter degrades gracefully and the "
+              "planner stops treating it as near-exact)")
 config.define("hll_precision", 12, True,
               "HLL register-count exponent for approx_count_distinct / "
               "hll_sketch (2^p int8 registers; relative error ~1.04/2^(p/2))")

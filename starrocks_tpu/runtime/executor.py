@@ -75,7 +75,13 @@ class DeviceCache:
                # sort-subsystem knobs are likewise baked at trace time
                config.get("topn_strategy"),
                config.get("enable_packed_sort_keys"),
-               config.get("enable_sort_timing"))
+               config.get("enable_sort_timing"),
+               # runtime-filter strategy + bloom sizing pick the probe
+               # filter kernel at trace time; a SET must not serve a
+               # program traced under the old strategy
+               config.get("enable_runtime_filters"),
+               config.get("runtime_filter_strategy"),
+               config.get("rf_bloom_max_bits"))
         b = self.programs.get(key)
         if b is None:
             b = self.programs[key] = {"last": None, "progs": {}}
@@ -128,8 +134,34 @@ class DeviceCache:
             self._cols[key] = (jnp.argsort(bk, stable=True), None)
         return self._cols[key][0]
 
-    def chunk_for(self, handle, alias: str, columns, placement=None) -> Chunk:
-        """Device chunk of the requested columns, renamed to alias-qualified."""
+    def pruned_handle_for(self, handle, columns, bounds):
+        """(handle, scan_stats, tag) for an RF-pruned snapshot of a stored
+        table: loads only the files whose zonemaps may hold build keys
+        (TabletStore.load_table's rf_predicate channel), wrapped in a fresh
+        TableHandle so chunk_for and its column stats see the pruned
+        subset — and the chunk capacity tightens to it before compile.
+        Cached per (table, bounds, columns); DML invalidation covers it
+        (keys lead with the table name like every other cache entry)."""
+        from ..sql.scan_rf import bounds_predicate
+        from ..storage.catalog import TableHandle
+
+        tag = "rf:" + ",".join(f"{c}[{lo},{hi}]" for c, lo, hi in bounds)
+        key = (handle.name, "__rfscan__", tag, tuple(columns))
+        if key not in self._cols:
+            ht = handle.store.load_table(
+                handle.name, columns=list(columns),
+                rf_predicate=bounds_predicate(bounds))
+            stats = dict(handle.store.last_scan_stats)
+            ph = TableHandle(handle.name, ht, handle.unique_keys,
+                             handle.distribution)
+            self._cols[key] = ((ph, stats, tag), None)
+        return self._cols[key][0]
+
+    def chunk_for(self, handle, alias: str, columns, placement=None,
+                  cache_tag=None) -> Chunk:
+        """Device chunk of the requested columns, renamed to alias-qualified.
+        `cache_tag` overrides the column-cache namespace (RF-pruned scans
+        must not collide with the full-table entries)."""
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -137,7 +169,7 @@ class DeviceCache:
         reorder = None  # host row permutation + per-shard layout (hash modes)
         per_shard_rows = None
         if placement is None:
-            tag, put, n_shards = "local", jnp.asarray, 1
+            tag, put, n_shards = cache_tag or "local", jnp.asarray, 1
         else:
             mesh, axis, mode = placement
             replicated = mode == "replicated"
@@ -631,6 +663,33 @@ class Executor:
             fail_point("executor::before_recompile")
         raise ExecError(f"capacity did not converge after {max_recompiles} recompiles")
 
+    def _scan_runtime_filters(self, plan, profile) -> dict:
+        """Two-phase scan pruning, phase 2 glue: resolve host-evaluated
+        build key bounds (sql/scan_rf.py) into RF-pruned table snapshots
+        and report `rf_segments_pruned`. {(table, alias): (handle, tag)}."""
+        if not (config.get("enable_runtime_filters")
+                and config.get("runtime_filter_strategy") != "off"
+                and config.get("enable_zonemap_pruning")):
+            return {}
+        from ..sql.scan_rf import compute_scan_prune
+
+        try:
+            prune_map = compute_scan_prune(plan, self.catalog)
+        except Exception:  # noqa: BLE001 — stats must never fail a query
+            return {}
+        scan_rf: dict = {}
+        rf_segs = 0
+        for (t, a), (cols, bounds) in prune_map.items():
+            handle = self.catalog.get_table(t)
+            if handle is None:
+                continue
+            ph, stats, tag = self.cache.pruned_handle_for(handle, cols, bounds)
+            scan_rf[(t, a)] = (ph, tag)
+            rf_segs += stats.get("rf_pruned", 0)
+        if scan_rf:
+            profile.add_counter("rf_segments_pruned", rf_segs)
+        return scan_rf
+
     def _run(self, plan: LogicalPlan, profile: RuntimeProfile | None = None) -> Chunk:
         profile = profile or RuntimeProfile("query")
 
@@ -640,6 +699,8 @@ class Executor:
             if out is not None:
                 return out
 
+        scan_rf = self._scan_runtime_filters(plan, profile)
+
         def attempt(caps, p):
             def compile_cb():
                 compiled = compile_plan(plan, self.catalog, caps)
@@ -647,10 +708,16 @@ class Executor:
 
             def place_cb(scans_aux):
                 scans, aux = scans_aux
-                inputs = [
-                    self.cache.chunk_for(self.catalog.get_table(t), a, cols)
-                    for t, a, cols in scans
-                ]
+                inputs = []
+                for t, a, cols in scans:
+                    rf = scan_rf.get((t, a))
+                    if rf is not None:
+                        ph, tag = rf
+                        inputs.append(self.cache.chunk_for(
+                            ph, a, cols, cache_tag=tag))
+                    else:
+                        inputs.append(self.cache.chunk_for(
+                            self.catalog.get_table(t), a, cols))
                 for table, a, key_cols, bw in aux:
                     inputs.append(self.cache.build_order_for(
                         self.catalog.get_table(table), a, key_cols, bw))

@@ -540,23 +540,55 @@ def compile_distributed(
                             rm = REPLICATED
                             break
 
-            # build-side min/max runtime filter; with a sharded build the local
-            # bounds merge across shards via pmin/pmax (global-RF collective)
+            # build-side runtime filter on the probe; with a sharded build
+            # the local summaries merge across shards — pmin/pmax for the
+            # range filter, bitset pmax (bitwise OR) for the dense bitmap
+            # AND the bloom bitset (the global-RF collective). Strategy
+            # ladder matches the single-chip compiler: dense > bloom >
+            # min/max per `runtime_filter_strategy`.
             from ..runtime.config import config as _cfg
-            from ..ops.join import runtime_filter_mask
+            from ..ops.join import bloom_filter_mask, runtime_filter_mask
+            from .optimizer import estimate_rows
+            from .physical import (
+                bloom_rf_bits, bloom_rf_useful, dense_rf_range,
+                rf_strategy_of,
+            )
 
+            strategy = rf_strategy_of(_cfg)
             if p.kind in ("inner", "semi", "cross") and probe_keys and not (
                 len(probe_keys) == 1 and isinstance(probe_keys[0], Lit)
-            ) and _cfg.get("enable_runtime_filters"):
-                from .physical import dense_rf_range
-
+            ) and strategy != "off":
                 rf_axis = axis if _is_dist(rm) else None
-                dr = dense_rf_range(p.left, p.right, probe_keys, build_keys, catalog)
-                lc = lc.and_sel(
-                    runtime_filter_mask(lc, rc, tuple(probe_keys),
-                                        tuple(build_keys), bit_widths, rf_axis,
-                                        dense_range=dr)
-                )
+                dr = (dense_rf_range(p.left, p.right, probe_keys, build_keys,
+                                     catalog)
+                      if strategy == "auto" else None)
+                bloom = None
+                if dr is None and (strategy == "bloom" or (
+                        strategy == "auto"
+                        and bloom_rf_useful(p, probe_keys, build_keys,
+                                            catalog))):
+                    bloom = bloom_rf_bits(estimate_rows(p.right, catalog),
+                                          _cfg.get("rf_bloom_max_bits"))
+                n0 = lc.num_rows()
+                if dr is None and bloom is not None:
+                    bits, _exactish = bloom
+                    lc = lc.and_sel(bloom_filter_mask(
+                        lc, rc, tuple(probe_keys), tuple(build_keys),
+                        bit_widths, rf_axis, bits=bits))
+                    # replicated on every shard: host max-merge = the value
+                    checks[f"~ctr_rf_bloom_bits@{ordinal(p)}"] = (
+                        jnp.asarray(bits, jnp.int64)[None])
+                else:
+                    lc = lc.and_sel(runtime_filter_mask(
+                        lc, rc, tuple(probe_keys), tuple(build_keys),
+                        bit_widths, rf_axis, dense_range=dr))
+                pruned = n0 - lc.num_rows()
+                if _is_dist(lm):
+                    # per-shard prune counts SUM to the global total (the
+                    # round-6 counter convention: psum in-program so the
+                    # host max IS the cross-shard sum)
+                    pruned = jax.lax.psum(pruned, axis)
+                checks[f"~ctr_rf_rows_pruned@{ordinal(p)}"] = pruned[None]
 
             # --- distribution strategy ---
             def align_pos(mode, keys):

@@ -355,6 +355,45 @@ def substitute(e: Expr, mapping: dict) -> Expr:
     return e
 
 
+def probe_scan_chain(plan: LogicalPlan):
+    """(scan, chain) when `plan` is a pure LFilter/LProject chain over an
+    LScan (chain listed top-down, possibly empty); (None, []) otherwise.
+
+    The shape runtime-filter pushdown needs: filters/projects are row-wise,
+    so a probe mask computed against the BOTTOM scan commutes with the whole
+    chain — masking + compacting there shrinks capacity before any upstream
+    expression work instead of after it."""
+    chain = []
+    node = plan
+    while isinstance(node, (LFilter, LProject)):
+        chain.append(node)
+        node = node.child
+    if isinstance(node, LScan):
+        return node, chain
+    return None, []
+
+
+def keys_through_chain(keys, chain, scan: LScan):
+    """Rewrite exprs phrased over the chain TOP's output names into exprs
+    over the bottom scan's columns (substituting through each LProject's
+    rename/computation). None when any key fails to resolve into pure scan
+    columns — then the caller must apply its mask above the chain."""
+    exprs = list(keys)
+    for node in chain:  # top-down: undo each projection's renames
+        if isinstance(node, LProject):
+            mapping = dict(node.exprs)
+            exprs = [substitute(e, mapping) for e in exprs]
+    scan_cols = frozenset(scan.output_names())
+    for e in exprs:
+        try:
+            cols = expr_cols(e)
+        except Exception:  # noqa: BLE001 — unexpected expr shapes: no pushdown
+            return None
+        if not cols or not cols <= scan_cols:
+            return None
+    return exprs
+
+
 def _disjuncts(e: Expr):
     if isinstance(e, Call) and e.fn == "or":
         for a in e.args:

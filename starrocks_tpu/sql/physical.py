@@ -116,6 +116,75 @@ def unique_sets(plan: LogicalPlan, catalog) -> set:
     return set()
 
 
+def rf_strategy_of(cfg) -> str:
+    """Effective probe runtime-filter strategy: `runtime_filter_strategy`
+    gated by the master `enable_runtime_filters` toggle. Shared by the
+    single-chip and distributed compilers (plans must never diverge)."""
+    if not cfg.get("enable_runtime_filters"):
+        return "off"
+    s = cfg.get("runtime_filter_strategy")
+    return s if s in ("auto", "minmax", "bloom", "off") else "auto"
+
+
+def _floor_pow2(n: int) -> int:
+    return 1 << (max(int(n), 1).bit_length() - 1)
+
+
+def bloom_rf_useful(p, probe_keys, build_keys, catalog) -> bool:
+    """False for membership filters that cannot prune: a build whose key
+    set covers the probe's (a pure FK dimension, e.g. TPC-H Q9's lineitem
+    x partsupp) keeps every probe row, so the bloom would pay its build
+    scatter + probe gathers for zero pruned rows. Decided on cardinality
+    evidence, not plan shape (selective builds may be semi-join rewrites
+    with no literal LFilter below): a build estimated well under its key
+    column's origin-table rows is filtered -> useful; otherwise compare
+    the build size against the probe's key-TUPLE cardinality, estimated
+    with full correlation WITHIN one origin table (TPC-H Q9's
+    (l_partkey, l_suppkey) tuple set IS partsupp's key set — the naive
+    NDV product over-counts it 2500x) and independence ACROSS tables
+    (Q7's (l_suppkey, c_nationkey) pair really does take the cross
+    product, so a supplier-sized build prunes ~(1 - 1/|nation|))."""
+    est_b = estimate_rows(p.right, catalog)
+    for bk in build_keys:
+        if isinstance(bk, Col):
+            origin = col_origin(p.right, bk.name)
+            if origin is not None:
+                t = catalog.get_table(origin[0])
+                if t is not None and est_b < 0.8 * max(t.row_count, 1):
+                    return True
+    from .optimizer import _key_ndv
+
+    l_est = estimate_rows(p.left, catalog)
+    per_table: dict = {}
+    for pk in probe_keys:
+        if isinstance(pk, Col):
+            origin = col_origin(p.left, pk.name)
+            tbl = origin[0] if origin is not None else pk.name
+            nv = _key_ndv(p.left, pk.name, l_est, catalog)
+            per_table[tbl] = max(per_table.get(tbl, 1.0), nv)
+    ndv = 1.0
+    for nv in per_table.values():
+        ndv *= nv
+    ndv = min(ndv, max(l_est, 1.0))
+    return est_b < 0.5 * ndv
+
+
+def bloom_rf_bits(build_rows_est: float, max_bits: int):
+    """(bits, exactish) sizing a bloom RF at ~8 bits per estimated build row
+    (2 probes -> ~5% false positives), power-of-2, capped by
+    `rf_bloom_max_bits`. None when even the capped array would hold under
+    1 bit/key (fp ~75%+ — the probes cost more than they prune). exactish
+    marks an uncapped sizing: fp is low enough that the planner may compact
+    the filtered probe to the join estimate, like the dense bitmap path."""
+    want_n = int(8 * max(build_rows_est, 1.0))
+    want = max(1 << (want_n - 1).bit_length(), 1 << 12)
+    cap = max(_floor_pow2(max_bits), 1 << 12)
+    bits = min(want, cap)
+    if bits < build_rows_est:
+        return None
+    return bits, bits >= want
+
+
 DENSE_RF_MAX_RANGE = 1 << 23  # dense presence bitmaps up to 8M slots
 # (covers l_orderkey's 6M domain at SF1: TPC-H Q18's orders-semi-subquery
 # presence test rides one scatter + one gather instead of a 1.5M-row sort)
@@ -633,25 +702,101 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                         lc, rc, tuple(probe_keys), tuple(build_keys), dsr,
                         p.kind == "anti"))
 
-            # build-side min/max runtime filter on the probe (INNER/SEMI only —
-            # LEFT OUTER/ANTI must keep non-matching probe rows)
-            from ..ops.join import runtime_filter_mask
+            # build-side runtime filter on the probe (INNER/SEMI only — LEFT
+            # OUTER/ANTI must keep non-matching probe rows). Strength ladder
+            # per `runtime_filter_strategy`: exact dense bitmap (stats-
+            # bounded key range) > bloom bitset (ANY key range, near-exact)
+            # > min/max range. When the probe input is a pure filter/project
+            # chain over a scan, the mask applies at the BOTTOM of that
+            # chain and compacts THERE — capacity shrinks before the chain's
+            # expression work instead of after it (RF pushdown).
+            import jax.numpy as jnp
 
+            from ..ops.join import bloom_filter_mask, runtime_filter_mask
+            from .optimizer import (
+                _key_ndv, keys_through_chain, probe_scan_chain,
+            )
+
+            strategy = rf_strategy_of(_cfg)
             exact_rf = False
             if p.kind in ("inner", "semi", "cross") and probe_keys and not (
                 len(probe_keys) == 1 and isinstance(probe_keys[0], Lit)
-            ) and _cfg.get("enable_runtime_filters"):
-                dr = dense_rf_range(p.left, p.right, probe_keys, build_keys, catalog)
-                lc = lc.and_sel(
-                    runtime_filter_mask(lc, rc, tuple(probe_keys),
-                                        tuple(build_keys), bit_widths,
-                                        dense_range=dr)
-                )
-                # only the dense bitmap is an EXACT membership test; the
-                # min/max fallback may keep every probe row, so compacting
-                # to the join estimate after it would guarantee an
-                # overflow recompile on wide build key ranges
-                exact_rf = dr is not None
+            ) and strategy != "off":
+                dr = (dense_rf_range(p.left, p.right, probe_keys,
+                                     build_keys, catalog)
+                      if strategy == "auto" else None)
+                bloom = None
+                if dr is None and (strategy == "bloom" or (
+                        strategy == "auto"
+                        and bloom_rf_useful(p, probe_keys, build_keys,
+                                            catalog))):
+                    bloom = bloom_rf_bits(estimate_rows(p.right, catalog),
+                                          _cfg.get("rf_bloom_max_bits"))
+
+                def rf_mask(pc, keys):
+                    """(mask, exactish) for probe chunk `pc` keyed by
+                    `keys`; only the dense bitmap / uncapped bloom justify
+                    compacting the survivors to the join estimate — the
+                    min/max fallback may keep every probe row, so
+                    compacting after it would guarantee an overflow
+                    recompile on wide build key ranges."""
+                    if dr is not None:
+                        return runtime_filter_mask(
+                            pc, rc, tuple(keys), tuple(build_keys),
+                            bit_widths, dense_range=dr), True
+                    if bloom is not None:
+                        bits, exactish = bloom
+                        checks[f"~ctr_rf_bloom_bits@{ordinal(p)}"] = (
+                            jnp.asarray(bits, jnp.int64))
+                        return bloom_filter_mask(
+                            pc, rc, tuple(keys), tuple(build_keys),
+                            bit_widths, bits=bits), exactish
+                    return runtime_filter_mask(
+                        pc, rc, tuple(keys), tuple(build_keys),
+                        bit_widths), False
+
+                pushed = False
+                scan_node, chain = probe_scan_chain(p.left)
+                if ((dr is not None or bloom is not None)
+                        and scan_node is not None and chain):
+                    skeys = keys_through_chain(probe_keys, chain, scan_node)
+                    if skeys is not None:
+                        sc = emit(scan_node)
+                        n0 = sc.num_rows()
+                        m, exact_rf = rf_mask(sc, skeys)
+                        sc = sc.and_sel(m)
+                        checks[f"~ctr_rf_rows_pruned@{ordinal(p)}"] = (
+                            n0 - sc.num_rows())
+                        if exact_rf:
+                            # RF-survivor estimate at the scan: containment
+                            # (build rows / probe-key NDV) — the semi-join
+                            # cardinality formula
+                            est_sc = estimate_rows(scan_node, catalog)
+                            frac = 0.5
+                            if isinstance(skeys[0], Col):
+                                ndv = _key_ndv(scan_node, skeys[0].name,
+                                               est_sc, catalog)
+                                frac = min(estimate_rows(p.right, catalog)
+                                           / max(ndv, 1.0), 1.0)
+                            sc = maybe_compact(scan_node, sc,
+                                               f"{ordinal(p)}rf",
+                                               est=est_sc * frac)
+                        c2 = sc
+                        for node in reversed(chain):
+                            if isinstance(node, LFilter):
+                                c2 = filter_chunk(c2, node.predicate)
+                            else:
+                                c2 = project(c2,
+                                             [e for _, e in node.exprs],
+                                             [n for n, _ in node.exprs])
+                        lc = c2
+                        pushed = True
+                if not pushed:
+                    n0 = lc.num_rows()
+                    m, exact_rf = rf_mask(lc, probe_keys)
+                    lc = lc.and_sel(m)
+                    checks[f"~ctr_rf_rows_pruned@{ordinal(p)}"] = (
+                        n0 - lc.num_rows())
 
             # a runtime-filtered probe holds ~join-output-many live rows,
             # not plan-estimate-many: compact it to the JOIN estimate so the

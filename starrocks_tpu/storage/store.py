@@ -637,10 +637,18 @@ class TabletStore:
 
     # --- read path ------------------------------------------------------------
     def load_table(
-        self, name: str, columns=None, predicate: Optional[Expr] = None
+        self, name: str, columns=None, predicate: Optional[Expr] = None,
+        rf_predicate: Optional[Expr] = None,
     ) -> HostTable:
         """Read the table (optionally only some columns), pruning files whose
-        zonemaps prove the predicate false (segment zonemap filtering analog)."""
+        zonemaps prove the predicate false (segment zonemap filtering analog).
+
+        `rf_predicate` is the runtime-filter channel of two-phase scan
+        pruning: a build-side key-bound predicate derived at plan time from
+        a join's dimension subplan. It prunes with the SAME zonemap prover
+        but its kills are counted separately (`rf_pruned`) so the profile
+        can attribute skipped segments to join selectivity rather than the
+        query's own WHERE clause."""
         import pyarrow.parquet as pq
 
         from ..runtime.config import config
@@ -651,7 +659,7 @@ class TabletStore:
         pb = m.get("partition_by")
         part_zms = _partition_zonemaps(pb)
         chosen = []
-        total, pruned, part_pruned = 0, 0, 0
+        total, pruned, part_pruned, rf_pruned = 0, 0, 0, 0
         for rs in m["rowsets"]:
             for fmeta in rs["files"]:
                 total += 1
@@ -668,9 +676,15 @@ class TabletStore:
                 ):
                     pruned += 1
                     continue
+                if (prune_enabled and rf_predicate is not None
+                        and _zonemap_excludes(fmeta["zonemap"],
+                                              rf_predicate)):
+                    rf_pruned += 1
+                    continue
                 chosen.append(fmeta)
         self.last_scan_stats = {
             "files": total, "pruned": pruned, "partition_pruned": part_pruned,
+            "rf_pruned": rf_pruned,
         }
         if not chosen:
             # empty table with correct schema (wide layouts keep rank 2)

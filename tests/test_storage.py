@@ -52,7 +52,7 @@ def test_zonemap_pruning(tmp_path):
     pred = Call("gt", Col("t.k"), Lit(500))
     out = store.load_table("t", predicate=pred)
     assert store.last_scan_stats == {"files": 2, "pruned": 1,
-                                 "partition_pruned": 0}
+                                 "partition_pruned": 0, "rf_pruned": 0}
     assert out.num_rows == 100
     assert int(out.arrays["k"].min()) == 1000
 
