@@ -323,6 +323,18 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
 
     from starrocks_tpu.runtime.session import Session
 
+    # static verifier in warn mode: plan/key passes run on every bench
+    # query (findings counted in the summary line); the jaxpr re-trace is
+    # skipped so compile_s stays comparable across rounds.
+    # SR_TPU_PLAN_VERIFY_LEVEL / _TRACE env knobs override.
+    from starrocks_tpu import analysis as _sr_analysis
+    from starrocks_tpu.runtime.config import config as _sr_cfg
+
+    if "SR_TPU_PLAN_VERIFY_LEVEL" not in os.environ:
+        _sr_cfg.set("plan_verify_level", "warn")
+    if "SR_TPU_PLAN_VERIFY_TRACE" not in os.environ:
+        _sr_cfg.set("plan_verify_trace", False)
+
     detail = {"backend": jax.default_backend(), "sf": sf,
               "budget_s": _budget_s()}
     if only:
@@ -521,6 +533,7 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         "rf_rows_pruned": rf_totals.get("rf_rows_pruned", 0),
         "rf_segments_pruned": rf_totals.get("rf_segments_pruned", 0),
         "rf_bloom_bits": rf_totals.get("rf_bloom_bits", 0),
+        "verify_findings": _sr_analysis.findings_total(),
     }))
 
 

@@ -1,0 +1,250 @@
+"""Trace auditor: static checks over the jaxpr of compiled programs.
+
+The compiled world's failure modes don't look like exceptions — they look
+like a host callback silently serializing the pipeline, a float32 column
+quietly widening to float64, a profile counter that host-merges instead of
+psum-ing across shards (reports one shard's count), or a capacity-sized
+constant baked into the trace (recompile per capacity change AND HBM spent
+on dead weight). All four are mechanically visible in the jaxpr. This pass
+walks it — including pjit/shard_map/scan/while/cond sub-jaxprs — without
+executing or XLA-compiling anything.
+
+The cross-shard counter check is a taint analysis: inside a shard_map body,
+a value is "shard-variant" when it depends on a sharded input (or
+axis_index) and has not passed through an all-reduce (psum/pmax/pmin) or
+all_gather. A `~ctr_` output that is shard-variant would be max-merged by
+the host into ONE shard's count — the exact round-6 review bug.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import Finding
+
+# primitives whose outputs are identical on every shard regardless of input
+# shardedness (all-reduces + all_gather); all_to_all/ppermute stay variant
+_SHARD_INVARIANT_PRIMS = {"psum", "pmax", "pmin", "pmean", "all_gather",
+                          "psum2", "reduce_scatter"}
+# primitives that INTRODUCE shard variance with no tainted inputs
+_SHARD_VARIANT_SOURCES = {"axis_index"}
+
+# a baked constant this large is a capacity leak: stats-derived values
+# belong in inputs (retrace-safe), not literals (silent staleness + HBM)
+OVERSIZED_CONST_ELEMS = 1 << 20
+
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                   "callback", "python_callback"}
+
+
+def _sub_jaxprs(eqn):
+    """Every sub-jaxpr reachable from an eqn's params (pjit/closed_call ->
+    'jaxpr'; cond -> 'branches'; scan/while -> '*_jaxpr')."""
+    out = []
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (tuple, list)) else [v]
+        for x in vs:
+            if hasattr(x, "eqns"):  # Jaxpr
+                out.append(x)
+            elif hasattr(x, "jaxpr") and hasattr(x.jaxpr, "eqns"):
+                out.append(x.jaxpr)  # ClosedJaxpr
+    return out
+
+
+def _callback_target_module(eqn) -> str:
+    """Best-effort module of the host function behind a callback eqn."""
+    cb = eqn.params.get("callback")
+    for attr in ("f", "fun", "func", "callback_func", "_fun"):
+        inner = getattr(cb, attr, None)
+        if inner is not None:
+            cb = inner
+    while isinstance(cb, functools.partial):
+        cb = cb.func
+    return getattr(cb, "__module__", "") or ""
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk_eqns(sub)
+
+
+def audit_jaxpr(closed_jaxpr, counter_indices=()) -> list:
+    """All trace checks over one (closed) jaxpr.
+
+    counter_indices: positions in the FLATTENED output corresponding to
+    `~ctr_` profile-counter leaves — those must be shard-invariant inside
+    any shard_map body they originate from.
+    """
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    consts = getattr(closed_jaxpr, "consts", ())
+    findings = []
+
+    # --- oversized baked constants ------------------------------------------
+    for var, const in zip(jaxpr.constvars, consts):
+        size = getattr(np.asarray(const), "size", 0)
+        if size >= OVERSIZED_CONST_ELEMS:
+            findings.append(Finding(
+                "trace_check", "capacity-leak", str(var.aval),
+                f"constant of {size} elements baked into the trace "
+                f"(stats-derived arrays belong in inputs)",
+                severity="warn"))
+
+    for eqn in _walk_eqns(jaxpr):
+        name = eqn.primitive.name
+        # --- host callbacks inside traced code ------------------------------
+        if name in _CALLBACK_PRIMS:
+            mod = _callback_target_module(eqn)
+            if not mod.startswith("starrocks_tpu"):
+                # engine-sanctioned callback sites (UDF bridge, opt-in sort
+                # timing) are audited at source level by tools/src_lint.py;
+                # anything else snuck into the trace
+                findings.append(Finding(
+                    "trace_check", "host-callback", name,
+                    f"host callback into {mod or '<unknown>'} inside traced "
+                    f"code: serializes the device pipeline"))
+        # --- implicit float64 promotion -------------------------------------
+        if name == "convert_element_type":
+            new = eqn.params.get("new_dtype")
+            if new is not None and np.dtype(new) == np.float64 and any(
+                    getattr(v, "aval", None) is not None
+                    and getattr(v.aval, "dtype", None) is not None
+                    and np.dtype(v.aval.dtype) == np.float32
+                    for v in eqn.invars):
+                findings.append(Finding(
+                    "trace_check", "f64-promotion", name,
+                    "float32 value promoted to float64 inside the trace "
+                    "(doubles HBM + halves VPU lanes; cast explicitly at "
+                    "the column boundary if intended)", severity="warn"))
+
+    # --- counters must be shard-invariant -----------------------------------
+    findings += _check_counters(jaxpr, counter_indices)
+    return findings
+
+
+def _check_counters(jaxpr, counter_indices) -> list:
+    if not counter_indices:
+        return []
+    findings = []
+    wanted = set(counter_indices)
+
+    # map each top-level outvar back through trivial unary eqns to a
+    # shard_map eqn position, then taint-check the body outvar there
+    producer = {}
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            producer[ov] = eqn
+
+    passthrough = {"reshape", "broadcast_in_dim", "convert_element_type",
+                   "squeeze", "expand_dims", "slice", "copy"}
+
+    for idx in sorted(wanted):
+        if idx >= len(jaxpr.outvars):
+            continue
+        var = jaxpr.outvars[idx]
+        if _is_literal(var):
+            continue
+        eqn = producer.get(var)
+        seen = 0
+        while eqn is not None and eqn.primitive.name in passthrough \
+                and seen < 32:
+            var = eqn.invars[0]
+            if _is_literal(var):
+                eqn = None
+                break
+            eqn = producer.get(var)
+            seen += 1
+        if eqn is None:
+            continue
+        if eqn.primitive.name in ("shard_map", "pjit", "closed_call",
+                                  "custom_jvp_call", "remat"):
+            subs = _sub_jaxprs(eqn)
+            if not subs:
+                continue
+            body = subs[0]
+            try:
+                pos = list(eqn.outvars).index(var)
+            except ValueError:
+                continue
+            if pos >= len(body.outvars):
+                continue
+            if eqn.primitive.name == "shard_map":
+                tainted = _shard_taint(body, eqn)
+                bv = body.outvars[pos]
+                if not _is_literal(bv) and bv in tainted:
+                    findings.append(Finding(
+                        "trace_check", "non-psum-counter",
+                        f"outvar[{idx}]",
+                        "profile counter on a sharded stage is not psum-"
+                        "shaped: each shard reports its OWN count and the "
+                        "host max-merge keeps one shard's value"))
+            else:
+                # recurse one level (jit wrapper around the shard_map)
+                findings += _check_counters(subs[0], [pos])
+    return findings
+
+
+def _shard_taint(body, eqn):
+    """Variables in a shard_map body whose value may DIFFER across shards."""
+    tainted = set()
+    in_names = eqn.params.get("in_names")
+    if in_names is None:
+        in_names = [{} for _ in body.invars]
+    for v, names in zip(body.invars, in_names):
+        # in_names: dict of dim index -> axis names; non-empty = sharded
+        if isinstance(names, dict) and names:
+            tainted.add(v)
+    for sub_eqn in body.eqns:
+        name = sub_eqn.primitive.name
+        if name in _SHARD_VARIANT_SOURCES:
+            tainted.update(sub_eqn.outvars)
+            continue
+        if name in _SHARD_INVARIANT_PRIMS:
+            continue  # outputs identical across shards
+        # jax literals (constants) are shard-invariant and unhashable —
+        # only proper Vars participate in the taint set
+        if any(not _is_literal(v) and v in tainted for v in sub_eqn.invars):
+            # conservative: any tainted operand taints every output
+            # (incl. through pjit/scan/while/cond sub-calls)
+            tainted.update(sub_eqn.outvars)
+    return tainted
+
+
+def _is_literal(v) -> bool:
+    return hasattr(v, "val")  # jax.core.Literal carries its value inline
+
+
+def counter_output_indices(out_shape) -> list:
+    """Positions of `~ctr_` leaves in the flattened output pytree (the
+    order make_jaxpr's outvars use)."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(out_shape)
+    idx = []
+    for i, (path, _leaf) in enumerate(flat):
+        for k in path:
+            key = getattr(k, "key", None)
+            if isinstance(key, str) and key.startswith("~ctr_"):
+                idx.append(i)
+                break
+    return idx
+
+
+def audit_program(raw_fn, inputs, extra_args=()) -> list:
+    """Trace `raw_fn(inputs)` (Python trace only — no XLA) and audit the
+    resulting jaxpr. Returns findings; tracing failures yield a single
+    warn finding rather than raising (the auditor must never take down a
+    query on its own bugs)."""
+    import jax
+
+    try:
+        closed, out_shape = jax.make_jaxpr(
+            raw_fn, return_shape=True)(inputs, *extra_args)
+    except Exception as e:  # noqa: BLE001
+        return [Finding("trace_check", "audit-failed", type(e).__name__,
+                        f"could not retrace program for audit: {e}",
+                        severity="warn")]
+    return audit_jaxpr(closed, counter_output_indices(out_shape))

@@ -10,8 +10,10 @@ SQL surface later via information_schema-style listing.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
+import threading
 from typing import Any, Callable, Optional
 
 
@@ -23,20 +25,59 @@ class ConfigField:
     mutable: bool
     description: str
     value: Any = None
+    # trace=True declares that the knob's value is BAKED into compiled
+    # programs at trace time: the compiled-program cache key is built from
+    # the set of trace fields (runtime/executor.py program_bucket), and the
+    # key-completeness checker (analysis/key_check.py) fails any knob that
+    # is read during tracing but not declared here — marking the knob at
+    # its definition is the whole contract.
+    trace: bool = False
 
 
 class ConfigRegistry:
     def __init__(self):
         self._fields: dict = {}
         self._hooks: dict = {}
+        self._reads = threading.local()  # per-thread stack of read-sets
 
-    def define(self, name, default, mutable=True, description=""):
-        f = ConfigField(name, default, type(default), mutable, description, default)
+    def define(self, name, default, mutable=True, description="",
+               trace=False):
+        f = ConfigField(name, default, type(default), mutable, description,
+                        default, trace)
         self._fields[name] = f
         return f
 
     def get(self, name: str):
+        for s in getattr(self._reads, "stack", ()):
+            s.add(name)
         return self._fields[name].value
+
+    @contextlib.contextmanager
+    def record_reads(self):
+        """Collect the set of knob names read (via get) on this thread while
+        the context is open — the key-completeness checker's probe. Nested
+        windows record independently (inner executions audit themselves)."""
+        stack = getattr(self._reads, "stack", None)
+        if stack is None:
+            stack = self._reads.stack = []
+        reads: set = set()
+        stack.append(reads)
+        try:
+            yield reads
+        finally:
+            stack.remove(reads)
+
+    def trace_knobs(self) -> frozenset:
+        """Names of all knobs declared trace-affecting."""
+        return frozenset(f.name for f in self._fields.values() if f.trace)
+
+    def trace_key(self) -> tuple:
+        """(name, value) of every trace-affecting knob, sorted by name —
+        the config portion of the compiled-program cache key. Declaring a
+        knob trace=True is sufficient to key it; there is no second list
+        to keep in sync."""
+        return tuple(sorted(
+            (f.name, f.value) for f in self._fields.values() if f.trace))
 
     def set(self, name: str, value, force: bool = False):
         f = self._fields.get(name)
@@ -93,7 +134,8 @@ config.define("enable_zonemap_pruning", True, True, "prune parquet rowsets by zo
 config.define("compaction_trigger_rowsets", 8, True,
               "compact a stored table when its rowset count reaches this "
               "(0 disables auto-compaction)")
-config.define("enable_runtime_filters", True, True, "build-side min/max filters applied to join probes")
+config.define("enable_runtime_filters", True, True, "build-side min/max filters applied to join probes",
+              trace=True)
 config.define("runtime_filter_strategy", "auto", True,
               "auto | minmax | bloom | off: probe-side join runtime filter. "
               "auto = exact dense bitmap when catalog stats bound the key "
@@ -102,47 +144,59 @@ config.define("runtime_filter_strategy", "auto", True,
               "range), else min/max; minmax = range filter only (legacy "
               "weak half); bloom = force the bloom bitset; off = no probe "
               "filter (A/B anchor). Also gates two-phase scan-level "
-              "pruning (host build-key bounds -> probe zonemap pruning)")
+              "pruning (host build-key bounds -> probe zonemap pruning)",
+              trace=True)
 config.define("rf_bloom_max_bits", 1 << 23, True,
               "bit-array size cap for bloom runtime filters (rounded down "
               "to a power of 2; ~8 bits/build-row are allocated up to this "
               "cap — past it the filter degrades gracefully and the "
-              "planner stops treating it as near-exact)")
+              "planner stops treating it as near-exact)",
+              trace=True)
 config.define("hll_precision", 12, True,
               "HLL register-count exponent for approx_count_distinct / "
-              "hll_sketch (2^p int8 registers; relative error ~1.04/2^(p/2))")
+              "hll_sketch (2^p int8 registers; relative error ~1.04/2^(p/2))",
+              trace=True)
 config.define("bitmap_default_domain", 65536, True,
               "bitmap_agg value-domain size when catalog bounds are absent "
               "(values outside [0, domain) are dropped like the reference's "
-              "non-uint32 to_bitmap inputs)")
+              "non-uint32 to_bitmap inputs)",
+              trace=True)
 config.define("enable_mv_rewrite", True, True,
               "transparently rewrite queries onto FRESH matching "
               "materialized views (SPJG containment; sql/mv_rewrite.py)")
 config.define("enable_lowcard_agg", True, True,
-              "sort-free packed-code aggregation for dictionary-bounded group keys")
+              "sort-free packed-code aggregation for dictionary-bounded group keys",
+              trace=True)
 config.define("enable_scatter_free_segments", True, True,
               "lower segment reductions to one-hot matmuls / sorted prefix "
               "tricks instead of XLA scatters (TPU scatter serializes on "
-              "duplicate indices)")
+              "duplicate indices)",
+              trace=True)
 config.define("enable_cached_build_sort", True, True,
               "pass cached per-(table, key) build-side sort permutations "
-              "into compiled joins (skips the per-query build argsort)")
+              "into compiled joins (skips the per-query build argsort)",
+              trace=True)
 config.define("rand_seed", 42, True,
-              "seed for rand()/random() (deterministic per trace)")
+              "seed for rand()/random() (deterministic per trace)",
+              trace=True)
 config.define("dense_agg_domain_max", 0, True,
               "max bounded group-key domain covered by a dense packed-gid "
-              "aggregation capacity (0 = auto by backend)")
+              "aggregation capacity (0 = auto by backend)",
+              trace=True)
 config.define("segment_strategy", "auto", True,
               "auto | mxu | scatter | pallas: auto picks the MXU-friendly "
               "scatter-free strategies on TPU and plain scatters on CPU "
               "(where they are orders of magnitude faster); mxu/scatter "
               "force one side; pallas routes float segment sums through the "
               "explicit Pallas kernel (interpret-mode on CPU) — flip this "
-              "on hardware to benchmark it")
+              "on hardware to benchmark it",
+              trace=True)
 config.define("matmul_segsum_groups_max", 1024, True,
-              "max group count for the one-hot-matmul segment-sum strategy")
+              "max group count for the one-hot-matmul segment-sum strategy",
+              trace=True)
 config.define("bcast_segreduce_groups_max", 64, True,
-              "max group count for broadcast-reduce segment min/max/float-sum")
+              "max group count for broadcast-reduce segment min/max/float-sum",
+              trace=True)
 config.define("batch_rows_threshold", 0, True,
               "stream scan-aggregations in host batches when a table exceeds "
               "this many rows (0 = off); the spill/host-offload path")
@@ -156,14 +210,16 @@ config.define("enable_packed_sort_keys", True, True,
               "bools, stats-bounded ints) into ONE order-preserving int64 "
               "so multi-operand lexsorts become a single-key argsort "
               "(descending via complement, NULLS FIRST/LAST via a "
-              "sentinel bit per nullable key)")
+              "sentinel bit per nullable key)",
+              trace=True)
 config.define("topn_strategy", "auto", True,
               "auto | lexsort | pallas: ORDER BY .. LIMIT k strategy for "
               "packable keys. auto = threshold top-N (lax.top_k partial "
               "select, prunes rows past the k-th key before any gather); "
               "pallas routes the partial select through the explicit "
               "per-block Pallas selection kernel (interpret mode off-TPU); "
-              "lexsort forces the full multi-operand sort")
+              "lexsort forces the full multi-operand sort",
+              trace=True)
 config.define("enable_window_topn", True, True,
               "rewrite rank()/row_number()/dense_rank() <= k filters over "
               "a window into per-partition segmented top-N pruning (the "
@@ -172,16 +228,30 @@ config.define("enable_window_topn", True, True,
 config.define("enable_sort_timing", False, True,
               "sandwich device sorts between ordered host callbacks and "
               "report per-query 'sort_ms' profile counters (adds host "
-              "sync points: diagnostics only, keep off for benchmarks)")
+              "sync points: diagnostics only, keep off for benchmarks)",
+              trace=True)
 config.define("join_probe_strategy", "auto", True,
               "auto | pallas: route the unique-join probe searchsorted "
               "ladder through the explicit Pallas kernel "
               "(ops/pallas_kernels.probe_searchsorted_pallas; interpret "
-              "mode off-TPU) instead of jnp.searchsorted")
+              "mode off-TPU) instead of jnp.searchsorted",
+              trace=True)
 config.define("compilation_cache_dir", "", False,
               "persistent XLA compilation cache directory (survives process "
               "restarts; big win for TPU first-compiles). Set via "
               "SR_TPU_COMPILATION_CACHE_DIR.")
+config.define("plan_verify_level", "off", True,
+              "off | warn | strict: static invariant verification of every "
+              "optimized plan and freshly-compiled program "
+              "(starrocks_tpu/analysis/ — plan structure, jaxpr audit, "
+              "cache-key completeness). warn logs findings and counts them "
+              "in the query profile; strict fails the query on any "
+              "error-severity finding")
+config.define("plan_verify_trace", True, True,
+              "run the jaxpr trace auditor on every freshly-compiled "
+              "program when plan_verify_level != off (adds one extra "
+              "Python trace per compile; the plan/key passes are always "
+              "on at warn/strict)")
 config.load_env()
 
 

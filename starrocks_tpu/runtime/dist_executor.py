@@ -33,6 +33,22 @@ class DistExecutor(Executor):
         self.axis = self.mesh.axis_names[0]
         self.n = self.mesh.shape[self.axis]
 
+    def _verify_plan(self, plan, profile):
+        """Adds the distribution pass on top of the structural passes: the
+        plan must admit a legal partitioned lowering under the compiler's
+        own placement rules."""
+        super()._verify_plan(plan, profile)
+        from ..analysis import report, verify_level
+        from ..analysis.plan_check import check_distribution
+
+        if verify_level() == "off":
+            return
+        try:
+            findings = check_distribution(plan, self.catalog)
+        except Exception:  # noqa: BLE001 — verifier bug, not a query bug
+            return
+        report(findings, profile, where="distribution")
+
     def _run(self, plan, profile: RuntimeProfile | None = None) -> Chunk:
         profile = profile or RuntimeProfile("dist-query")
 
@@ -50,15 +66,16 @@ class DistExecutor(Executor):
                     )
                     for chunk, (_, m) in zip(inputs0, scans_meta)
                 )
-                fn = jax.jit(
-                    shard_map(
-                        compiled.fn, mesh=self.mesh,
-                        in_specs=(in_specs,),
-                        out_specs=(P(), P(self.axis)),
-                        check_vma=False,
-                    )
+                raw = shard_map(
+                    compiled.fn, mesh=self.mesh,
+                    in_specs=(in_specs,),
+                    out_specs=(P(), P(self.axis)),
+                    check_vma=False,
                 )
-                return fn, scans_meta
+                # raw (the un-jitted shard_map) goes to the trace auditor:
+                # its jaxpr exposes the shard_map body, where the psum-
+                # shaped-counter check runs
+                return jax.jit(raw), scans_meta, raw
 
             out, checks = self._cached_attempt(
                 ("dist", self.n, plan), caps, p, compile_cb, self._place

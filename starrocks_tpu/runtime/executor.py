@@ -66,22 +66,14 @@ class DeviceCache:
 
         # UDF create/replace/drop must invalidate EVERY session's compiled
         # plans (callbacks close over the registered callable): the epoch
-        # rides in the cache key so stale programs simply miss. Kernel-
-        # strategy flags are baked at TRACE time, so they key too — a SET
-        # segment_strategy/join_probe_strategy must not serve stale traces
-        key = (key, registry_epoch(),
-               config.get("segment_strategy"),
-               config.get("join_probe_strategy"),
-               # sort-subsystem knobs are likewise baked at trace time
-               config.get("topn_strategy"),
-               config.get("enable_packed_sort_keys"),
-               config.get("enable_sort_timing"),
-               # runtime-filter strategy + bloom sizing pick the probe
-               # filter kernel at trace time; a SET must not serve a
-               # program traced under the old strategy
-               config.get("enable_runtime_filters"),
-               config.get("runtime_filter_strategy"),
-               config.get("rf_bloom_max_bits"))
+        # rides in the cache key so stale programs simply miss. Every knob
+        # declared trace=True in runtime/config.py keys too — such knobs
+        # are baked at TRACE time, so a SET must not serve a stale trace.
+        # The key is BUILT from the declaration (config.trace_key()), and
+        # analysis/key_check.py fails any knob that is read during tracing
+        # without the declaration — the missing-knob bug class is closed
+        # at both ends.
+        key = (key, registry_epoch(), config.trace_key())
         b = self.programs.get(key)
         if b is None:
             b = self.programs[key] = {"last": None, "progs": {}}
@@ -317,12 +309,19 @@ class Executor:
         QUERIES_TOTAL.inc()
         try:
             with profile.timer("optimize"):
-                # plan-shaping flags key the cache (SET enable_window_topn
-                # must not serve a plan rewritten under the old setting)
-                opt_key = (plan, config.get("enable_window_topn"))
+                # plan-shaping flags key the cache (SET enable_window_topn /
+                # enable_mv_rewrite must not serve a plan rewritten under
+                # the old setting) — the knob list is shared with the
+                # key-completeness checker so the two can't drift
+                from ..analysis.key_check import OPT_KEY_KNOBS
+
+                opt_key = (plan,) + tuple(
+                    config.get(k) for k in OPT_KEY_KNOBS)
                 opt = self.cache.opt_plans.get(opt_key)
                 if opt is None:
-                    opt = optimize(plan, self.catalog)
+                    with config.record_reads() as opt_reads:
+                        opt = optimize(plan, self.catalog)
+                    self._verify_opt_reads(opt_reads, profile)
                     self.cache.opt_plans[opt_key] = opt
                     while len(self.cache.opt_plans) > DeviceCache.MAX_CACHED_PLANS:
                         self.cache.opt_plans.popitem(last=False)
@@ -331,6 +330,7 @@ class Executor:
                 # subquery resolution executes data-dependent sub-plans —
                 # never cached
                 plan = self._resolve_scalar_subqueries(opt)
+            self._verify_plan(plan, profile)
             out_chunk = self._run(plan, profile)
             with profile.timer("fetch_results"):
                 # spilled sorts return host-materialized results directly
@@ -343,6 +343,41 @@ class Executor:
         except Exception:
             QUERY_ERRORS.inc()
             raise
+
+    # --- static verification hooks (analysis/) --------------------------------
+    def _verify_plan(self, plan, profile):
+        """Per-query structural verification of the optimized plan (behind
+        SET plan_verify_level; see starrocks_tpu/analysis/)."""
+        from ..analysis import run_plan_checks, verify_level
+
+        if verify_level() == "off":
+            return
+        run_plan_checks(plan, self.catalog, profile)
+
+    def _verify_opt_reads(self, reads, profile):
+        """Optimized-plan cache-key completeness: knobs read during
+        optimize() must be part of opt_key (key_check.OPT_KEY_KNOBS)."""
+        from ..analysis import report, verify_level
+        from ..analysis.key_check import check_opt_reads
+
+        if verify_level() == "off":
+            return
+        report(check_opt_reads(reads), profile, where="optimize")
+
+    def _verify_compile(self, raw_fn, inputs, reads, profile):
+        """Fresh-compile verification: program cache-key completeness from
+        the recorded knob read-set, plus the jaxpr trace audit."""
+        from ..analysis import report, verify_level
+        from ..analysis.key_check import check_trace_reads
+
+        if verify_level() == "off":
+            return
+        findings = check_trace_reads(reads)
+        if config.get("plan_verify_trace"):
+            from ..analysis import trace_check
+
+            findings += trace_check.audit_program(raw_fn, inputs)
+        report(findings, profile, where="compile")
 
     # --- group_concat orchestration -------------------------------------------
     def _execute_group_concat(self, plan, gc, profile):
@@ -704,7 +739,8 @@ class Executor:
         def attempt(caps, p):
             def compile_cb():
                 compiled = compile_plan(plan, self.catalog, caps)
-                return jax.jit(compiled.fn), (compiled.scans, compiled.aux)
+                return (jax.jit(compiled.fn),
+                        (compiled.scans, compiled.aux), compiled.fn)
 
             def place_cb(scans_aux):
                 scans, aux = scans_aux
@@ -819,21 +855,36 @@ class Executor:
         Caching is retrace-safe: the traced fns keep ALL mutable state inside
         the traced function and return overflow checks as a statically-keyed
         dict, so a cached fn simply retraces when input structure changes
-        (DML growing a table, new string dictionaries)."""
+        (DML growing a table, new string dictionaries).
+
+        compile_cb returns (jitted_fn, scans, raw_fn): raw_fn is the
+        un-jitted traceable program, handed to the trace auditor on every
+        fresh compile (cache hits were audited when first compiled)."""
         bucket = self.cache.program_bucket(cache_key)
         if not caps.values and bucket["last"]:
             # adopt the last successful capacities: skips re-discovering
             # overflows (and usually any recompile) on repeated queries
             caps.values.update(bucket["last"])
         hit = bucket["progs"].get(tuple(sorted(caps.values.items())))
+        raw = reads = None
         if hit is None:
-            fn, scans = compile_cb()
+            # record every knob read from compile through the first call
+            # (jit traces lazily INSIDE that call) — the key-completeness
+            # checker's probe window
+            with config.record_reads() as reads:
+                fn, scans, raw = compile_cb()
+                with p.timer("scan_to_device"):
+                    inputs = place_cb(scans)
+                out, checks = fn(inputs)
+                jax.block_until_ready(out.data)
         else:
             fn, scans = hit
-        with p.timer("scan_to_device"):
-            inputs = place_cb(scans)
-        out, checks = fn(inputs)
-        jax.block_until_ready(out.data)
+            with p.timer("scan_to_device"):
+                inputs = place_cb(scans)
+            out, checks = fn(inputs)
+            jax.block_until_ready(out.data)
+        if raw is not None:
+            self._verify_compile(raw, inputs, reads, p)
         # caps defaults fill during the first trace; record entries after it
         bucket["progs"].setdefault(tuple(sorted(caps.values.items())), (fn, scans))
         # store by REFERENCE: the adaptive loop tightens over-seeded caps

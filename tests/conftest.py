@@ -37,6 +37,14 @@ if not _sr_config.get("compilation_cache_dir"):
         force=True,  # not runtime-mutable; the harness sets it pre-backend
     )
 
+# Static verification in warn mode for the whole tier-1 suite: every
+# optimized plan and every fresh compile runs the analysis/ passes; findings
+# log + count in the profile but never fail a test (strict enforcement lives
+# in tools/plan_lint.py and the golden fixtures of test_plan_verifier.py).
+# SR_TPU_PLAN_VERIFY_LEVEL overrides (e.g. "off" to time the suite bare).
+if "SR_TPU_PLAN_VERIFY_LEVEL" not in os.environ:
+    _sr_config.set("plan_verify_level", "warn")
+
 import pytest  # noqa: E402
 
 

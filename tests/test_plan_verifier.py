@@ -1,0 +1,325 @@
+"""Static-verifier tests: golden bad-plan fixtures that MUST fail strict,
+and the clean-corpus guarantee (every TPC-H/SSB/TPC-DS query passes).
+
+Each fixture reproduces one invariant class a past round shipped a bug in:
+- schema mismatch (operator references a column its child never produces);
+- replicated-operand join without an exchange (distribution pass);
+- a profile counter on a sharded stage that is not psum-shaped (the host
+  max-merge would report ONE shard's count — round-6 review bug);
+- a knob read during tracing but missing from the compiled-program cache
+  key (a SET could serve a stale trace — round-7 bug).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from starrocks_tpu.analysis import Finding, VerifyError, report
+from starrocks_tpu.analysis import key_check, plan_check, trace_check
+from starrocks_tpu.exprs.ir import AggExpr, Call, Col, Lit
+from starrocks_tpu.runtime.config import config
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.sql.logical import (
+    LAggregate, LFilter, LJoin, LProject, LScan, LSort,
+)
+from starrocks_tpu.storage.catalog import tpch_catalog
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return tpch_catalog(sf=0.001)
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == "error"]
+
+
+# --- golden fixture 1: schema mismatch ---------------------------------------
+
+
+def test_schema_mismatch_rejected(catalog):
+    scan = LScan("nation", "nation", ("n_nationkey", "n_name"))
+    bad = LFilter(scan, Call("eq", Col("nation.n_regionkey"), Lit(1)))
+    findings = plan_check.check_plan(bad, catalog)
+    errs = _errors(findings)
+    assert errs, "schema mismatch must be an error finding"
+    f = errs[0]
+    assert f.invariant == "schema-agreement"
+    assert "n_regionkey" in f.message
+    assert "Filter" in f.node  # names the offending op
+    with pytest.raises(VerifyError):
+        report(findings, level="strict")
+
+
+def test_schema_join_condition_and_duplicates(catalog):
+    l = LScan("nation", "n1", ("n_nationkey",))
+    r = LScan("region", "r1", ("r_regionkey",))
+    bad = LJoin(l, r, "inner",
+                Call("eq", Col("n1.n_nationkey"), Col("r1.r_name")))
+    errs = _errors(plan_check.check_plan(bad, catalog))
+    assert any(f.invariant == "schema-agreement" and "r_name" in f.message
+               for f in errs)
+    # ambiguous outputs: same alias+column from both sides
+    dup = LJoin(LScan("nation", "n1", ("n_nationkey",)),
+                LScan("nation", "n1", ("n_nationkey",)), "inner",
+                Call("eq", Col("n1.n_nationkey"), Col("n1.n_nationkey")))
+    errs = _errors(plan_check.check_plan(dup, catalog))
+    assert any("ambiguous" in f.message or "duplicate" in f.message
+               for f in errs)
+
+
+def test_dtype_mismatch_rejected(catalog):
+    # joining an int key against a dict-coded string column compares codes
+    # to values
+    l = LScan("nation", "n1", ("n_nationkey",))
+    r = LScan("region", "r1", ("r_name",))
+    bad = LJoin(l, r, "inner",
+                Call("eq", Col("n1.n_nationkey"), Col("r1.r_name")))
+    findings = plan_check.check_dtypes(bad, catalog)
+    assert any(f.invariant == "dtype-agreement" for f in _errors(findings))
+
+
+# --- golden fixture 2: replicated-operand join without exchange --------------
+
+
+def test_replicated_join_without_exchange_rejected(catalog):
+    from starrocks_tpu.sql.distributed import REPLICATED, SHARDED
+
+    probe = LScan("nation", "n1", ("n_nationkey", "n_name"))
+    build = LScan("customer", "c1", ("c_custkey", "c_nationkey"))
+    join = LJoin(probe, build, "inner",
+                 Call("eq", Col("n1.n_nationkey"), Col("c1.c_nationkey")))
+    # declared physical plan: replicated probe x partitioned build, NO
+    # exchange — each shard would join the whole probe against one build
+    # fragment and the "result" is per-shard garbage
+    modes = {id(probe): REPLICATED, id(build): SHARDED}
+    findings = plan_check.check_distribution(
+        join, catalog, scan_modes=modes, managed_exchanges=False)
+    errs = _errors(findings)
+    assert any(f.invariant == "distribution"
+               and "replicated probe" in f.message
+               and "Join" in f.node for f in errs)
+    with pytest.raises(VerifyError):
+        report(findings, level="strict")
+    # same operands WITH compiler-managed exchanges: legal
+    clean = plan_check.check_distribution(
+        join, catalog, scan_modes=modes, managed_exchanges=True)
+    assert not _errors(clean)
+
+
+def test_uncolocated_sharded_join_needs_exchange(catalog):
+    from starrocks_tpu.sql.distributed import SHARDED
+
+    a = LScan("orders", "o", ("o_orderkey", "o_custkey"))
+    b = LScan("lineitem", "l", ("l_orderkey",))
+    join = LJoin(a, b, "inner",
+                 Call("eq", Col("o.o_orderkey"), Col("l.l_orderkey")))
+    modes = {id(a): SHARDED, id(b): SHARDED}
+    errs = _errors(plan_check.check_distribution(
+        join, catalog, scan_modes=modes, managed_exchanges=False))
+    assert any("not colocated" in f.message for f in errs)
+    # hash-colocated on the join keys: no exchange needed even undeclared
+    modes = {id(a): ("hash", "o.o_orderkey"), id(b): ("hash", "l.l_orderkey")}
+    plan2 = LAggregate(join, (("k", Col("o.o_orderkey")),),
+                       (("n", AggExpr("count", None)),))
+    findings = plan_check.check_distribution(
+        plan2, catalog, scan_modes=modes, managed_exchanges=False)
+    assert not [f for f in _errors(findings) if "Join" in f.node]
+
+
+# --- golden fixture 3: non-psum profile counter on a sharded stage -----------
+
+
+def _counter_program(use_psum: bool):
+    from starrocks_tpu.parallel.mesh import make_mesh, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(8)
+
+    def step(x):
+        local = jnp.sum(x)  # per-shard count
+        ctr = jax.lax.psum(local, "d") if use_psum else local
+        return {"~ctr_rows_pruned@0": ctr[None]}
+
+    return shard_map(step, mesh=mesh, in_specs=(P("d"),), out_specs=P("d")), \
+        jnp.ones((64,), jnp.int64)
+
+
+def test_non_psum_counter_rejected(eight_devices):
+    raw, x = _counter_program(use_psum=False)
+    findings = trace_check.audit_program(raw, x)
+    errs = _errors(findings)
+    assert any(f.invariant == "non-psum-counter" for f in errs), findings
+    with pytest.raises(VerifyError):
+        report(findings, level="strict")
+
+
+def test_psum_counter_clean(eight_devices):
+    raw, x = _counter_program(use_psum=True)
+    findings = trace_check.audit_program(raw, x)
+    assert not [f for f in findings if f.invariant == "non-psum-counter"], \
+        findings
+
+
+def test_distributed_corpus_counters_clean(eight_devices, catalog):
+    """The REAL distributed compiler's counters must audit clean (they
+    psum on sharded stages by construction)."""
+    config.set("plan_verify_level", "strict")
+    try:
+        s = Session(tpch_catalog(sf=0.01), dist_shards=8)
+        res = s.sql("select l_returnflag, count(*) n, sum(l_quantity) q "
+                    "from lineitem group by l_returnflag order by n desc "
+                    "limit 3")
+        assert res.table.num_rows == 3
+    finally:
+        config.set("plan_verify_level", "off")
+
+
+# --- golden fixture 4: knob read during trace but outside the cache key ------
+
+
+def test_knob_outside_key_rejected():
+    config.define("_test_rogue_knob", 7, True, "verifier fixture knob")
+    try:
+        with config.record_reads() as reads:
+            config.get("_test_rogue_knob")
+        findings = key_check.check_trace_reads(reads)
+        errs = _errors(findings)
+        assert any(f.invariant == "knob-outside-key"
+                   and "_test_rogue_knob" in f.node for f in errs)
+        with pytest.raises(VerifyError):
+            report(findings, level="strict")
+    finally:
+        config._fields.pop("_test_rogue_knob", None)
+
+
+def test_declared_trace_knob_clean():
+    config.define("_test_keyed_knob", 7, True, "verifier fixture knob",
+                  trace=True)
+    try:
+        with config.record_reads() as reads:
+            config.get("_test_keyed_knob")
+        assert key_check.check_trace_reads(reads) == []
+        # and the declaration alone puts it in the program cache key
+        assert ("_test_keyed_knob", 7) in config.trace_key()
+    finally:
+        config._fields.pop("_test_keyed_knob", None)
+
+
+def test_engine_trace_reads_are_keyed(catalog):
+    """End-to-end round-7 regression: trace a real program, record every
+    knob read, and require the read-set to be covered by the key."""
+    from starrocks_tpu.sql.analyzer import Analyzer
+    from starrocks_tpu.sql.optimizer import optimize
+    from starrocks_tpu.sql.parser import parse
+    from starrocks_tpu.sql.physical import Caps, compile_plan
+
+    plan = optimize(Analyzer(catalog).analyze(parse(
+        "select n_name, count(*) c from nation, customer "
+        "where n_nationkey = c_nationkey group by n_name")), catalog)
+    caps = Caps({})
+    with config.record_reads() as reads:
+        compiled = compile_plan(plan, catalog, caps)
+        # force the actual trace (knob reads inside ops happen here)
+        from starrocks_tpu.runtime.executor import DeviceCache
+
+        cache = DeviceCache()
+        inputs = tuple(
+            cache.chunk_for(catalog.get_table(t), a, cols)
+            for t, a, cols in compiled.scans)
+        jax.make_jaxpr(compiled.fn)(inputs)
+    assert key_check.check_trace_reads(reads) == [], reads
+
+
+def test_opt_key_covers_optimizer_reads(catalog):
+    from starrocks_tpu.sql.analyzer import Analyzer
+    from starrocks_tpu.sql.optimizer import optimize
+    from starrocks_tpu.sql.parser import parse
+
+    plan = Analyzer(catalog).analyze(parse(
+        "select * from (select n_name, rank() over (order by n_nationkey) r "
+        "from nation) t where r <= 3"))
+    with config.record_reads() as reads:
+        optimize(plan, catalog)
+    assert key_check.check_opt_reads(reads) == [], reads
+
+
+# --- capacity monotonicity + null semantics ----------------------------------
+
+
+def test_capacity_monotonicity_flags_non_monotone_estimate(catalog):
+    # a Sort claiming more output rows than its limit allows is only
+    # constructible by corrupting the estimate: emulate with a bound probe
+    scan = LScan("customer", "c", ("c_custkey",))
+    sort = LSort(scan, ((Col("c.c_custkey"), True, False),), limit=10)
+    assert plan_check._row_bound(sort, catalog) == 10.0
+    clean = plan_check.check_capacities(sort, catalog)
+    assert not _errors(clean)
+
+
+def test_null_comparison_warned(catalog):
+    scan = LScan("nation", "n", ("n_nationkey",))
+    bad = LFilter(scan, Call("eq", Col("n.n_nationkey"), Lit(None)))
+    findings = plan_check.check_null_semantics(bad, catalog)
+    assert any(f.invariant == "null-semantics" for f in findings)
+    assert all(f.severity == "warn" for f in findings)  # advisory only
+
+
+# --- strict end-to-end through the Session -----------------------------------
+
+
+def test_strict_mode_executes_clean_queries():
+    config.set("plan_verify_level", "strict")
+    try:
+        s = Session(tpch_catalog(sf=0.001))
+        res = s.sql("select n_name from nation order by n_name limit 5")
+        assert res.table.num_rows == 5
+    finally:
+        config.set("plan_verify_level", "off")
+
+
+# --- the whole corpus verifies clean -----------------------------------------
+
+
+def _corpus_plans():
+    import sys
+
+    sys.path.insert(0, "tests")
+    from tests.tpch_queries import QUERIES as TPCH
+    from tests.ssb_queries import FLAT_QUERIES as SSB
+    from tests.tpcds_queries import QUERIES as TPCDS
+
+    return [("tpch", f"q{k}", v) for k, v in sorted(TPCH.items())] + \
+        [("ssb", k, v) for k, v in sorted(SSB.items())] + \
+        [("tpcds", k, v) for k, v in sorted(TPCDS.items())]
+
+
+@pytest.fixture(scope="module")
+def corpus_sessions():
+    from starrocks_tpu.storage.datagen.ssb import ssb_catalog
+    from starrocks_tpu.storage.datagen.tpcds import tpcds_catalog
+
+    return {
+        "tpch": Session(tpch_catalog(sf=0.001)),
+        "ssb": Session(ssb_catalog(sf=0.002)),
+        "tpcds": Session(tpcds_catalog(sf=0.002)),
+    }
+
+
+@pytest.mark.parametrize("suite,name,text", _corpus_plans())
+def test_corpus_plan_clean(corpus_sessions, suite, name, text):
+    """Every corpus query's optimized plan passes the structural passes
+    with zero error findings (warn-severity advisories allowed), and its
+    distributed lowering is legal under managed exchanges."""
+    from starrocks_tpu.sql.analyzer import Analyzer
+    from starrocks_tpu.sql.optimizer import optimize
+    from starrocks_tpu.sql.parser import parse
+
+    sess = corpus_sessions[suite]
+    plan = optimize(Analyzer(sess.catalog).analyze(parse(text)),
+                    sess.catalog)
+    findings = plan_check.check_plan(plan, sess.catalog)
+    findings += plan_check.check_distribution(plan, sess.catalog)
+    errs = _errors(findings)
+    assert not errs, f"{suite}/{name}: {[str(f) for f in errs]}"

@@ -1,6 +1,17 @@
 #!/usr/bin/env bash
-# Tier-1 verify gate — the EXACT command from ROADMAP.md ("Tier-1 verify"),
-# so builders and reviewers run the same thing the driver enforces.
-# Prints DOTS_PASSED=<n> and exits with pytest's status.
+# Tier-1 verify gate — the EXACT pytest command from ROADMAP.md ("Tier-1
+# verify"), so builders and reviewers run the same thing the driver
+# enforces, preceded by the static-analysis gates (tools/src_lint.py +
+# tools/plan_lint.py --corpus): new invariant violations fail the gate
+# before the test suite even starts.
+# Prints DOTS_PASSED=<n> and exits non-zero on any failing stage.
 cd "$(dirname "$0")/.." || exit 1
+
+echo "== src_lint =="
+python tools/src_lint.py || exit 1
+
+echo "== plan_lint --corpus =="
+timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/plan_lint.py --corpus || exit 1
+
+echo "== tier-1 pytest =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

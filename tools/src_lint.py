@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""AST source lint for JAX pitfalls in starrocks_tpu/.
+
+Two rules, both for bug classes that pass every unit test and then burn on
+real hardware:
+
+R1 shard-map-shim: `shard_map` must be imported from parallel/mesh.py (the
+   version shim that handles the jax>=0.6 move and the check_vma/check_rep
+   rename), never from jax directly. A bare import works on exactly one jax
+   version.
+
+R2 traced-host-op: inside TRACED scopes — functions handed to jax.jit /
+   shard_map, and the program closures built by compile_plan /
+   compile_distributed (`run` / `step`) — calling `.item()` or
+   `np.asarray`/`np.array` on a traced value either crashes at trace time
+   (ConcretizationTypeError) or silently freezes a trace-time constant into
+   the program. Host callbacks registered via pure_callback/io_callback/
+   debug_callback are exempt (numpy there is the point), as is any line
+   tagged `# lint: host-ok`.
+
+Exit 1 on any finding; each names file:line, the rule, and the offending op.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "starrocks_tpu")
+SHIM = os.path.join("starrocks_tpu", "parallel", "mesh.py")
+
+CALLBACK_FNS = {"pure_callback", "io_callback", "debug_callback"}
+TRACE_BUILDERS = {"compile_plan": {"run"}, "compile_distributed": {"step"}}
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _is_np(node) -> bool:
+    return isinstance(node, ast.Name) and node.id in ("np", "numpy")
+
+
+class Linter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, src: str):
+        self.path = path
+        self.rel = rel
+        self.lines = src.splitlines()
+        self.findings: list = []
+        self._traced_depth = 0
+        self._func_stack: list = []
+        # names of local functions passed to jit/shard_map somewhere in
+        # this module: defs with those names are traced roots
+        self.traced_names: set = set()
+        # (lineno of defs that are callback host-fns) — exempt subtrees
+        self.callback_args: set = set()
+
+    def add(self, node, rule, msg):
+        line = self.lines[node.lineno - 1] if node.lineno <= len(
+            self.lines) else ""
+        if "lint: host-ok" in line:
+            return
+        self.findings.append(f"{self.rel}:{node.lineno}: [{rule}] {msg}")
+
+    # --- pass 1: collect traced / callback names -----------------------------
+    def collect(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name in ("jit", "shard_map"):
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            self.traced_names.add(a.id)
+                if name in CALLBACK_FNS and node.args:
+                    a = node.args[0]
+                    if isinstance(a, ast.Name):
+                        self.callback_args.add(a.id)
+
+    # --- pass 2: walk with traced-scope tracking -----------------------------
+    def visit_Import(self, node):
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        mod = node.module or ""
+        names = {a.name for a in node.names}
+        if self.rel != SHIM and (
+                ("shard_map" in names and mod.startswith("jax"))
+                or mod == "jax.experimental.shard_map"):
+            self.add(node, "shard-map-shim",
+                     f"import shard_map from parallel/mesh.py, not "
+                     f"{mod!r} (version shim bypassed)")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        # jax.experimental.shard_map.* attribute access
+        if (node.attr == "shard_map" and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "experimental"
+                and self.rel != SHIM):
+            self.add(node, "shard-map-shim",
+                     "use parallel/mesh.py's shard_map shim")
+        self.generic_visit(node)
+
+    def _enter_func(self, node):
+        traced = False
+        name = getattr(node, "name", "<lambda>")
+        if name in self.traced_names:
+            traced = True
+        parent = self._func_stack[-1] if self._func_stack else None
+        if parent is not None and name in TRACE_BUILDERS.get(parent, ()):
+            traced = True
+        if self._traced_depth and name in self.callback_args:
+            traced = False  # host callback body nested in a traced scope
+            self._func_stack.append(name)
+            self._visit_body(node, bump=0, host_exempt=True)
+            self._func_stack.pop()
+            return
+        self._func_stack.append(name)
+        self._visit_body(node, bump=1 if (traced or self._traced_depth) else 0)
+        self._func_stack.pop()
+
+    def _visit_body(self, node, bump: int, host_exempt: bool = False):
+        if host_exempt:
+            # walk without traced context (nested defs restart clean)
+            saved = self._traced_depth
+            self._traced_depth = 0
+            for child in ast.iter_child_nodes(node):
+                self.visit(child)
+            self._traced_depth = saved
+            return
+        self._traced_depth += bump
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self._traced_depth -= bump
+
+    def visit_FunctionDef(self, node):
+        self._enter_func(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._visit_body(node, bump=0)
+
+    def visit_Call(self, node):
+        if self._traced_depth:
+            name = _call_name(node)
+            if name == "item" and isinstance(node.func, ast.Attribute):
+                self.add(node, "traced-host-op",
+                         ".item() inside a traced function pulls the value "
+                         "to host (trace-time concretization)")
+            if name in ("asarray", "array") and isinstance(
+                    node.func, ast.Attribute) and _is_np(node.func.value):
+                self.add(node, "traced-host-op",
+                         f"np.{name}() inside a traced function freezes a "
+                         f"trace-time constant (use jnp, or tag the line "
+                         f"`# lint: host-ok` if the operand is static)")
+        self.generic_visit(node)
+
+
+def lint_file(path: str) -> list:
+    rel = os.path.relpath(path, REPO)
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: [parse] {e.msg}"]
+    linter = Linter(path, rel, src)
+    linter.collect(tree)
+    for node in tree.body:
+        linter.visit(node)
+    return linter.findings
+
+
+def main():
+    findings = []
+    for root, _dirs, files in os.walk(PKG):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                findings += lint_file(os.path.join(root, fn))
+    for f in findings:
+        print(f)
+    print(f"src_lint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
