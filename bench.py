@@ -87,7 +87,35 @@ def _rows_match(got, exp):
     return norm(got) == norm(tuple(r) for r in rows)
 
 
-def _bench_sql(session, text, rows_base, repeats, oracle=None):
+def _qcache_repeat(session, text, n: int) -> dict:
+    """Query-cache A/B for one query (--repeat N): one cold run with the
+    full-result tier dropped, then N-1 warm repeats that should hit it.
+    Counters accumulate across the runs from each run's profile."""
+    qc = session.cache.qcache
+    qc.drop_results()
+    totals = {"qcache_hits": 0, "qcache_partial_hits": 0,
+              "qcache_rows_saved": 0}
+
+    def timed():
+        t0 = time.time()
+        session.sql(text)
+        dt = time.time() - t0
+        prof = getattr(session, "last_profile", None)
+        if prof is not None:
+            for k in totals:
+                totals[k] += int(prof.counters.get(k, (0,))[0])
+        return dt
+
+    cold_ms = timed() * 1000
+    warm_ms = min(timed() for _ in range(max(1, n - 1))) * 1000
+    return {
+        "cold_ms": round(cold_ms, 2), "warm_ms": round(warm_ms, 2),
+        "warm_speedup": round(cold_ms / warm_ms, 2) if warm_ms else 0.0,
+        **totals,
+    }
+
+
+def _bench_sql(session, text, rows_base, repeats, oracle=None, qrepeat=0):
     """Time one query through the full SQL path on an existing session.
 
     Returns a detail dict. Wall times include the host->device command
@@ -114,6 +142,17 @@ def _bench_sql(session, text, rows_base, repeats, oracle=None):
               if k.startswith("rf_")}
         if rf:
             out["rf"] = rf
+    if qrepeat > 1:
+        # cold-vs-warm through the query cache (runs AFTER the uncached
+        # timings above so device_ms/compile_s stay comparable across
+        # rounds; enable_query_cache flips only around this block)
+        from starrocks_tpu.runtime.config import config as _cfg
+
+        _cfg.set("enable_query_cache", True)
+        try:
+            out["qcache"] = _qcache_repeat(session, text, qrepeat)
+        finally:
+            _cfg.set("enable_query_cache", False)
     if oracle is not None:
         t0 = time.time()
         first = oracle()
@@ -314,7 +353,7 @@ def _entry_selected(name: str, only, skip) -> bool:
 
 
 def run_suite(sf: float, repeats: int, probe_failed: bool = False,
-              only=(), skip=()):
+              only=(), skip=(), qrepeat: int = 0):
     """All BASELINE.json config families.  Headline JSON line prints right
     after Q1; the rest runs under the wall-clock budget with incremental
     BENCH_DETAIL.json writes.  --only/--skip narrow the query set (manual
@@ -423,7 +462,7 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
                 f"ssb_{qid}",
                 lambda qid=qid: _bench_sql(
                     ssess, FLAT_QUERIES[qid], nrows_ssb, repeats,
-                    oracle=lambda: ssb_oracle(sdf, qid)),
+                    oracle=lambda: ssb_oracle(sdf, qid), qrepeat=qrepeat),
             )
         del ssess, scat, sdf  # free the wide flat table before TPC-H
 
@@ -439,7 +478,7 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         dsess = Session(dcat)
         return _bench_sql(
             dsess, Q67, dcat.get_table("store_sales").row_count, repeats,
-            oracle=lambda: q67_oracle(dcat))
+            oracle=lambda: q67_oracle(dcat), qrepeat=qrepeat)
 
     try_entry("tpcds_q67", q67_entry)
 
@@ -472,7 +511,8 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
                 f"tpch_q{qn}",
                 lambda qn=qn: _bench_sql(
                     tsess, QUERIES[qn], nrows_li, repeats,
-                    oracle=lambda: getattr(tpch_oracle, f"q{qn}")(frames)),
+                    oracle=lambda: getattr(tpch_oracle, f"q{qn}")(frames),
+                    qrepeat=qrepeat),
             )
 
     geomean = round(
@@ -486,6 +526,16 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
             for k, v in (d.get("rf") or {}).items():
                 rf_totals[k] = rf_totals.get(k, 0) + v
     detail["rf_totals"] = rf_totals
+    # query-cache effectiveness (--repeat N): per-query cold/warm dicts sum
+    # into suite totals for the summary line
+    qcache_totals: dict = {}
+    for d in detail.values():
+        if isinstance(d, dict):
+            for k, v in (d.get("qcache") or {}).items():
+                if k.startswith("qcache_"):
+                    qcache_totals[k] = qcache_totals.get(k, 0) + v
+    if qrepeat > 1:
+        detail["qcache_totals"] = qcache_totals
     # oracle MISMATCHes must be machine-readable, not a comment tail: any
     # nonzero `mismatches` marks the round's results wrong regardless of
     # how fast they were
@@ -534,6 +584,8 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         "rf_segments_pruned": rf_totals.get("rf_segments_pruned", 0),
         "rf_bloom_bits": rf_totals.get("rf_bloom_bits", 0),
         "verify_findings": _sr_analysis.findings_total(),
+        **({"qcache_repeat": qrepeat, **qcache_totals} if qrepeat > 1
+           else {}),
     }))
 
 
@@ -548,6 +600,12 @@ def main():
                          "ssb_q1.1,q67 (q1 = the handplan headline)")
     ap.add_argument("--skip", default=os.environ.get("SR_TPU_BENCH_SKIP", ""),
                     help="comma list of queries to exclude")
+    ap.add_argument("--repeat", type=int,
+                    default=int(os.environ.get("SR_TPU_BENCH_REPEAT", "0")),
+                    help="query-cache A/B: per query, one cold run (full-"
+                         "result tier dropped) + N-1 warm repeats with "
+                         "enable_query_cache=on; cold/warm ms and qcache_* "
+                         "totals join the JSON summary line")
     args, _unknown = ap.parse_known_args()
 
     def toks(s):
@@ -561,7 +619,8 @@ def main():
     _T0 = time.time()  # budget clock starts after the device probe
     if query_key == "suite":
         return run_suite(sf, repeats, probe_failed=not probe_ok,
-                         only=toks(args.only), skip=toks(args.skip))
+                         only=toks(args.only), skip=toks(args.skip),
+                         qrepeat=args.repeat)
     if query_key != "q1":
         return run_sql_bench(query_key, sf, repeats)
 

@@ -79,6 +79,43 @@ def check_trace_reads(reads, config=None) -> list:
     return findings
 
 
+def check_cache_reads(reads, config=None) -> list:
+    """Findings for knobs read during an execution whose RESULT gets
+    cached (the full-result query cache, starrocks_tpu/cache/) but absent
+    from every declared key channel. The result key is built from
+    config.trace_key() + OPT_KEY_KNOBS, so a knob is covered when it is:
+
+    - declared trace=True (keyed through trace_key()),
+    - an OPT_KEY_KNOBS plan-shaping knob (keyed through the plan + the
+      explicit opt-knob tuple in cache/keys.full_result_key),
+    - declared cache_key=True (the cache's OWN machinery — lookup/budget
+      knobs whose value cannot change cached bytes), or
+    - a documented HOST_LOOP_KNOBS entry (perf-only host orchestration:
+      batching, admission, profiling — never result bytes).
+
+    Anything else is the round-7/8 stale-trace bug class aimed at result
+    bytes: a SET could serve a stale table. The executor declines to cache
+    on any finding (and strict mode fails the query)."""
+    if config is None:
+        from ..runtime.config import config as _c
+
+        config = _c
+    keyed = config.trace_knobs()
+    own = config.cache_key_knobs()
+    findings = []
+    for name in sorted(reads):
+        if (name in keyed or name in own or name in OPT_KEY_KNOBS
+                or name in HOST_LOOP_KNOBS):
+            continue
+        findings.append(Finding(
+            "key_check", "knob-outside-result-key", name,
+            f"config knob {name!r} read while executing a query whose "
+            f"result enters the query cache, but covered by no key channel "
+            f"(trace=True / OPT_KEY_KNOBS / cache_key=True / documented "
+            f"host-loop knob): a SET {name} could serve a stale result"))
+    return findings
+
+
 def check_opt_reads(reads) -> list:
     """Findings for knobs read during optimize() but absent from the
     optimized-plan cache key (a SET would serve a stale PLAN). Knobs that

@@ -37,6 +37,55 @@ from ..sql.logical import (
 GROUP_CAP_KEY = "batched_agg"
 
 
+def audited_jit(raw_fn, where: str):
+    """jax.jit plus a ONE-TIME static audit of the traced program.
+
+    The batched/spill/grace program caches live outside the executor's
+    _cached_attempt, so until now their compiles escaped the round-8
+    fresh-compile verification (analysis/trace_check jaxpr audit +
+    analysis/key_check read-set completeness). This wrapper is their
+    equivalent of Executor._verify_compile: the first invocation records
+    the knob read-set of the lazy jit trace and hands the raw fn + real
+    inputs to the auditor; later calls (including silent retraces on new
+    shapes, same as the executor's cache-hit semantics) run bare."""
+    return _AuditedProgram(raw_fn, where)
+
+
+class _AuditedProgram:
+    def __init__(self, raw_fn, where: str):
+        self._raw = raw_fn
+        self._jit = jax.jit(raw_fn)
+        self._where = where
+        self._audited = False
+
+    def __call__(self, *args):
+        if self._audited:
+            return self._jit(*args)
+        self._audited = True
+        from .config import config
+
+        with config.record_reads() as reads:
+            out = self._jit(*args)
+        self._audit(args, reads)
+        return out
+
+    def _audit(self, args, reads):
+        from ..analysis import report, verify_level
+
+        if verify_level() == "off":
+            return
+        from .config import config
+        from ..analysis.key_check import check_trace_reads
+
+        findings = check_trace_reads(reads)
+        if config.get("plan_verify_trace"):
+            from ..analysis import trace_check
+
+            findings += trace_check.audit_program(
+                self._raw, args[0], args[1:])
+        report(findings, None, where=f"compile({self._where})")
+
+
 def slice_scan_chunk(ht, alias: str, cols, sel, cap: int):
     """Device chunk of `ht[sel]` with alias-qualified names (shared by the
     batched-agg, spill-sort, and spill-window group loops)."""
@@ -144,7 +193,8 @@ def make_programs(bp: BatchablePlan, group_cap: int):
         )
         return _apply_top_chain(out, bp.top_chain), ng
 
-    return jax.jit(partial_program), jax.jit(final_program)
+    return (audited_jit(partial_program, "batched_partial"),
+            audited_jit(final_program, "batched_final"))
 
 
 def execute_batched(
@@ -372,7 +422,8 @@ def execute_grace_join(
                 return out, checks
             return c, checks
 
-        programs_cache[prog_key] = (jax.jit(run_part), compiled.scans)
+        programs_cache[prog_key] = (audited_jit(run_part, "grace_part"),
+                                    compiled.scans)
     jpart, scans = programs_cache[prog_key]
 
     outs = []
@@ -409,7 +460,7 @@ def execute_grace_join(
         fkey = ("grace_final", tuple(gp.top_chain), gp.agg, gcap,
                 merged.capacity)
         if fkey not in programs_cache:
-            programs_cache[fkey] = jax.jit(final_fn)
+            programs_cache[fkey] = audited_jit(final_fn, "grace_final")
         out, ng = programs_cache[fkey](merged)
         checks_max[gkey] = max(checks_max.get(gkey, 0), int(ng))
     else:
@@ -471,7 +522,7 @@ def make_sort_spill_program(sp: SpillSortPlan):
         ops = sort_operands(keys, sp.sort.keys)
         return c, tuple(ops), c.sel_mask()
 
-    return jax.jit(prog)
+    return audited_jit(prog, "spill_sort")
 
 
 def execute_spill_sort(sp: SpillSortPlan, catalog, batch_rows: int,
@@ -740,7 +791,7 @@ def execute_streaming_window(sp: SpillWindowPlan, catalog, batch_rows: int,
                                 [n for n, _ in node.exprs])
             return window_op(c, w.partition_by, w.order_by, w.funcs)
 
-        programs_cache[prog_key] = jax.jit(prog)
+        programs_cache[prog_key] = audited_jit(prog, "stream_window")
     jprog = programs_cache[prog_key]
 
     profile_node.set_info("stream_chunks", len(cuts) - 1)
@@ -901,7 +952,7 @@ def execute_spill_window(sp: SpillWindowPlan, catalog, batch_rows: int,
                 c = window_op(c, w.partition_by, w.order_by, w.funcs)
             return _apply_top_chain(c, sp.top_chain)
 
-        programs_cache[prog_key] = jax.jit(prog)
+        programs_cache[prog_key] = audited_jit(prog, "spill_window")
     jprog = programs_cache[prog_key]
 
     alias, cols = sp.scan.alias, sp.scan.columns

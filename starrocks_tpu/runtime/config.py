@@ -32,6 +32,14 @@ class ConfigField:
     # is read during tracing but not declared here — marking the knob at
     # its definition is the whole contract.
     trace: bool = False
+    # cache_key=True declares a knob owned by the query-result cache's
+    # key/lookup machinery (starrocks_tpu/cache/): reads inside cache-key
+    # construction are sanctioned for such knobs (and trace=True knobs,
+    # which key results through config.trace_key()). tools/src_lint.py R3
+    # rejects any OTHER config.get inside the cache package's key builders,
+    # and analysis/key_check.py's result-key completeness pass allowlists
+    # exactly this set.
+    cache_key: bool = False
 
 
 class ConfigRegistry:
@@ -41,9 +49,9 @@ class ConfigRegistry:
         self._reads = threading.local()  # per-thread stack of read-sets
 
     def define(self, name, default, mutable=True, description="",
-               trace=False):
+               trace=False, cache_key=False):
         f = ConfigField(name, default, type(default), mutable, description,
-                        default, trace)
+                        default, trace, cache_key)
         self._fields[name] = f
         return f
 
@@ -65,7 +73,14 @@ class ConfigRegistry:
         try:
             yield reads
         finally:
-            stack.remove(reads)
+            # remove by IDENTITY: list.remove compares by equality, and a
+            # nested window whose set momentarily EQUALS this one (common —
+            # get() adds to every open set) would pop the wrong entry,
+            # leaving this one behind to raise on its own exit
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] is reads:
+                    del stack[i]
+                    break
 
     def trace_knobs(self) -> frozenset:
         """Names of all knobs declared trace-affecting."""
@@ -78,6 +93,12 @@ class ConfigRegistry:
         to keep in sync."""
         return tuple(sorted(
             (f.name, f.value) for f in self._fields.values() if f.trace))
+
+    def cache_key_knobs(self) -> frozenset:
+        """Names of knobs declared cache_key=True (the query-result cache's
+        own machinery; see ConfigField.cache_key)."""
+        return frozenset(
+            f.name for f in self._fields.values() if f.cache_key)
 
     def set(self, name: str, value, force: bool = False):
         f = self._fields.get(name)
@@ -247,6 +268,22 @@ config.define("plan_verify_level", "off", True,
               "cache-key completeness). warn logs findings and counts them "
               "in the query profile; strict fails the query on any "
               "error-severity finding")
+config.define("enable_query_cache", False, True,
+              "two-tier query result cache (starrocks_tpu/cache/): a "
+              "full-result tier serving byte-identical repeats without "
+              "touching the executor, keyed by (plan, per-table data "
+              "version, config.trace_key()), plus a per-segment partial-"
+              "aggregation tier for scan->filter->agg fragments over "
+              "stored tables — after an append only NEW segments are "
+              "scanned/aggregated (the reference's be/src/exec/query_cache "
+              "multi-version delta reuse). off = bit-identical to the "
+              "uncached engine",
+              cache_key=True)
+config.define("query_cache_capacity_mb", 256, True,
+              "host memory budget for the query cache's LRU (full results "
+              "+ per-segment partial-aggregation states share it; least-"
+              "recently-used entries evict past the budget)",
+              cache_key=True)
 config.define("plan_verify_trace", True, True,
               "run the jaxpr trace auditor on every freshly-compiled "
               "program when plan_verify_level != off (adds one extra "

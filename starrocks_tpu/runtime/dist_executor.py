@@ -52,6 +52,18 @@ class DistExecutor(Executor):
     def _run(self, plan, profile: RuntimeProfile | None = None) -> Chunk:
         profile = profile or RuntimeProfile("dist-query")
 
+        # per-segment partial-aggregation cache (cache/partial.py): the
+        # tier is host-orchestrated over manifest segments, so a cacheable
+        # stored-table fragment takes the same path on every topology —
+        # states cached by a single-chip run serve the distributed executor
+        # and vice versa (the Session shares one DeviceCache/QueryCache
+        # across both), and the merge is the engine's FINAL re-aggregation
+        # rather than a mesh exchange. Non-matching plans (joins, in-memory
+        # tables) fall through to the shard_map pipeline below.
+        out = self._try_partial_cache(plan, profile)
+        if out is not None:
+            return out
+
         def attempt(caps, p):
             def compile_cb():
                 compiled = compile_distributed(

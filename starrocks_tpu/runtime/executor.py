@@ -60,6 +60,12 @@ class DeviceCache:
         # milliseconds on repeated multi-join queries. Evicted with programs
         # on DML (stats drive join order / runtime-filter decisions).
         self.opt_plans: OrderedDict = OrderedDict()
+        # two-tier query cache (starrocks_tpu/cache/): full results +
+        # per-segment partial-aggregation states. Living here means every
+        # existing DML invalidate(table) call covers it for free.
+        from ..cache.query_cache import QueryCache
+
+        self.qcache = QueryCache()
 
     def program_bucket(self, key):
         from .udf import registry_epoch
@@ -86,6 +92,10 @@ class DeviceCache:
     def invalidate(self, table: str):
         self._cols = {k: v for k, v in self._cols.items() if k[0] != table}
         self._caps = {k: v for k, v in self._caps.items() if k[0] != table}
+        # full-result entries that observed this table drop immediately;
+        # per-segment partial states validate by file identity and survive
+        # appends by design (cache/query_cache.py)
+        self.qcache.invalidate_table(table)
         # evict compiled programs that scan this table: traces bake
         # stats-derived constants (dense runtime-filter ranges, multi-key
         # bit widths), which DML can silently outgrow without a shape change
@@ -305,7 +315,62 @@ class Executor:
     def _execute_plain(
         self, plan: LogicalPlan, profile: RuntimeProfile | None = None
     ) -> QueryResult:
+        """Full-result cache gate around the real execution path: a
+        validated hit returns the materialized table without touching
+        optimizer/compiler/device; a cacheable miss executes under a knob
+        read-set recording window and stores the result keyed by
+        (plan, trace knobs, opt knobs, udf epoch) + per-table data
+        versions. With enable_query_cache=off this is a single boolean
+        check — bit-identical to the uncached engine."""
         profile = profile or RuntimeProfile("query")
+        if not config.get("enable_query_cache"):
+            return self._execute_plain_uncached(plan, profile)
+        from ..cache import keys as cache_keys
+        from ..sql.optimizer import plan_uncacheable_reason
+
+        reason = plan_uncacheable_reason(plan)
+        if reason is not None:
+            profile.set_info("qcache_uncacheable", reason)
+            return self._execute_plain_uncached(plan, profile)
+        skey = cache_keys.full_result_key(plan)
+        hit = self.cache.qcache.lookup_result(skey, self.catalog)
+        if hit is not None:
+            QUERIES_TOTAL.inc()
+            ROWS_RETURNED.inc(hit.table.num_rows)
+            profile.add_counter("qcache_hits", 1)
+            return QueryResult(hit.table, hit.plan, profile)
+        profile.add_counter("qcache_misses", 1)
+        with config.record_reads() as reads:
+            res = self._execute_plain_uncached(plan, profile)
+        self._qcache_store(plan, skey, res, reads, profile)
+        return res
+
+    def _qcache_store(self, plan, skey, res, reads, profile):
+        """Store a full result under a VERIFIED key: the knob read-set of
+        the execution must be covered by the declared key channels
+        (trace=True / OPT_KEY_KNOBS / cache_key=True / documented host-loop
+        knobs), and the version map covers both the analyzed plan's tables
+        (incl. subquery plans) and the tables the EXECUTED plan actually
+        scanned (an MV rewrite adds its MV here). Escapee knobs are the
+        round-7/8 stale-trace bug class aimed at results: strict mode
+        fails the query, warn mode reports and declines to cache."""
+        from ..analysis import report, verify_level
+        from ..analysis.key_check import check_cache_reads
+        from ..cache import keys as cache_keys
+        from ..sql.optimizer import plan_tables
+
+        if verify_level() != "off":
+            findings = check_cache_reads(reads)
+            report(findings, profile, where="qcache")
+            if findings:
+                return
+        tables = plan_tables(plan) | plan_tables(res.plan)
+        versions = cache_keys.version_map(self.catalog, tables)
+        self.cache.qcache.store_result(skey, res.table, res.plan, versions)
+
+    def _execute_plain_uncached(
+        self, plan: LogicalPlan, profile: RuntimeProfile
+    ) -> QueryResult:
         QUERIES_TOTAL.inc()
         try:
             with profile.timer("optimize"):
@@ -728,6 +793,10 @@ class Executor:
     def _run(self, plan: LogicalPlan, profile: RuntimeProfile | None = None) -> Chunk:
         profile = profile or RuntimeProfile("query")
 
+        out = self._try_partial_cache(plan, profile)
+        if out is not None:
+            return out
+
         batch_threshold = config.get("batch_rows_threshold")
         if batch_threshold:
             out = self._try_batched(plan, profile, batch_threshold)
@@ -765,6 +834,19 @@ class Executor:
             return out, [(k, int(v)) for k, v in checks.items()]
 
         return self._adaptive(profile, attempt)
+
+    def _try_partial_cache(self, plan, profile):
+        """Per-segment partial-aggregation tier (cache/partial.py): for a
+        cacheable scan->filter->agg fragment over a STORED table, aggregate
+        each manifest segment independently and reuse cached partial states
+        — after an append only NEW segments scan. None = not a match;
+        callers fall through to the normal paths (single boolean check
+        when enable_query_cache is off)."""
+        if not config.get("enable_query_cache"):
+            return None
+        from ..cache.partial import try_partial_cached
+
+        return try_partial_cached(self, plan, profile)
 
     def _try_batched(self, plan, profile, batch_threshold):
         """Host-offload streaming for big scan-aggregations (spill analog).

@@ -89,6 +89,17 @@ class Session:
                 )
             self._replay_external_defs()
             self._restore_catalog_meta()
+            # storage-level mutations that bypass session DML (an explicit
+            # compact_table, out-of-session loads against the same root)
+            # must invalidate cached query results exactly like DML does:
+            # every store write advances the catalog's per-table data epoch
+            self.store.add_listener(
+                lambda t, op: self.catalog.bump_data_epoch(t))
+        # data-epoch bumps invalidate this session's device + query caches
+        # (DML paths also call cache.invalidate directly — idempotent; the
+        # listener covers epochs advanced by OTHER sessions on a shared
+        # catalog and by storage-level listeners above)
+        self.catalog.add_invalidation_listener(self.cache.invalidate)
 
     # journal ops before an image snapshot triggers (the FE
     # CheckpointController's checkpoint-interval analog)
@@ -110,6 +121,8 @@ class Session:
                            for u, g in a.grants.items()},
             }
         wm = getattr(self.catalog, "workgroups", None)
+        from ..storage.external import ExternalTableHandle
+
         img = {
             "views": dict(self.catalog.views),
             "mv_defs": dict(self.catalog.mv_defs),
@@ -117,6 +130,15 @@ class Session:
             "resource_groups": (
                 {n: g.to_props() for n, g in wm.groups.items()}
                 if wm is not None else {}),
+            # external-table defs live IN the image (NEXT item 9): a
+            # restored catalog registers the same handles a live one holds,
+            # so query-cache data versions (file stat signatures) agree
+            # across restarts and external DDL invalidation replays exactly
+            # like native DDL. The sidecar external_tables.json stays as a
+            # redundant copy for pre-image stores.
+            "external_tables": {
+                n: h.location for n, h in self.catalog.tables.items()
+                if isinstance(h, ExternalTableHandle)},
         }
         return self.store.checkpoint(img)
 
@@ -142,6 +164,16 @@ class Session:
             from .workgroup import ResourceGroup
 
             self.workgroups().groups[name] = ResourceGroup.from_props(props)
+        from ..storage.external import ExternalTableHandle
+
+        for name, location in cat.get("external_tables", {}).items():
+            if self.catalog.get_table(name) is not None:
+                continue  # sidecar replay already registered it
+            try:
+                self.catalog.register_handle(
+                    ExternalTableHandle(name, location))
+            except ValueError:
+                pass  # files vanished; the definition stays until DROP
         for op in self.store.replay(after_seq=base):
             k = op["op"]
             if k == "create_rg":
@@ -157,6 +189,15 @@ class Session:
                 mv_defs[op["name"]] = op["text"]
             elif k == "drop_mv":
                 mv_defs.pop(op["name"], None)
+            elif k == "create_external":
+                if self.catalog.get_table(op["name"]) is None:
+                    try:
+                        self.catalog.register_handle(
+                            ExternalTableHandle(op["name"], op["location"]))
+                    except ValueError:
+                        pass
+            elif k == "drop_external":
+                self.catalog.drop(op["name"], if_exists=True)
             elif k == "create_user":
                 a = self.auth()
                 a.users[op["user"]] = bytes.fromhex(op["hash"])
@@ -372,6 +413,12 @@ class Session:
             self.catalog.register_handle(
                 ExternalTableHandle(name, stmt.location))
             self._save_external_defs(add={name: stmt.location})
+            # journaled like native DDL so image+tail replay agrees with
+            # the sidecar, and the data epoch moves so any cached result
+            # under a same-named earlier definition drops
+            self._log_meta({"op": "create_external", "name": name,
+                            "location": stmt.location})
+            self.catalog.bump_data_epoch(name)
             return None
         if isinstance(stmt, ast.CreateTable):
             return self._create(stmt)
@@ -398,6 +445,7 @@ class Session:
             self.catalog.bump_version(stmt.name.lower())
             if was_external:
                 self._save_external_defs(remove=nm)
+                self._log_meta({"op": "drop_external", "name": nm})
             elif self.store is not None and existed:
                 self.store.drop_table(stmt.name.lower())
             return None

@@ -1801,3 +1801,90 @@ def _prune(plan: LogicalPlan, required: frozenset, dups, reqs, record: bool
         return LUnion(tuple(prune_columns(c, required) for c in plan.inputs))
 
     raise TypeError(type(plan))
+
+
+# --- query-cache cacheability marking ----------------------------------------
+# The optimizer owns the semantic judgement the query cache needs: whether a
+# plan's result is a pure function of (plan, table contents, declared knobs).
+# Reference analog: the FE's CachedStatement checks behind enable_query_cache
+# (nondeterministic calls, system relations and session-dependent functions
+# disqualify a fragment from the BE's query_cache).
+
+NONDETERMINISTIC_FNS = frozenset({
+    "rand", "random", "uuid",
+    "now", "current_timestamp", "localtimestamp",
+    "current_date", "curdate", "current_time", "curtime", "localtime",
+    "utc_timestamp", "utc_time", "utc_date",
+    "sleep", "current_user", "connection_id", "last_query_id", "database",
+})
+
+
+def _exprs_in(val):
+    """Every Expr embedded in a plan node's field value (fields hold bare
+    exprs, (name, expr) pairs, (expr, asc, nulls_first) triples, window
+    func tuples — all nested tuple shapes)."""
+    if isinstance(val, Expr):
+        yield val
+    elif isinstance(val, tuple):
+        for x in val:
+            yield from _exprs_in(x)
+
+
+def iter_plan_exprs(plan: LogicalPlan):
+    """Yield every expression of every node in the plan tree, recursing
+    into subquery plans carried INSIDE expressions (ScalarSubquery /
+    SemiJoinMark — a nondeterministic call or system-table scan hiding in
+    `WHERE x IN (SELECT ...)` must disqualify the outer plan too)."""
+    from ..exprs.ir import walk as walk_expr
+
+    for node in walk_plan(plan):
+        for attr in getattr(node, "__dataclass_fields__", {}):
+            for e in _exprs_in(getattr(node, attr)):
+                for sub in walk_expr(e):
+                    yield sub
+                    if isinstance(sub, (ScalarSubquery, SemiJoinMark)):
+                        if isinstance(sub, SemiJoinMark) \
+                                and sub.probe_expr is not None:
+                            yield from (
+                                x for x in walk_expr(sub.probe_expr))
+                        yield from iter_plan_exprs(sub.plan)
+
+
+def plan_tables(plan: LogicalPlan) -> set:
+    """Every catalog table the plan (or any embedded subquery plan) reads —
+    the table set whose data versions join the full-result cache key."""
+    tables = set()
+    for node in walk_plan(plan):
+        if isinstance(node, LScan):
+            tables.add(node.table.lower())
+    for e in iter_plan_exprs(plan):
+        if isinstance(e, (ScalarSubquery, SemiJoinMark)):
+            tables |= plan_tables(e.plan)
+    return tables
+
+
+def plan_uncacheable_reason(plan: LogicalPlan) -> str | None:
+    """None when the plan's result is cacheable; otherwise a short reason.
+
+    Disqualifiers: nondeterministic/session-dependent functions, zero-arg
+    unix_timestamp (= now), UDF calls (arbitrary host python — the registry
+    epoch keys create/drop, not the body's purity), and scans of virtual
+    information_schema relations (rebuilt per read, no version clock)."""
+    for t in plan_tables(plan):
+        if t.startswith("information_schema."):
+            return f"scans virtual relation {t}"
+    udfs = None
+    for e in iter_plan_exprs(plan):
+        if isinstance(e, Call):
+            fn = e.fn.lower()
+            if fn in NONDETERMINISTIC_FNS:
+                return f"nondeterministic function {fn}()"
+            if fn == "unix_timestamp" and not e.args:
+                return "nondeterministic function unix_timestamp()"
+            if udfs is None:
+                from ..runtime.udf import list_udfs
+
+                udfs = {u.lower() for u in list_udfs()}
+            if fn in udfs:
+                return f"UDF call {fn}() (host python body)"
+    return None

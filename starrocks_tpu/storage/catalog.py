@@ -57,6 +57,14 @@ class TableHandle:
             self._stats[col] = st
         return self._stats[col]
 
+    def data_version(self) -> tuple:
+        """Content token of this handle's CURRENT data, joined with the
+        catalog's per-table data epoch into query-cache version maps
+        (starrocks_tpu/cache/keys.py). In-memory tables mutate only
+        through catalog.register (which bumps the epoch), so the row count
+        is belt-and-braces."""
+        return ("mem", self.row_count)
+
     def column_ndv(self, col: str) -> Optional[int]:
         """Exact distinct count, computed once per column on the host (the
         ANALYZE analog; reference statistic/StatisticsCollectJob). Drives
@@ -113,6 +121,18 @@ class StoredTableHandle(TableHandle):
         self._table = None
         self._stats = {}
 
+    def data_version(self) -> tuple:
+        """Manifest-derived content token: rowset watermark + live rows +
+        file count. Catches direct TabletStore mutations (compaction, out-
+        of-session loads) that never pass through the session's DML path."""
+        m = self.store.read_manifest(self.name)
+        live = sum(
+            f["rows"] - len(f.get("delvec") or ())
+            for rs in m["rowsets"] for f in rs["files"]
+        )
+        nfiles = sum(len(rs["files"]) for rs in m["rowsets"])
+        return ("store", m["next_rowset"], live, nfiles)
+
     def file_metas(self):
         """Per-data-file metadata rows for the information_schema tablets/
         partitions views (manifest only — no data load)."""
@@ -140,6 +160,16 @@ class Catalog:
         self.mv_meta: dict = {}
         # per-table mutation counters: the MV staleness clock
         self.versions: dict = {}
+        # per-table DATA epochs: the query-cache invalidation clock. Every
+        # bump_version bumps the data epoch too, but the epoch ALSO moves on
+        # storage-level mutations that preserve MV freshness semantics
+        # (compaction rewrites files without changing logical content —
+        # cached results revalidate, fresh MVs stay fresh)
+        self.data_epochs: dict = {}
+        # invalidation listeners: fn(table_name) called on every data-epoch
+        # bump (query/device caches subscribe; failures are swallowed —
+        # cache bookkeeping must never take down DML)
+        self._listeners: list = []
         # users + table-level grants (runtime/auth.py); created on demand
         self.auth = None
         # resource groups / admission (runtime/workgroup.py); on demand
@@ -150,6 +180,36 @@ class Catalog:
     def bump_version(self, name: str):
         n = name.lower()
         self.versions[n] = self.versions.get(n, 0) + 1
+        self.bump_data_epoch(n)
+
+    def bump_data_epoch(self, name: str):
+        """Advance the table's data epoch and notify cache listeners —
+        the ingest/compaction/DDL invalidation hook the query cache keys
+        against (MV freshness keeps its own `versions` clock)."""
+        n = name.lower()
+        self.data_epochs[n] = self.data_epochs.get(n, 0) + 1
+        for fn in list(self._listeners):
+            try:
+                fn(n)
+            except Exception:  # noqa: BLE001 — listeners must never fail DML
+                pass
+
+    def add_invalidation_listener(self, fn):
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def data_version(self, name: str) -> tuple:
+        """(epoch, handle content token) for one table — the per-table data
+        version the query cache validates entries against."""
+        n = name.lower()
+        epoch = self.data_epochs.get(n, 0)
+        h = self.tables.get(n)
+        if h is None:
+            return (epoch, None)
+        try:
+            return (epoch,) + tuple(h.data_version())
+        except Exception:  # noqa: BLE001 — a torn manifest is a cache miss
+            return (epoch, "unversioned", id(h))
 
     def register(self, name: str, table: HostTable, unique_keys=(),
                  distribution=()):

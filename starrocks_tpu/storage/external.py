@@ -118,6 +118,21 @@ class ExternalTableHandle(TableHandle):
         self._table = HostTable.from_arrow(merged)
         self._schema = self._table.schema
 
+    def data_version(self) -> tuple:
+        """Content token from the file set's stat signatures (mtime+size
+        per file): the engine does not own these files, so cache validity
+        must come from the filesystem, not the catalog's DML clock. The
+        image checkpoint records external defs with the same tokens so a
+        restore and a live catalog agree on data versions."""
+        sig = []
+        for f in _resolve(self.location):
+            try:
+                st = os.stat(f)
+                sig.append((f, st.st_mtime_ns, st.st_size))
+            except OSError:
+                sig.append((f, None, None))
+        return ("ext", tuple(sig))
+
     def invalidate(self):
         # external data may change underneath; a refresh re-resolves the
         # file set and re-reads footers/data

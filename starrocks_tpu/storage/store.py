@@ -80,6 +80,23 @@ class TabletStore:
         # vanish from the journal (appends are short, checkpoints rare —
         # one lock is cheaper than being right about interleavings)
         self._journal_lock = threading.RLock()
+        # mutation listeners: fn(table, op) fired after every storage-level
+        # write (insert/upsert/rewrite/alter/compact/drop). Sessions wire
+        # these to catalog data-epoch bumps + cache invalidation so DIRECT
+        # store mutations (e.g. an explicit compact_table) invalidate the
+        # query cache exactly like session DML does.
+        self._listeners: list = []
+
+    def add_listener(self, fn):
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def _notify(self, table: str, op: str):
+        for fn in list(self._listeners):
+            try:
+                fn(table, op)
+            except Exception:  # noqa: BLE001 — listeners must never fail a write
+                pass
 
     # --- edit log + image checkpoint -----------------------------------------
     # The journal is the FE EditLog/image pair (fe persist/EditLog.java:133 +
@@ -228,6 +245,7 @@ class TabletStore:
             os.rmdir(tdir)
         if record:
             self.log({"op": "drop", "table": name})
+        self._notify(name, "drop")
 
     def table_names(self):
         return sorted(
@@ -258,6 +276,7 @@ class TabletStore:
         self._write_manifest(name, m)
         if record:
             self.log({"op": "insert", "table": name, "rowset": rid, "rows": n})
+        self._notify(name, "insert")
         self._maybe_compact(name, m)
         return n
 
@@ -349,6 +368,7 @@ class TabletStore:
                 pass
         if record:
             self.log({"op": "rewrite", "table": name, "rows": n})
+        self._notify(name, "rewrite")
         return n
 
     # --- schema change --------------------------------------------------------
@@ -417,6 +437,7 @@ class TabletStore:
         if record:
             self.log({"op": "alter", "table": name, "action": action,
                       "column": column})
+        self._notify(name, "alter")
         return Schema(fields)
 
     # --- compaction -----------------------------------------------------------
@@ -492,6 +513,7 @@ class TabletStore:
                 pass
         if record:
             self.log({"op": "compact", "table": name, "rows": total_rows})
+        self._notify(name, "compact")
         return total_rows
 
     # --- primary-key delta path -------------------------------------------------
@@ -608,6 +630,7 @@ class TabletStore:
             index[key] = (new_ri, fi, row_in_file)
         if record:
             self.log({"op": "upsert", "table": name, "rowset": rid, "rows": n})
+        self._notify(name, "upsert")
         self._maybe_compact(name, m)
         return n
 
@@ -638,7 +661,7 @@ class TabletStore:
     # --- read path ------------------------------------------------------------
     def load_table(
         self, name: str, columns=None, predicate: Optional[Expr] = None,
-        rf_predicate: Optional[Expr] = None,
+        rf_predicate: Optional[Expr] = None, files=None,
     ) -> HostTable:
         """Read the table (optionally only some columns), pruning files whose
         zonemaps prove the predicate false (segment zonemap filtering analog).
@@ -648,7 +671,12 @@ class TabletStore:
         a join's dimension subplan. It prunes with the SAME zonemap prover
         but its kills are counted separately (`rf_pruned`) so the profile
         can attribute skipped segments to join selectivity rather than the
-        query's own WHERE clause."""
+        query's own WHERE clause.
+
+        `files` restricts the read to the named data files (a set of
+        manifest file names) — the per-segment read path of the query
+        cache's partial-aggregation tier, which aggregates each segment
+        independently so only NEW segments re-scan after an append."""
         import pyarrow.parquet as pq
 
         from ..runtime.config import config
@@ -662,6 +690,8 @@ class TabletStore:
         total, pruned, part_pruned, rf_pruned = 0, 0, 0, 0
         for rs in m["rowsets"]:
             for fmeta in rs["files"]:
+                if files is not None and fmeta["file"] not in files:
+                    continue
                 total += 1
                 if (prune_enabled and predicate is not None
                         and part_zms is not None and "part" in fmeta

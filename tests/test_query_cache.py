@@ -1,0 +1,365 @@
+"""Two-tier query cache (starrocks_tpu/cache/): correctness of reuse,
+invalidation, eviction, and the verified cache key.
+
+Reference behavior: be/src/exec/query_cache/ (per-tablet partial-
+aggregation states with multi-version delta reuse) behind the FE's
+enable_query_cache session variable. The invariants under test:
+
+- a warm full-result hit returns byte-identical rows without executing;
+- ANY mutation path (session DML, direct TabletStore calls) drops stale
+  full-result entries — never a stale row served;
+- after an append the partial-aggregation tier re-aggregates ONLY the new
+  segments (asserted via qcache_partial_hits / qcache_rows_saved) and the
+  merged result matches an uncached run;
+- nondeterministic expressions are never cached;
+- the LRU evicts past query_cache_capacity_mb;
+- the result cache key is VERIFIED complete (analysis/key_check.py
+  check_cache_reads + tools/src_lint.py R3);
+- enable_query_cache=off is bit-identical to the uncached engine.
+"""
+
+import numpy as np
+import pytest
+
+from starrocks_tpu.column import HostTable
+from starrocks_tpu.runtime.config import config
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.storage.catalog import Catalog
+
+
+@pytest.fixture
+def qcache_on():
+    config.set("enable_query_cache", True)
+    config.set("plan_verify_level", "strict")
+    try:
+        yield
+    finally:
+        config.set("enable_query_cache", False)
+        config.set("query_cache_capacity_mb", 256)
+        config.set("plan_verify_level", "warn")
+
+
+def _counters(sess):
+    return {k: v for k, (v, _) in sess.last_profile.counters.items()}
+
+
+def _mem_session(n=1000):
+    cat = Catalog()
+    cat.register("t", HostTable.from_pydict({
+        "k": np.arange(n) % 7, "v": np.arange(n) * 1.0}))
+    return Session(cat)
+
+
+def _stored_session(tmp_path, batches=((0, 2000), (2000, 4000))):
+    s = Session(data_dir=str(tmp_path / "db"))
+    s.sql("create table t (k int, v double)")
+    for lo, hi in batches:  # one rowset file per INSERT
+        vals = ",".join(f"({i % 5},{float(i)})" for i in range(lo, hi))
+        s.sql(f"insert into t values {vals}")
+    return s
+
+
+AGG = "select k, sum(v) as s, count(*) as c from t group by k order by k"
+
+
+# --- full-result tier --------------------------------------------------------
+
+def test_full_result_hit_identical(qcache_on):
+    s = _mem_session()
+    r1 = s.sql(AGG)
+    r2 = s.sql(AGG)
+    assert _counters(s).get("qcache_hits") == 1
+    assert r2.rows() == r1.rows()
+    # the hit path never touched optimizer/compiler
+    assert "optimize" not in _counters(s)
+
+
+def test_insert_drops_stale_entry(qcache_on):
+    s = _mem_session()
+    s.sql(AGG)
+    s.sql(AGG)
+    assert _counters(s).get("qcache_hits") == 1
+    s.sql("insert into t values (1, 99.0)")
+    r = s.sql(AGG)
+    c = _counters(s)
+    assert c.get("qcache_hits", 0) == 0 and c.get("qcache_misses") == 1
+    got = {row[0]: row[1] for row in r.rows()}
+    exp = {k: sum(float(i) for i in range(1000) if i % 7 == k)
+           for k in range(7)}
+    exp[1] += 99.0
+    assert all(abs(got[k] - exp[k]) < 1e-6 for k in exp)
+
+
+def test_set_trace_knob_misses(qcache_on):
+    """A SET on any trace-declared knob changes the result key: the old
+    entry must not serve (the stale-trace bug class, closed for results)."""
+    s = _mem_session()
+    s.sql(AGG)
+    old = config.get("enable_runtime_filters")
+    try:
+        config.set("enable_runtime_filters", not old)
+        s.sql(AGG)
+        assert _counters(s).get("qcache_hits", 0) == 0
+    finally:
+        config.set("enable_runtime_filters", old)
+
+
+def test_nondeterministic_never_cached(qcache_on):
+    s = _mem_session()
+    for q in ("select rand() as r from t limit 1",
+              "select now() as n from t limit 1"):
+        s.sql(q)
+        assert "qcache_uncacheable" in s.last_profile.infos
+        s.sql(q)
+        c = _counters(s)
+        assert c.get("qcache_hits", 0) == 0 and "qcache_misses" not in c
+
+
+def test_lru_eviction_tiny_budget(qcache_on):
+    from starrocks_tpu.cache.query_cache import QCACHE_EVICTIONS
+
+    s = _mem_session()
+    config.set("query_cache_capacity_mb", 0)  # every store evicts at once
+    e0 = QCACHE_EVICTIONS.value
+    s.sql(AGG)
+    s.sql(AGG)
+    assert _counters(s).get("qcache_hits", 0) == 0
+    assert QCACHE_EVICTIONS.value > e0
+    assert s.cache.qcache.resident_bytes == 0
+
+
+def test_off_is_uncached(qcache_on):
+    config.set("enable_query_cache", False)
+    s = _mem_session()
+    s.sql(AGG)
+    s.sql(AGG)
+    c = _counters(s)
+    assert "qcache_hits" not in c and "qcache_misses" not in c
+    assert s.cache.qcache.resident_bytes == 0
+
+
+# --- partial-aggregation tier (stored tables) --------------------------------
+
+def test_partial_tier_delta_reuse(qcache_on, tmp_path):
+    s = _stored_session(tmp_path)
+    s.sql(AGG)  # cold: both segments aggregate, states cached
+    assert _counters(s).get("qcache_partial_hits") == 0
+    s.sql(AGG)
+    assert _counters(s).get("qcache_hits") == 1  # full-result short-circuit
+    # append a THIRD segment: full-result entry drops, the partial tier
+    # must reuse the 2 cached states and scan only the new 1000 rows
+    vals = ",".join(f"({i % 5},{float(i)})" for i in range(4000, 5000))
+    s.sql(f"insert into t values {vals}")
+    r = s.sql(AGG)
+    c = _counters(s)
+    assert c.get("qcache_partial_hits") == 2
+    assert c.get("qcache_rows_saved") == 4000
+    got = {row[0]: (row[1], row[2]) for row in r.rows()}
+    for k in range(5):
+        vs = [float(i) for i in range(5000) if i % 5 == k]
+        assert abs(got[k][0] - sum(vs)) < 1e-3 and got[k][1] == len(vs)
+
+
+def test_partial_tier_string_keys_and_avg(qcache_on, tmp_path):
+    """Per-segment string dictionaries must remap through the state merge,
+    and avg must decompose/merge exactly (sum+count split)."""
+    s = Session(data_dir=str(tmp_path / "db"))
+    s.sql("create table t (g varchar, v double)")
+    names = ["aa", "bb", "cc"]
+    for lo, hi in ((0, 1500), (1500, 3000)):
+        vals = ",".join(
+            f"('{names[i % 3]}',{float(i)})" for i in range(lo, hi))
+        s.sql(f"insert into t values {vals}")
+    q = ("select g, avg(v) as a, count(*) as c from t "
+         "group by g order by g")
+    config.set("enable_query_cache", False)
+    base = s.sql(q).rows()
+    config.set("enable_query_cache", True)
+    got = s.sql(q).rows()
+    assert [r[0] for r in got] == [r[0] for r in base]
+    for a, b in zip(got, base):
+        assert abs(a[1] - b[1]) < 1e-9 and a[2] == b[2]
+    vals = ",".join(f"('{names[i % 3]}',{float(i)})"
+                    for i in range(3000, 3600))
+    s.sql(f"insert into t values {vals}")
+    r = s.sql(q)
+    assert _counters(s).get("qcache_partial_hits") == 2
+    for g, a, c in r.rows():
+        vs = [float(i) for i in range(3600) if names[i % 3] == g]
+        assert c == len(vs) and abs(a - sum(vs) / len(vs)) < 1e-9
+
+
+def test_upsert_delvec_recomputes_segment(qcache_on, tmp_path):
+    """A primary-key upsert moves a segment's delete vector: its cached
+    state must MISS (the version token pins delvec) and the masked rows
+    must leave the aggregate."""
+    d = str(tmp_path / "db")
+    s = Session(data_dir=d)
+    s.sql("create table t (k int, v double, primary key (k))")
+    s.sql("insert into t values " + ",".join(
+        f"({i},{float(i)})" for i in range(100)))
+    s.sql("insert into t values " + ",".join(
+        f"({i},{float(i)})" for i in range(100, 200)))
+    q = "select sum(v) as s, count(*) as c from t"
+    s.sql(q)
+    # upsert rewrites k=5 (segment 1 gains a delvec entry + new rowset)
+    s.sql("insert into t values (5, 500.0)")
+    r = s.sql(q)
+    row = r.rows()[0]
+    assert row[1] == 200
+    assert abs(row[0] - (sum(range(200)) - 5.0 + 500.0)) < 1e-6
+
+
+def test_direct_store_compaction_invalidates(qcache_on, tmp_path):
+    """Storage-level mutations that bypass session DML (explicit
+    compaction) must still drop full-result entries — the TabletStore
+    mutation listener -> catalog data-epoch path."""
+    s = _stored_session(tmp_path)
+    s.sql(AGG)
+    s.sql(AGG)
+    assert _counters(s).get("qcache_hits") == 1
+    s.store.compact_table("t")
+    s.sql(AGG)
+    assert _counters(s).get("qcache_hits", 0) == 0
+
+
+# --- distributed -------------------------------------------------------------
+
+def test_distributed_partial_merge_matches_uncached(qcache_on, tmp_path):
+    s = Session(data_dir=str(tmp_path / "db"), dist_shards=2)
+    s.sql("create table t (k int, v double)")
+    for lo, hi in ((0, 1500), (1500, 3000)):
+        vals = ",".join(f"({i % 5},{float(i)})" for i in range(lo, hi))
+        s.sql(f"insert into t values {vals}")
+    config.set("enable_query_cache", False)
+    base = s.sql(AGG).rows()
+    config.set("enable_query_cache", True)
+    got = s.sql(AGG).rows()
+    assert [r[0] for r in got] == [r[0] for r in base]
+    for a, b in zip(got, base):
+        assert abs(a[1] - b[1]) < 1e-6 and a[2] == b[2]
+    vals = ",".join(f"({i % 5},{float(i)})" for i in range(3000, 3600))
+    s.sql(f"insert into t values {vals}")
+    r = s.sql(AGG)
+    c = _counters(s)
+    assert c.get("qcache_partial_hits") == 2 and c.get("qcache_rows_saved") == 3000
+    for k, sm, cnt in r.rows():
+        vs = [float(i) for i in range(3600) if i % 5 == k]
+        assert cnt == len(vs) and abs(sm - sum(vs)) < 1e-3
+
+
+# --- verified cache key ------------------------------------------------------
+
+def test_check_cache_reads_flags_undeclared_knob():
+    from starrocks_tpu.analysis.key_check import check_cache_reads
+
+    assert check_cache_reads({"enable_query_cache"}) == []      # cache_key
+    assert check_cache_reads({"runtime_filter_strategy"}) == []  # trace
+    assert check_cache_reads({"enable_mv_rewrite"}) == []        # opt key
+    assert check_cache_reads({"max_recompiles"}) == []           # host loop
+    bad = check_cache_reads({"some_undeclared_knob"})
+    assert len(bad) == 1 and bad[0].invariant == "knob-outside-result-key"
+
+
+def test_strict_declines_to_cache_on_escapee(qcache_on):
+    """An undeclared knob read during a cached execution fails strict mode
+    (and the result is not stored)."""
+    from starrocks_tpu.analysis import VerifyError
+
+    s = _mem_session()
+    if "test_unkeyed_knob" not in config._fields:  # escapee probe knob
+        config.define("test_unkeyed_knob", 7)
+
+    from starrocks_tpu.runtime import executor as ex
+    real_uncached = ex.Executor._execute_plain_uncached
+
+    def leaky(self, plan, profile):
+        config.get("test_unkeyed_knob")
+        return real_uncached(self, plan, profile)
+
+    ex.Executor._execute_plain_uncached = leaky
+    try:
+        with pytest.raises(VerifyError):
+            s.sql(AGG)
+    finally:
+        ex.Executor._execute_plain_uncached = real_uncached
+    assert s.cache.qcache.resident_bytes == 0
+
+
+def test_src_lint_r3_flags_undeclared_literal(tmp_path):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import src_lint
+
+    os.makedirs(tmp_path / "starrocks_tpu" / "cache")
+    bad = tmp_path / "starrocks_tpu" / "cache" / "keys.py"
+    bad.write_text("def k():\n"
+                   "    return (config.get('batch_rows_threshold'),\n"
+                   "            config.get('enable_query_cache'))\n")
+    old = src_lint.REPO
+    src_lint.REPO = str(tmp_path)
+    try:
+        findings = src_lint.lint_cache_keys()
+    finally:
+        src_lint.REPO = old
+    assert len(findings) == 1 and "batch_rows_threshold" in findings[0]
+    # the real keys.py is clean
+    assert src_lint.lint_cache_keys() == []
+
+
+# --- external tables in the metadata image -----------------------------------
+
+def test_external_defs_in_image_checkpoint(qcache_on, tmp_path):
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    ext = tmp_path / "ext"
+    ext.mkdir()
+    pq.write_table(pa.table(pd.DataFrame(
+        {"k": [1, 2, 2], "v": [1.0, 2.0, 3.0]})), str(ext / "a.parquet"))
+    d = str(tmp_path / "db")
+    s = Session(data_dir=d)
+    s.sql(f"create external table e from '{ext}'")
+    r1 = s.sql("select k, sum(v) as s from e group by k order by k").rows()
+    s.checkpoint_metadata()
+    # image (not just the sidecar) carries the def
+    img = s.store.read_image()
+    assert img["catalog"]["external_tables"] == {"e": str(ext)}
+    # a restored catalog registers the same handle with the same file-stat
+    # data version: cache validity agrees across restarts
+    s2 = Session(data_dir=d)
+    assert s2.catalog.data_version("e")[1:] == s.catalog.data_version("e")[1:]
+    r2 = s2.sql("select k, sum(v) as s from e group by k order by k").rows()
+    assert r2 == r1
+    # external file mutation changes the data version -> stale entry drops
+    s2.sql("select k, sum(v) as s from e group by k order by k")
+    pq.write_table(pa.table(pd.DataFrame(
+        {"k": [1], "v": [10.0]})), str(ext / "b.parquet"))
+    s2.catalog.get_table("e").invalidate()
+    s2.cache.invalidate("e")  # the external refresh idiom (device cols too)
+    r3 = s2.sql("select k, sum(v) as s from e group by k order by k")
+    assert _counters(s2).get("qcache_hits", 0) == 0
+    got = {r[0]: r[1] for r in r3.rows()}
+    assert got == {1: 11.0, 2: 5.0}
+
+
+def test_drop_external_survives_restart(tmp_path):
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    ext = tmp_path / "ext"
+    ext.mkdir()
+    pq.write_table(pa.table(pd.DataFrame({"k": [1]})),
+                   str(ext / "a.parquet"))
+    d = str(tmp_path / "db")
+    s = Session(data_dir=d)
+    s.sql(f"create external table e from '{ext}'")
+    s.checkpoint_metadata()
+    s.sql("drop table e")
+    s2 = Session(data_dir=d)  # image says create, journal tail says drop
+    assert s2.catalog.get_table("e") is None

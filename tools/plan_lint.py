@@ -12,6 +12,7 @@ invariant named.
 Usage:
   python tools/plan_lint.py --corpus           # all three corpora
   python tools/plan_lint.py --corpus --suite tpch
+  python tools/plan_lint.py --corpus --qcache  # + query cache on, 2 runs/query
   python tools/plan_lint.py --sql "select ..." # ad-hoc statement (TPC-H cat)
 """
 
@@ -49,7 +50,8 @@ def _suites(which):
         yield ("tpcds", tpcds_catalog(sf=0.01), dict(sorted(TPCDS.items())))
 
 
-def lint_corpus(which: str = "all", verbose: bool = False) -> int:
+def lint_corpus(which: str = "all", verbose: bool = False,
+                qcache: bool = False) -> int:
     import logging
 
     from starrocks_tpu import analysis
@@ -63,6 +65,11 @@ def lint_corpus(which: str = "all", verbose: bool = False) -> int:
     analysis.logger.setLevel(logging.WARNING)
 
     config.set("plan_verify_level", "strict")
+    if qcache:
+        # query cache on: run every query TWICE so both the store path
+        # (result-key completeness audit of the real knob read-set) and
+        # the validated-hit path run under strict
+        config.set("enable_query_cache", True)
     if not config.get("compilation_cache_dir"):
         # share the tier-1 suite's persistent XLA cache: lint re-traces
         # every program (that is the point) but compiles stay warm
@@ -81,6 +88,8 @@ def lint_corpus(which: str = "all", verbose: bool = False) -> int:
             status = "ok"
             try:
                 res = sess.sql(text)
+                if qcache:
+                    res = sess.sql(text)  # the validated-hit path
                 # distribution pass, statically (the single-process corpus
                 # run never enters the distributed executor)
                 analysis.report(
@@ -99,6 +108,7 @@ def lint_corpus(which: str = "all", verbose: bool = False) -> int:
                       f"({time.time() - tq:.1f}s)", file=sys.stderr)
     summary = {
         "metric": "plan_lint_corpus",
+        **({"qcache": True} if qcache else {}),
         "queries": n_queries,
         "strict_failures": errors,
         "findings": analysis.findings_total() - findings_before,
@@ -132,12 +142,16 @@ def main():
     ap.add_argument("--suite", default="all",
                     choices=["all", "tpch", "ssb", "tpcds"])
     ap.add_argument("--sql", default=None, help="lint one ad-hoc statement")
+    ap.add_argument("--qcache", action="store_true",
+                    help="enable the query cache and run each corpus query "
+                         "twice: strict-audits the result cache key (store "
+                         "path) and the validated-hit path")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     if args.sql:
         return lint_sql(args.sql)
     if args.corpus:
-        return lint_corpus(args.suite, args.verbose)
+        return lint_corpus(args.suite, args.verbose, qcache=args.qcache)
     ap.print_help()
     return 2
 

@@ -1,8 +1,8 @@
 #!/usr/bin/env python
 """AST source lint for JAX pitfalls in starrocks_tpu/.
 
-Two rules, both for bug classes that pass every unit test and then burn on
-real hardware:
+Three rules, all for bug classes that pass every unit test and then burn
+on real hardware (or real traffic):
 
 R1 shard-map-shim: `shard_map` must be imported from parallel/mesh.py (the
    version shim that handles the jax>=0.6 move and the check_vma/check_rep
@@ -17,6 +17,16 @@ R2 traced-host-op: inside TRACED scopes — functions handed to jax.jit /
    the program. Host callbacks registered via pure_callback/io_callback/
    debug_callback are exempt (numpy there is the point), as is any line
    tagged `# lint: host-ok`.
+
+R3 cache-key-knob: inside the query cache's key builders
+   (starrocks_tpu/cache/keys.py), every LITERAL `config.get("name")` must
+   name a knob declared `trace=True` or `cache_key=True` at its
+   `config.define` site (statically parsed from runtime/config.py — no
+   import needed). Undeclared reads punch a hole in the result-key
+   completeness proof: analysis/key_check.py audits the DYNAMIC read-set,
+   this rule pins the STATIC one, and the two meet at the declaration.
+   Non-literal reads (`config.get(k) for k in OPT_KEY_KNOBS`) are the
+   shared opt-key channel and stay legal.
 
 Exit 1 on any finding; each names file:line, the rule, and the offending op.
 """
@@ -163,6 +173,70 @@ class Linter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+CACHE_KEY_MODULE = os.path.join("starrocks_tpu", "cache", "keys.py")
+CONFIG_MODULE = os.path.join(PKG, "runtime", "config.py")
+
+
+def _declared_key_knobs() -> dict:
+    """{knob name: (trace, cache_key)} parsed from the config.define calls
+    in runtime/config.py — purely static, so the lint needs no package
+    import (and can't be fooled by runtime monkey-patching)."""
+    with open(CONFIG_MODULE) as f:
+        tree = ast.parse(f.read())
+    out = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and _call_name(node) == "define"
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            flags = {
+                kw.arg: bool(kw.value.value)
+                for kw in node.keywords
+                if kw.arg in ("trace", "cache_key")
+                and isinstance(kw.value, ast.Constant)
+            }
+            out[node.args[0].value] = (
+                flags.get("trace", False), flags.get("cache_key", False))
+    return out
+
+
+def lint_cache_keys() -> list:
+    """R3: literal config.get reads inside cache-key construction must be
+    declared trace=True or cache_key=True (see module docstring)."""
+    path = os.path.join(REPO, CACHE_KEY_MODULE)
+    if not os.path.exists(path):
+        return []
+    declared = _declared_key_knobs()
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{CACHE_KEY_MODULE}:{e.lineno}: [parse] {e.msg}"]
+    lines = src.splitlines()
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "config"
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "lint: host-ok" in line:
+            continue
+        name = node.args[0].value
+        trace, cache_key = declared.get(name, (False, False))
+        if not (trace or cache_key):
+            findings.append(
+                f"{CACHE_KEY_MODULE}:{node.lineno}: [cache-key-knob] "
+                f"config.get({name!r}) inside cache-key construction: "
+                f"declare the knob trace=True or cache_key=True at its "
+                f"config.define site, or the result key cannot be proven "
+                f"complete")
+    return findings
+
+
 def lint_file(path: str) -> list:
     rel = os.path.relpath(path, REPO)
     with open(path) as f:
@@ -184,6 +258,7 @@ def main():
         for fn in sorted(files):
             if fn.endswith(".py"):
                 findings += lint_file(os.path.join(root, fn))
+    findings += lint_cache_keys()
     for f in findings:
         print(f)
     print(f"src_lint: {len(findings)} finding(s)")
