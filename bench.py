@@ -373,9 +373,19 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         _sr_cfg.set("plan_verify_level", "warn")
     if "SR_TPU_PLAN_VERIFY_TRACE" not in os.environ:
         _sr_cfg.set("plan_verify_trace", False)
+    # per-query deadline (runtime/lifecycle.py): a wedged query fails with
+    # QueryTimeoutError and the suite continues. 0/unset = off so timings
+    # stay comparable across rounds by default.
+    q_timeout = float(os.environ.get("SR_TPU_BENCH_QUERY_TIMEOUT_S", "0"))
+    if q_timeout > 0:
+        _sr_cfg.set("query_timeout_s", q_timeout)
 
+    # chaos counters for the summary line: killed / deadline-failed queries
+    chaos = {"qcancelled": 0, "qtimeout": 0}
     detail = {"backend": jax.default_backend(), "sf": sf,
               "budget_s": _budget_s()}
+    if q_timeout > 0:
+        detail["query_timeout_s"] = q_timeout
     if only:
         detail["only"] = list(only)
     if skip:
@@ -414,6 +424,10 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
             print(f"# {name}: SKIPPED (budget)", file=sys.stderr)
             flush_detail()
             return
+        from starrocks_tpu.runtime.lifecycle import (
+            QueryCancelledError, QueryTimeoutError,
+        )
+
         try:
             d = fn()
             detail[name] = d
@@ -425,6 +439,15 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
             print(f"# {name}: {d.get('device_ms')}ms device, "
                   f"{d.get('pandas_ms')}ms pandas, "
                   f"{d.get('vs_pandas')}x{flag}", file=sys.stderr)
+        except QueryTimeoutError as e:
+            # per-query deadline fired: machine-readable, suite continues
+            chaos["qtimeout"] += 1
+            detail[name] = {"timeout": f"{e}"}
+            print(f"# {name}: TIMEOUT {e}", file=sys.stderr)
+        except QueryCancelledError as e:
+            chaos["qcancelled"] += 1
+            detail[name] = {"cancelled": f"{e}"}
+            print(f"# {name}: CANCELLED {e}", file=sys.stderr)
         except Exception as e:  # noqa: BLE001 — one failure must not kill the bench
             detail[name] = {"error": f"{type(e).__name__}: {e}"}
             print(f"# {name}: FAILED {type(e).__name__}: {e}", file=sys.stderr)
@@ -544,6 +567,8 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         if isinstance(d, dict) and d.get("correct") is False)
     detail["mismatches"] = len(mismatches)
     detail["mismatched_queries"] = mismatches
+    detail["qcancelled"] = chaos["qcancelled"]
+    detail["qtimeout"] = chaos["qtimeout"]
     flush_detail()
 
     # --- TPU tunnel forensics (only when the probe failed) ------------------
@@ -584,6 +609,8 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         "rf_segments_pruned": rf_totals.get("rf_segments_pruned", 0),
         "rf_bloom_bits": rf_totals.get("rf_bloom_bits", 0),
         "verify_findings": _sr_analysis.findings_total(),
+        "qcancelled": chaos["qcancelled"],
+        "qtimeout": chaos["qtimeout"],
         **({"qcache_repeat": qrepeat, **qcache_totals} if qrepeat > 1
            else {}),
     }))

@@ -40,6 +40,15 @@ HOST_LOOP_KNOBS = {
     "default_agg_groups": "capacity default; caps dict keys the programs",
     "plan_verify_level": "the verifier's own knob (host-side)",
     "plan_verify_trace": "the verifier's own knob (host-side)",
+    "query_timeout_s":
+        "lifecycle deadline, captured at query-scope entry (outside every "
+        "record window) and enforced at host stage boundaries only",
+    "query_mem_limit_bytes":
+        "lifecycle hard memory cap; host accountant only, never traced",
+    "query_mem_soft_limit_bytes":
+        "lifecycle soft memory threshold; host-side degradation only",
+    "process_mem_limit_bytes":
+        "process-level accountant cap; host-side only",
 }
 
 # Knobs that shape the OPTIMIZED PLAN (read during optimize(), not during

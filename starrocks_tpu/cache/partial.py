@@ -86,7 +86,9 @@ def try_partial_cached(executor, plan, profile):
     stats = {}
 
     def attempt(caps, p):
+        from ..runtime import lifecycle
         from ..runtime.batched import make_programs, slice_scan_chunk
+        from ..runtime.failpoint import fail_point
         from ..runtime.session import concat_tables
 
         if not caps.values and bucket["last"]:
@@ -99,7 +101,15 @@ def try_partial_cached(executor, plan, profile):
 
         states, max_ng = [], 0
         hits = saved = fresh_rows = 0
+        # LRU admission is DEFERRED until the whole fragment completes: a
+        # kill/deadline/failure mid-loop must not leave a half-populated
+        # set of partial entries behind (they are individually valid, but
+        # admitting some segments of an aborted attempt makes leak
+        # accounting and before/after snapshots unauditable)
+        pending_puts = []
         for fmeta in seg_metas:
+            fail_point("qcache::partial_segment")
+            lifecycle.checkpoint("qcache::partial_segment")
             ver = cache_keys.segment_version(store, handle.name, fmeta)
             live = fmeta["rows"] - len(fmeta.get("delvec") or ())
             ent = qc.get_partial(fkey, ver) if ver is not None else None
@@ -124,16 +134,24 @@ def try_partial_cached(executor, plan, profile):
                 bucket["last"] = caps.values
                 return None, [(CAP_KEY, max_ng)]
             st = HostTable.from_chunk(out)
+            lifecycle.account(st, "qcache::partial_segment")
             states.append(st)
             if ver is not None:
-                qc.put_partial(fkey, ver, st, live)
+                pending_puts.append((ver, st, live))
 
+        lifecycle.checkpoint("qcache::partial_merge")
         merged = states[0]
         for st in states[1:]:
             merged = concat_tables(merged, st, target_schema=merged.schema)
         out, ng = jfinal(merged.to_chunk())
         ng = int(ng)
         bucket["last"] = caps.values
+        if lifecycle.degraded():
+            p.set_info("qcache_declined", "mem-soft-degraded")
+        else:
+            for ver, st, live in pending_puts:
+                fail_point("qcache::partial_store")
+                qc.put_partial(fkey, ver, st, live)
         stats.update(hits=hits, saved=saved, fresh=fresh_rows)
         return out, [(CAP_KEY, max(max_ng, ng))]
 

@@ -28,6 +28,7 @@ import threading
 from collections import OrderedDict
 
 from ..runtime.config import config
+from ..runtime.failpoint import fail_point
 from ..runtime.metrics import metrics
 
 QCACHE_HITS = metrics.counter(
@@ -94,6 +95,7 @@ class QueryCache:
         """Validated hit or None. Stale entries (any table's current data
         version differs from the one observed at store time) are dropped
         immediately — the append-invalidates-repeat contract."""
+        fail_point("qcache::lookup")
         with self._lock:
             k = ("r", skey)
             e = self._entries.get(k)
@@ -110,6 +112,7 @@ class QueryCache:
             return e
 
     def store_result(self, skey, table, plan, versions):
+        fail_point("qcache::store_result")
         with self._lock:
             e = ResultEntry(table, plan, versions, table_bytes(table))
             self._put(("r", skey), e)
@@ -142,6 +145,7 @@ class QueryCache:
         DeviceCache.invalidate). Partial entries stay: their segment-version
         keys already pin exact file content, so after an append the old
         segments' states remain valid — that IS the delta-reuse tier."""
+        fail_point("qcache::invalidate")
         t = table.lower()
         with self._lock:
             stale = [k for k, e in self._entries.items()

@@ -24,6 +24,8 @@ import dataclasses
 
 import jax
 
+from . import lifecycle
+from .failpoint import fail_point
 from ..column import Chunk
 from ..column.column import Schema, chunk_from_arrays, pad_capacity
 from ..exprs.ir import Col
@@ -224,15 +226,34 @@ def execute_batched(
 
     partials = []
     max_ng = 0
-    for b in range(n_batches):
-        lo, hi = b * batch_rows, min((b + 1) * batch_rows, total)
+    # dynamic slicing (not a fixed range) so soft-mem degradation can
+    # shrink the remaining batches' row count mid-stream: smaller slices
+    # into the same compiled capacity are free, and host-resident bytes
+    # per iteration halve (the lifecycle's graceful-degradation hook)
+    b_rows = batch_rows
+    lo = 0
+    n_batches = 0
+    while lo < total or n_batches == 0:
+        fail_point("spill::batch_loop")
+        lifecycle.checkpoint("spill::batch_loop")
+        hi = min(lo + b_rows, total)
         chunk = slice_scan_chunk(ht, alias, cols, slice(lo, hi), cap)
         out, ng = jpartial(chunk)
+        lifecycle.account(out, "spill::batch_loop")
         partials.append(out)
         max_ng = max(max_ng, int(ng))
+        lo = hi
+        n_batches += 1
+        if lifecycle.degraded() and b_rows > 1024:
+            b_rows = max(b_rows // 2, 1024)
+    profile_node.set_info("batches", n_batches)
 
+    fail_point("spill::merge_partials")
+    lifecycle.checkpoint("spill::merge_partials")
     merged = concat_many(partials)
+    fail_point("spill::final_agg")
     out, ng = jfinal(merged)
+    lifecycle.account(out, "spill::final_agg")
     max_ng = max(max_ng, int(ng))
     return out, [(GROUP_CAP_KEY, max_ng)]
 
@@ -429,6 +450,8 @@ def execute_grace_join(
     outs = []
     checks_max: dict = {}
     for p in range(n_parts):
+        fail_point("grace::partition_loop")
+        lifecycle.checkpoint("grace::partition_loop")
         inputs = []
         for table, alias, cols in scans:
             if alias == gp.left_scan.alias:
@@ -441,10 +464,13 @@ def execute_grace_join(
                 inputs.append(executor.cache.chunk_for(
                     catalog.get_table(table), alias, cols))
         out, checks = jpart(inputs)
+        lifecycle.account(out, "grace::partition_loop")
         outs.append(out)
         for k, v in checks.items():
             checks_max[k] = max(checks_max.get(k, 0), int(v))
 
+    fail_point("grace::final")
+    lifecycle.checkpoint("grace::final")
     if gp.agg is not None:
         merged = concat_many(outs)
         final_group_by = tuple((n, Col(n)) for n, _ in gp.agg.group_by)
@@ -548,11 +574,14 @@ def execute_spill_sort(sp: SpillSortPlan, catalog, batch_rows: int,
     profile_node.set_info("batches", n_batches)
     out_tables, out_ops = [], None
     for b in range(n_batches):
+        fail_point("spill_sort::batch")
+        lifecycle.checkpoint("spill_sort::batch")
         lo, hi = b * batch_rows, min((b + 1) * batch_rows, total)
         chunk = slice_scan_chunk(ht, alias, cols, slice(lo, hi), cap)
         c, ops, live = jprog(chunk)
         live_np = np.asarray(live)
         out_tables.append(HostTable.from_chunk(c))  # drops dead rows
+        lifecycle.account(out_tables[-1], "spill_sort::batch")
         batch_ops = [np.asarray(o)[live_np] for o in ops]
         if out_ops is None:
             out_ops = [[o] for o in batch_ops]
@@ -560,6 +589,8 @@ def execute_spill_sort(sp: SpillSortPlan, catalog, batch_rows: int,
             for acc, o in zip(out_ops, batch_ops):
                 acc.append(o)
 
+    fail_point("spill_sort::merge")
+    lifecycle.checkpoint("spill_sort::merge")
     schema, merged_arrays, merged_valids = host_concat_tables(out_tables)
     order = np.lexsort(tuple(np.concatenate(a) for a in out_ops))
     lo = 0
@@ -803,9 +834,12 @@ def execute_streaming_window(sp: SpillWindowPlan, catalog, batch_rows: int,
     cont_rows = 0      # emitted rows of the open partition so far
     outs = []
     for a, b in zip(cuts, cuts[1:]):
+        fail_point("stream_window::chunk")
+        lifecycle.checkpoint("stream_window::chunk")
         idx = order[a:b]
         out = HostTable.from_chunk(jprog(
             slice_scan_chunk(ht, alias, cols, idx, cap)))
+        lifecycle.account(out, "stream_window::chunk")
         if out.num_rows:
             # identify output rows of the partition continuing from the
             # previous chunk; chunk-local part keys read from the OUTPUT
@@ -965,8 +999,11 @@ def execute_spill_window(sp: SpillWindowPlan, catalog, batch_rows: int,
         off += cnt
         if cnt == 0:
             continue
+        fail_point("spill_window::group")
+        lifecycle.checkpoint("spill_window::group")
         chunk = slice_scan_chunk(ht, alias, cols, idx, cap)
         outs.append(HostTable.from_chunk(jprog(chunk)))
+        lifecycle.account(outs[-1], "spill_window::group")
 
     schema, arrays, valids = host_concat_tables(outs)
     return HostTable(schema, arrays, valids)

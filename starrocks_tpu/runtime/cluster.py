@@ -92,8 +92,13 @@ class ClusterMonitor:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
                 if self.path == "/heartbeat" and "id" in body:
-                    mon.beat(str(body["id"]))
-                    self.send_response(200)
+                    try:
+                        mon.beat(str(body["id"]))
+                        self.send_response(200)
+                    except Exception:  # noqa: BLE001  # lint: swallow-ok —
+                        # injected/receiver faults answer 500; the worker's
+                        # backoff ladder treats it as a missed beat
+                        self.send_response(500)
                 else:
                     self.send_response(404)
                 self.end_headers()
@@ -125,6 +130,9 @@ class ClusterMonitor:
 
     # --- registry ------------------------------------------------------------
     def beat(self, worker_id: str):
+        from .failpoint import fail_point
+
+        fail_point("heartbeat::recv")
         with self._lock:
             self._beats[worker_id] = time.monotonic()
             self._state[worker_id] = ALIVE
@@ -152,7 +160,7 @@ class ClusterMonitor:
                 if self.on_failure is not None:
                     try:
                         self.on_failure(w)
-                    except Exception:  # noqa: BLE001 — liveness must survive
+                    except Exception:  # noqa: BLE001  # lint: swallow-ok — liveness must survive
                         pass
 
     def close(self):
@@ -162,32 +170,69 @@ class ClusterMonitor:
 
 
 class Heartbeater:
-    """Worker-side periodic beat (the BE heartbeat answer analog)."""
+    """Worker-side periodic beat (the BE heartbeat answer analog).
+
+    Reconnect policy: capped exponential backoff with jitter. A healthy
+    coordinator is probed every `interval_s`; after k consecutive failed
+    beats the delay grows to min(interval_s * 2^k, max_backoff_s), then a
+    uniform jitter in [0.5, 1.0) of that value spreads a fleet of workers
+    whose coordinator just restarted (the thundering-herd guard the old
+    fixed-interval probe lacked). One successful beat resets the ladder."""
 
     def __init__(self, host: str, port: int, worker_id: str,
-                 interval_s: float = 0.2):
+                 interval_s: float = 0.2, max_backoff_s: float = 5.0,
+                 rng=None, autostart: bool = True, _wait=None):
+        """`rng` and `_wait` are injection points for deterministic tests
+        (a seeded Random and a fake-clock wait); `autostart=False` builds
+        the beater without its thread for unit-testing the policy."""
+        import random
+
         self.host, self.port = host, port
         self.worker_id = worker_id
         self.interval_s = interval_s
+        self.max_backoff_s = max_backoff_s
+        self._failures = 0
+        self._rng = rng or random.Random()
         self._stop = threading.Event()
-        self._t = threading.Thread(target=self._run, daemon=True)
-        self._t.start()
+        self._wait = _wait or self._stop.wait
+        self._t = None
+        if autostart:
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+    def _next_delay(self) -> float:
+        """Seconds until the next beat given the consecutive-failure count
+        (pure: the unit-testable policy)."""
+        if self._failures == 0:
+            return self.interval_s
+        backoff = min(self.interval_s * (2 ** self._failures),
+                      self.max_backoff_s)
+        return backoff * (0.5 + self._rng.random() / 2)
+
+    def _beat_once(self) -> bool:
+        from .failpoint import FailPointError, fail_point
+
+        try:
+            fail_point("heartbeat::send")
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=2)
+            conn.request("POST", "/heartbeat",
+                         json.dumps({"id": self.worker_id}),
+                         {"Content-Type": "application/json"})
+            conn.getresponse().read()
+            conn.close()
+            return True
+        except (OSError, FailPointError):
+            return False  # coordinator away (or injected fault): back off
 
     def _run(self):
-        body = json.dumps({"id": self.worker_id})
         while not self._stop.is_set():
-            try:
-                conn = http.client.HTTPConnection(
-                    self.host, self.port, timeout=2)
-                conn.request("POST", "/heartbeat", body,
-                             {"Content-Type": "application/json"})
-                conn.getresponse().read()
-                conn.close()
-            except OSError:
-                pass  # coordinator briefly away: keep beating
-            self._stop.wait(self.interval_s)
+            ok = self._beat_once()
+            self._failures = 0 if ok else self._failures + 1
+            self._wait(self._next_delay())
 
     def stop(self):
         """Silence the worker (the crash simulation in tests)."""
         self._stop.set()
-        self._t.join(timeout=2)
+        if self._t is not None:
+            self._t.join(timeout=2)

@@ -284,6 +284,26 @@ config.define("query_cache_capacity_mb", 256, True,
               "+ per-segment partial-aggregation states share it; least-"
               "recently-used entries evict past the budget)",
               cache_key=True)
+config.define("query_timeout_s", 0.0, True,
+              "per-query deadline in seconds, enforced cooperatively at "
+              "host-side stage boundaries (compiled-program dispatches, "
+              "batched/grace/spill iterations, segment-cache merges, scan "
+              "loads) with QueryTimeoutError (runtime/lifecycle.py). "
+              "0 = off — byte-identical to a build without the lifecycle "
+              "manager")
+config.define("query_mem_limit_bytes", 0, True,
+              "hard per-query cap on cumulative materialized-buffer bytes "
+              "(device chunks, host partial states, spill tables) fed to "
+              "the hierarchical memory accountant at stage boundaries; "
+              "breach raises MemLimitExceeded naming the stage. 0 = off")
+config.define("query_mem_soft_limit_bytes", 0, True,
+              "soft per-query memory threshold: crossing it degrades "
+              "gracefully (query-cache admission declined, spill batch "
+              "capacity shrinks) instead of failing. 0 = off")
+config.define("process_mem_limit_bytes", 0, True,
+              "hard process-wide cap on accountant-tracked bytes across "
+              "all running queries (the process-level MemTracker analog). "
+              "0 = off")
 config.define("plan_verify_trace", True, True,
               "run the jaxpr trace auditor on every freshly-compiled "
               "program when plan_verify_level != off (adds one extra "

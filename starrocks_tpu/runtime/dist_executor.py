@@ -45,7 +45,7 @@ class DistExecutor(Executor):
             return
         try:
             findings = check_distribution(plan, self.catalog)
-        except Exception:  # noqa: BLE001 — verifier bug, not a query bug
+        except Exception:  # noqa: BLE001  # lint: swallow-ok — verifier bug, not a query bug
             return
         report(findings, profile, where="distribution")
 

@@ -28,6 +28,7 @@ from ..sql.logical import (
 )
 from ..sql.optimizer import optimize
 from ..sql.physical import Caps, compile_plan
+from . import lifecycle
 from .config import config
 from .failpoint import fail_point
 from .metrics import QUERIES_TOTAL, QUERY_ERRORS, RECOMPILES, ROWS_RETURNED
@@ -90,6 +91,7 @@ class DeviceCache:
         return b
 
     def invalidate(self, table: str):
+        fail_point("devicecache::invalidate")
         self._cols = {k: v for k, v in self._cols.items() if k[0] != table}
         self._caps = {k: v for k, v in self._caps.items() if k[0] != table}
         # full-result entries that observed this table drop immediately;
@@ -150,6 +152,7 @@ class DeviceCache:
         tag = "rf:" + ",".join(f"{c}[{lo},{hi}]" for c, lo, hi in bounds)
         key = (handle.name, "__rfscan__", tag, tuple(columns))
         if key not in self._cols:
+            fail_point("scan::rf_pruned_load")
             ht = handle.store.load_table(
                 handle.name, columns=list(columns),
                 rf_predicate=bounds_predicate(bounds))
@@ -166,6 +169,11 @@ class DeviceCache:
         must not collide with the full-table entries)."""
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # scan-load stage boundary: cancellable, failpoint-injectable, and
+        # the placed buffers feed the memory accountant below
+        fail_point("scan::chunk_to_device")
+        lifecycle.checkpoint("scan::chunk_to_device")
 
         ht = handle.table
         reorder = None  # host row permutation + per-shard layout (hash modes)
@@ -278,7 +286,9 @@ class DeviceCache:
                         selv[b * shard_cap : b * shard_cap + cnt] = True
                 self._cols[sel_key] = (put(selv), None)
             sel = self._cols[sel_key][0]
-        return Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)
+        out = Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)
+        lifecycle.account(out, "scan::chunk_to_device")
+        return out
 
 
 @dataclasses.dataclass
@@ -359,6 +369,14 @@ class Executor:
         from ..cache import keys as cache_keys
         from ..sql.optimizer import plan_tables
 
+        ctx = lifecycle.current()
+        if ctx is not None and ctx.degraded:
+            # soft-mem degradation: the result is correct but the query
+            # crossed its soft limit — decline cache admission rather than
+            # grow the LRU under pressure (graceful-degradation contract)
+            profile.set_info("qcache_declined",
+                             f"mem-soft-degraded: {ctx.degrade_reason}")
+            return
         if verify_level() != "off":
             findings = check_cache_reads(reads)
             report(findings, profile, where="qcache")
@@ -373,6 +391,8 @@ class Executor:
     ) -> QueryResult:
         QUERIES_TOTAL.inc()
         try:
+            fail_point("optimizer::before_optimize")
+            lifecycle.checkpoint("optimizer::before_optimize")
             with profile.timer("optimize"):
                 # plan-shaping flags key the cache (SET enable_window_topn /
                 # enable_mv_rewrite must not serve a plan rewritten under
@@ -397,13 +417,19 @@ class Executor:
                 plan = self._resolve_scalar_subqueries(opt)
             self._verify_plan(plan, profile)
             out_chunk = self._run(plan, profile)
+            fail_point("executor::fetch_results")
+            lifecycle.checkpoint("executor::fetch_results")
             with profile.timer("fetch_results"):
                 # spilled sorts return host-materialized results directly
                 ht = (out_chunk if isinstance(out_chunk, HostTable)
                       else HostTable.from_chunk(out_chunk))
                 # strip alias qualifiers for final output names where unambiguous
                 ht = _prettify_names(ht)
+            lifecycle.account(ht, "executor::fetch_results")
             ROWS_RETURNED.inc(ht.num_rows)
+            # deliberately AFTER the last checkpoint: a kill landing here
+            # finds a completed query (the documented KILL-race no-op)
+            fail_point("executor::result_ready")
             return QueryResult(ht, plan, profile)
         except Exception:
             QUERY_ERRORS.inc()
@@ -594,6 +620,8 @@ class Executor:
                     raise ExecError(
                         "correlated scalar subquery not rewritten by optimizer"
                     )
+                fail_point("executor::subquery_resolve")
+                lifecycle.checkpoint("executor::subquery_resolve")
                 sub = self.execute_logical(e.plan)
                 ht = sub.table
                 rows = ht.to_pylist()
@@ -693,10 +721,15 @@ class Executor:
         from ..ops.sort import drain_sort_stamps
 
         for attempt in range(max_recompiles):
+            lifecycle.checkpoint("executor::attempt")
             drain_sort_stamps()  # discard stamps of failed/other attempts
             p = profile.child(f"attempt_{attempt}")
             with p.timer("compile_and_run"):
                 out, keyed_checks = attempt_fn(caps, p)
+            # post-attempt boundary: a deadline that expired during this
+            # compile+run fails the query HERE, before the next dispatch
+            lifecycle.checkpoint("executor::after_attempt")
+            lifecycle.account(out, "executor::attempt")
             p.set_info("capacities", dict(caps.values))
             floors = {k[len("~floor_"):]: int(v) for k, v in keyed_checks
                       if k.startswith("~floor_")}
@@ -775,7 +808,7 @@ class Executor:
 
         try:
             prune_map = compute_scan_prune(plan, self.catalog)
-        except Exception:  # noqa: BLE001 — stats must never fail a query
+        except Exception:  # noqa: BLE001  # lint: swallow-ok — stats must never fail a query
             return {}
         scan_rf: dict = {}
         rf_segs = 0
@@ -950,6 +983,8 @@ class Executor:
         hit = bucket["progs"].get(tuple(sorted(caps.values.items())))
         raw = reads = None
         if hit is None:
+            fail_point("executor::before_compile")
+            lifecycle.checkpoint("executor::before_compile")
             # record every knob read from compile through the first call
             # (jit traces lazily INSIDE that call) — the key-completeness
             # checker's probe window
@@ -957,12 +992,16 @@ class Executor:
                 fn, scans, raw = compile_cb()
                 with p.timer("scan_to_device"):
                     inputs = place_cb(scans)
+                fail_point("executor::before_dispatch")
+                lifecycle.checkpoint("executor::before_dispatch")
                 out, checks = fn(inputs)
                 jax.block_until_ready(out.data)
         else:
             fn, scans = hit
             with p.timer("scan_to_device"):
                 inputs = place_cb(scans)
+            fail_point("executor::before_dispatch")
+            lifecycle.checkpoint("executor::before_dispatch")
             out, checks = fn(inputs)
             jax.block_until_ready(out.data)
         if raw is not None:
@@ -1099,7 +1138,7 @@ def _expr_cols_safe(e):
 
     try:
         return expr_cols(e)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001  # lint: swallow-ok — cols unused
         return set()
 
 

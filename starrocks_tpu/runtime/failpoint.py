@@ -52,6 +52,22 @@ class _Registry:
     def list(self):
         return sorted(self._armed)
 
+    def snapshot(self):
+        """[(name, armed, times_remaining, hits)] over every failpoint ever
+        hit or currently armed — the information_schema.fail_points /
+        HTTP metrics surface. times_remaining -1 = unlimited."""
+        with self._lock:
+            names = sorted(set(self._armed) | set(self._hits))
+            out = []
+            for n in names:
+                ent = self._armed.get(n)
+                times = -1
+                if ent is not None and ent["times"] is not None:
+                    times = int(ent["times"])
+                out.append((n, ent is not None, times,
+                            self._hits.get(n, 0)))
+            return out
+
 
 _registry = _Registry()
 
@@ -67,6 +83,56 @@ def arm(name: str, action=None, times=None):
 
 def disarm(name: str):
     _registry.disarm(name)
+
+
+def snapshot():
+    return _registry.snapshot()
+
+
+def hits(name: str) -> int:
+    return _registry.hits(name)
+
+
+def set_from_sql(name: str, value: str):
+    """The `ADMIN SET failpoint '<name>' = '<value>'` surface (reference:
+    the fail-point RPC scripted by SQL regression suites). Values:
+    'enable' (raise on hit), 'enable:times=N' (raise for the next N hits),
+    'disable'."""
+    v = str(value).strip().lower()
+    if v == "disable":
+        disarm(name)
+        return
+    if v == "enable":
+        arm(name)
+        return
+    if v.startswith("enable:"):
+        opt = v[len("enable:"):]
+        if opt.startswith("times="):
+            try:
+                times = int(opt[len("times="):])
+            except ValueError:
+                raise ValueError(
+                    f"bad failpoint times in {value!r}") from None
+            arm(name, times=times)
+            return
+    raise ValueError(
+        f"unknown failpoint action {value!r}: expected "
+        "'enable', 'enable:times=N', or 'disable'")
+
+
+def render_prometheus() -> str:
+    """Armed flags + hit counters as Prometheus text (appended to the HTTP
+    /metrics payload next to the main registry's render)."""
+    rows = _registry.snapshot()
+    if not rows:
+        return ""
+    out = ["# TYPE sr_tpu_failpoint_armed gauge"]
+    for n, armed, _times, _hits in rows:
+        out.append(f'sr_tpu_failpoint_armed{{name="{n}"}} {int(armed)}')
+    out.append("# TYPE sr_tpu_failpoint_hits counter")
+    for n, _armed, _times, h in rows:
+        out.append(f'sr_tpu_failpoint_hits{{name="{n}"}} {h}')
+    return "\n".join(out) + "\n"
 
 
 @contextmanager

@@ -39,13 +39,25 @@ def make_handler(session: Session, lock: threading.Lock):
 
         def do_GET(self):
             if self.path == "/metrics":
-                self._send(200, metrics.render_prometheus(), "text/plain")
+                from . import failpoint
+
+                # failpoint armed/hit series ride the same payload so a
+                # chaos run is observable from the standard scrape
+                self._send(200, metrics.render_prometheus()
+                           + failpoint.render_prometheus(), "text/plain")
             elif self.path == "/profile":
                 prof = session.last_profile
                 self._send(200, prof.render() if prof else "no queries yet",
                            "text/plain")
             elif self.path == "/tables":
                 self._send(200, json.dumps(sorted(session.catalog.tables)))
+            elif self.path == "/api/queries":
+                from .lifecycle import REGISTRY
+
+                cols = ("id", "user", "state", "elapsed_ms", "group",
+                        "mem_bytes", "stage", "sql")
+                self._send(200, json.dumps(
+                    [dict(zip(cols, r)) for r in REGISTRY.snapshot()]))
             else:
                 self._send(404, json.dumps({"error": "not found"}))
 
@@ -62,11 +74,40 @@ def make_handler(session: Session, lock: threading.Lock):
                 try:
                     user, _, pw = base64.b64decode(
                         hdr[6:]).decode().partition(":")
-                except Exception:
+                except Exception:  # lint: swallow-ok — bad header = deny
                     return None
             return user if auth.verify_plain(user, pw) else None
 
         def do_POST(self):
+            import re
+
+            m = re.fullmatch(r"/api/query/(\d+)/cancel", self.path)
+            if m is not None:
+                # lock-free by design: the query lock is HELD by the very
+                # query being cancelled; cancellation is a registry flag
+                # the running query observes at its next stage boundary
+                from .lifecycle import REGISTRY
+
+                user = self._auth_user()
+                if user is None:
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate",
+                                     'Basic realm="starrocks_tpu"')
+                    self.end_headers()
+                    return
+                try:
+                    ok = REGISTRY.cancel(
+                        int(m.group(1)), requester=user,
+                        admin=session.auth().is_admin(user))
+                except PermissionError as e:
+                    self._send(403, json.dumps({"error": str(e)}))
+                    return
+                self._send(200, json.dumps({
+                    "cancelled": ok,
+                    "note": ("cooperative: takes effect at the next stage "
+                             "boundary" if ok else
+                             "query not running; cancel is a no-op")}))
+                return
             if self.path != "/query":
                 self._send(404, json.dumps({"error": "not found"}))
                 return
@@ -74,7 +115,7 @@ def make_handler(session: Session, lock: threading.Lock):
                 n = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(n) or b"{}")
                 sql = payload["sql"]
-            except Exception as e:
+            except Exception as e:  # lint: swallow-ok — 400 response
                 self._send(400, json.dumps({"error": f"bad request: {e}"}))
                 return
             user = self._auth_user()
@@ -84,8 +125,11 @@ def make_handler(session: Session, lock: threading.Lock):
                                  'Basic realm="starrocks_tpu"')
                 self.end_headers()
                 return
+            from .failpoint import fail_point
+
             t0 = time.time()
             try:
+                fail_point("http::query")
                 with lock:
                     prev = session.current_user
                     session.current_user = user
@@ -101,7 +145,7 @@ def make_handler(session: Session, lock: threading.Lock):
                     body = {"columns": res.column_names, "rows": res.rows()}
                 body["ms"] = round((time.time() - t0) * 1000, 1)
                 self._send(200, json.dumps(body, default=str))
-            except Exception as e:
+            except Exception as e:  # lint: swallow-ok — typed error -> 400
                 self._send(
                     400,
                     json.dumps({"error": f"{type(e).__name__}: {e}"}),

@@ -1,0 +1,368 @@
+"""Query lifecycle manager: cancellation, deadlines, memory accounting.
+
+Reference behavior: the FE/BE query lifecycle plane —
+- `KILL <query>` / `query_timeout` cancellation that unwinds fragments and
+  releases admission slots (fe qe/ConnectContext.java kill handling,
+  be exec_env fragment cancellation);
+- per-query MemTrackers in a process -> resource-group -> query hierarchy
+  with soft-limit spill triggers and hard-limit query failure
+  (be/src/base/mem_tracker.h);
+- SHOW PROCESSLIST / information_schema surfaces over the running set.
+
+TPU-first re-design: a query here is a host loop around a handful of
+compiled-program dispatches (attempt loop, batched/grace/spill iterations,
+segment-cache merges, scan loads). A dispatched XLA program is not
+interruptible, so cancellation is COOPERATIVE: every host-side stage
+boundary calls `checkpoint(stage)`, which raises `QueryCancelledError` /
+`QueryTimeoutError` when a kill landed or the deadline passed. That bounds
+kill latency to one stage, which is exactly the granularity the engine
+has. The same boundaries feed the `MemoryAccountant` with REAL
+materialized-buffer sizes (device chunks, host partial states, spill
+tables), replacing estimate-only admission as the enforcement point.
+
+Unwind contract: `query_scope` is the single entry/exit gate. On ANY exit
+path (success, kill, timeout, mem-limit, engine error) it runs the
+context's cleanup stack (admission-slot release and anything else
+registered via `on_exit`), releases every byte the accountant charged,
+and deregisters the query — so a killed/failed query leaves the session
+immediately reusable and the accountant snapshot identical to before.
+tests/test_chaos.py asserts this for every failure class.
+
+With defaults (`query_timeout_s=0`, mem limits 0, nothing armed) every
+checkpoint is a few attribute reads and the engine's behavior is
+byte-identical to a build without this module.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+
+from .metrics import metrics
+
+QUERIES_CANCELLED = metrics.counter(
+    "sr_tpu_queries_cancelled_total", "queries killed via KILL/cancel")
+QUERIES_TIMEOUT = metrics.counter(
+    "sr_tpu_queries_timeout_total", "queries failed by query_timeout_s")
+MEMLIMIT_TOTAL = metrics.counter(
+    "sr_tpu_mem_limit_exceeded_total",
+    "queries failed by a hard memory limit")
+MEM_DEGRADED = metrics.counter(
+    "sr_tpu_mem_soft_degraded_total",
+    "queries that crossed the soft memory limit and degraded")
+
+
+class QueryAbortError(RuntimeError):
+    """Base of the lifecycle's typed query errors."""
+
+
+class QueryCancelledError(QueryAbortError):
+    """Raised at the first checkpoint after a KILL landed."""
+
+
+class QueryTimeoutError(QueryAbortError):
+    """Raised at the first checkpoint past the query's deadline."""
+
+
+class MemLimitExceeded(QueryAbortError):
+    """Raised by the accountant when a hard limit breaks; the message
+    names the offending stage."""
+
+
+class QueryContext:
+    """One query's lifecycle state. Created by `query_scope`; reached from
+    stage boundaries via the thread-local `current()`."""
+
+    def __init__(self, sql: str, user: str = "root", group: str | None = None,
+                 group_limit: int = 0):
+        from .config import config
+
+        self.qid: int = 0  # assigned by the registry
+        self.sql = sql
+        self.user = user
+        self.group = group
+        self.group_limit = int(group_limit or 0)
+        self.state = "running"
+        self.t0 = time.monotonic()
+        self.timeout_s = float(config.get("query_timeout_s") or 0.0)
+        self.deadline = self.t0 + self.timeout_s if self.timeout_s > 0 else None
+        # limits are CAPTURED here (outside any knob-read-set recording
+        # window) so checkpoints/accounting never read config mid-execution
+        # — a config.get inside the executor's record_reads window would
+        # register as a cache-key escapee (analysis/key_check.py)
+        self.mem_limit = int(config.get("query_mem_limit_bytes") or 0)
+        self.mem_soft_limit = int(
+            config.get("query_mem_soft_limit_bytes") or 0)
+        self.process_limit = int(config.get("process_mem_limit_bytes") or 0)
+        self.mem_bytes = 0          # cumulative charged bytes (this query)
+        self.degraded = False       # soft limit crossed: degrade gracefully
+        self.degrade_reason = None
+        self.last_stage = "start"
+        self._cancel_reason = None
+        self._cleanups: list = []   # run LIFO on scope exit, every path
+
+    # --- cooperative cancellation --------------------------------------------
+    def cancel(self, reason: str = "killed") -> bool:
+        """Request cancellation (any thread). Cooperative: the query dies at
+        its NEXT checkpoint; a query already past its last checkpoint
+        completes normally and the kill is a documented no-op."""
+        if self.state != "running":
+            return False
+        self._cancel_reason = reason
+        return True
+
+    def check(self, stage: str):
+        """The stage-boundary checkpoint: raise if killed or past deadline."""
+        self.last_stage = stage
+        if self._cancel_reason is not None:
+            raise QueryCancelledError(
+                f"query {self.qid} cancelled at stage {stage!r}: "
+                f"{self._cancel_reason}")
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            raise QueryTimeoutError(
+                f"query {self.qid} exceeded query_timeout_s="
+                f"{self.timeout_s:g} at stage {stage!r}")
+
+    # --- unwind registration --------------------------------------------------
+    def on_exit(self, fn):
+        """Register a cleanup to run on ANY exit path (LIFO). Cleanups must
+        be idempotent — belt-and-braces callers may also release inline."""
+        self._cleanups.append(fn)
+
+    def run_cleanups(self):
+        while self._cleanups:
+            fn = self._cleanups.pop()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001  # lint: swallow-ok — unwind
+                pass           # must finish; one failing cleanup must not
+                               # leak the rest
+
+    def elapsed_ms(self) -> int:
+        return int((time.monotonic() - self.t0) * 1000)
+
+
+class QueryRegistry:
+    """Process-wide running-query registry (the SHOW PROCESSLIST surface;
+    sessions of every front door share it, so a KILL from one connection
+    reaches a query running on another)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._running: dict = {}
+        self.last_kill_result = None  # documented no-op visibility (tests)
+
+    def register(self, ctx: QueryContext) -> QueryContext:
+        with self._lock:
+            ctx.qid = next(self._ids)
+            self._running[ctx.qid] = ctx
+        return ctx
+
+    def deregister(self, ctx: QueryContext):
+        with self._lock:
+            self._running.pop(ctx.qid, None)
+
+    def get(self, qid: int):
+        with self._lock:
+            return self._running.get(qid)
+
+    def cancel(self, qid: int, requester: str | None = None,
+               admin: bool = True, reason: str | None = None) -> bool:
+        """Deliver a kill. False = the query is not running (finished,
+        never existed) — the documented no-op. Non-admin requesters may
+        only kill their own queries."""
+        ctx = self.get(int(qid))
+        if ctx is None:
+            self.last_kill_result = "not-running"
+            return False
+        if requester is not None and not admin and ctx.user != requester:
+            raise PermissionError(
+                f"user {requester!r} cannot kill query {qid} owned by "
+                f"{ctx.user!r}")
+        ok = ctx.cancel(reason or f"KILL QUERY {qid}"
+                        + (f" by {requester!r}" if requester else ""))
+        self.last_kill_result = "delivered" if ok else "not-running"
+        return ok
+
+    def snapshot(self) -> list:
+        """[(qid, user, state, elapsed_ms, group, mem_bytes, stage, sql)]"""
+        with self._lock:
+            ctxs = list(self._running.values())
+        return [
+            (c.qid, c.user, c.state, c.elapsed_ms(), c.group or "",
+             c.mem_bytes, c.last_stage, c.sql[:512])
+            for c in sorted(ctxs, key=lambda c: c.qid)
+        ]
+
+
+class MemoryAccountant:
+    """Hierarchical (process -> resource group -> query) memory accounting
+    fed by real materialized-buffer sizes at stage boundaries. Charges are
+    cumulative per query and released wholesale when the query's scope
+    exits — so a before/after snapshot balancing to zero proves no leak."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.process_bytes = 0
+        self.group_bytes: dict = {}
+
+    def charge(self, ctx: QueryContext, nbytes: int, stage: str):
+        if nbytes <= 0 or ctx.state != "running":
+            return
+        with self._lock:
+            ctx.mem_bytes += nbytes
+            self.process_bytes += nbytes
+            if ctx.group:
+                self.group_bytes[ctx.group] = (
+                    self.group_bytes.get(ctx.group, 0) + nbytes)
+            group_used = self.group_bytes.get(ctx.group, 0) if ctx.group else 0
+            process_used = self.process_bytes
+        # enforcement outside the lock: the charge is already recorded, so
+        # the scope-exit release keeps the books balanced even on raise
+        if ctx.mem_limit and ctx.mem_bytes > ctx.mem_limit:
+            MEMLIMIT_TOTAL.inc()
+            raise MemLimitExceeded(
+                f"query {ctx.qid} exceeded query_mem_limit_bytes="
+                f"{ctx.mem_limit} at stage {stage!r} "
+                f"({ctx.mem_bytes} bytes materialized)")
+        if ctx.group_limit and group_used > ctx.group_limit:
+            MEMLIMIT_TOTAL.inc()
+            raise MemLimitExceeded(
+                f"query {ctx.qid} pushed resource group {ctx.group!r} over "
+                f"mem_limit_bytes={ctx.group_limit} at stage {stage!r} "
+                f"({group_used} bytes across the group)")
+        if ctx.process_limit and process_used > ctx.process_limit:
+            MEMLIMIT_TOTAL.inc()
+            raise MemLimitExceeded(
+                f"query {ctx.qid} pushed the process over "
+                f"process_mem_limit_bytes={ctx.process_limit} at stage "
+                f"{stage!r} ({process_used} bytes)")
+        if (ctx.mem_soft_limit and not ctx.degraded
+                and ctx.mem_bytes > ctx.mem_soft_limit):
+            ctx.degraded = True
+            ctx.degrade_reason = (
+                f"soft limit {ctx.mem_soft_limit} crossed at {stage!r}")
+            MEM_DEGRADED.inc()
+
+    def release_query(self, ctx: QueryContext):
+        with self._lock:
+            n = ctx.mem_bytes
+            ctx.mem_bytes = 0
+            self.process_bytes -= n
+            if ctx.group and ctx.group in self.group_bytes:
+                self.group_bytes[ctx.group] -= n
+                if self.group_bytes[ctx.group] <= 0:
+                    del self.group_bytes[ctx.group]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"process_bytes": self.process_bytes,
+                    "group_bytes": dict(self.group_bytes)}
+
+
+REGISTRY = QueryRegistry()
+ACCOUNTANT = MemoryAccountant()
+
+_tls = threading.local()
+
+
+def current() -> QueryContext | None:
+    """The thread's active query context (None outside a query scope)."""
+    return getattr(_tls, "ctx", None)
+
+
+def checkpoint(stage: str):
+    """Stage-boundary hook: no-op without an active context or with
+    nothing armed; raises the typed lifecycle errors otherwise."""
+    ctx = current()
+    if ctx is not None:
+        ctx.check(stage)
+
+
+def _nbytes(obj) -> int:
+    """Estimated bytes of a materialized buffer: device Chunk, HostTable,
+    numpy/jax array, or a tuple/list of those. Duck-typed so this module
+    never imports jax."""
+    n = getattr(obj, "nbytes", None)
+    if n is not None:
+        return int(n)
+    total = 0
+    arrays = getattr(obj, "arrays", None)  # HostTable
+    if isinstance(arrays, dict):
+        for a in arrays.values():
+            total += int(getattr(a, "nbytes", 0) or 0)
+        valids = getattr(obj, "valids", None)
+        if isinstance(valids, dict):
+            for v in valids.values():
+                total += int(getattr(v, "nbytes", 0) or 0)
+        return total
+    data = getattr(obj, "data", None)  # Chunk
+    if isinstance(data, tuple):
+        for a in data:
+            total += int(getattr(a, "nbytes", 0) or 0)
+        for v in getattr(obj, "valid", ()) or ():
+            total += int(getattr(v, "nbytes", 0) or 0)
+        return total
+    if isinstance(obj, (tuple, list)):
+        return sum(_nbytes(x) for x in obj)
+    return 0
+
+
+def account(obj, stage: str):
+    """Charge the active query for a materialized buffer (no-op outside a
+    scope). Raises MemLimitExceeded on hard-limit breach."""
+    ctx = current()
+    if ctx is None:
+        return
+    n = _nbytes(obj)
+    if n:
+        ACCOUNTANT.charge(ctx, n, stage)
+
+
+def degraded() -> bool:
+    """True when the active query crossed its soft memory limit: callers
+    degrade gracefully (decline cache admission, shrink batch capacity)."""
+    ctx = current()
+    return ctx is not None and ctx.degraded
+
+
+@contextlib.contextmanager
+def query_scope(sql: str, user: str = "root", group: str | None = None,
+                group_limit: int = 0):
+    """Enter a query lifecycle scope. Re-entrant: nested statements (MV
+    refresh bodies, INSERT..SELECT subqueries) ride the outer query's
+    context — its deadline and kill cover the whole statement tree."""
+    outer = current()
+    if outer is not None:
+        yield outer
+        return
+    ctx = REGISTRY.register(QueryContext(sql, user, group, group_limit))
+    _tls.ctx = ctx
+    try:
+        yield ctx
+        if ctx.state == "running":
+            ctx.state = "done"
+    except QueryCancelledError:
+        ctx.state = "cancelled"
+        QUERIES_CANCELLED.inc()
+        raise
+    except QueryTimeoutError:
+        ctx.state = "timeout"
+        QUERIES_TIMEOUT.inc()
+        raise
+    except MemLimitExceeded:
+        ctx.state = "memlimit"
+        raise
+    except BaseException:
+        ctx.state = "error"
+        raise
+    finally:
+        _tls.ctx = None
+        # guaranteed unwind, every exit path: cleanup stack (admission
+        # slots et al), then the accountant, then visibility
+        ctx.run_cleanups()
+        ACCOUNTANT.release_query(ctx)
+        REGISTRY.deregister(ctx)

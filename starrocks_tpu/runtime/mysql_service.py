@@ -342,15 +342,63 @@ class MySQLServer:
             finally:
                 self.session.current_user = prev
 
+    def _kill_bypass(self, conn: _Conn, sql: str, user: str) -> bool:
+        """KILL QUERY / SHOW PROCESSLIST handled WITHOUT the session lock:
+        the lock serializes queries, so a kill routed through it would
+        queue behind the very query it targets. The registry and auth
+        manager are thread-safe; nothing here touches session state.
+        Returns True when the statement was handled."""
+        from ..sql import ast as _ast
+        from ..sql.parser import parse as _parse
+        from .lifecycle import REGISTRY
+
+        try:
+            stmt = _parse(sql)
+        except Exception:  # noqa: BLE001  # lint: swallow-ok — not a
+            return False   # kill/processlist statement: normal path parses
+        if isinstance(stmt, _ast.KillQuery):
+            try:
+                ok = REGISTRY.cancel(
+                    stmt.query_id, requester=user,
+                    admin=self.session.auth().is_admin(user))
+            except PermissionError as e:
+                conn.send_err(1142, str(e), b"42000")
+                return True
+            conn.send_ok(info=(
+                b"cancel delivered" if ok else b"query not running; "
+                b"KILL is a no-op"))
+            return True
+        if isinstance(stmt, _ast.ShowProcesslist):
+            rows = REGISTRY.snapshot()
+            names = ("Id", "User", "State", "Time_ms", "Group",
+                     "Mem_bytes", "Stage", "Info")
+            types = (T.BIGINT, T.VARCHAR, T.VARCHAR, T.BIGINT, T.VARCHAR,
+                     T.BIGINT, T.VARCHAR, T.VARCHAR)
+            conn.send_packet(lenenc_int(len(names)))
+            for n, t in zip(names, types):
+                conn.send_column_def(n, t)
+            conn.send_eof()
+            for r in rows:
+                conn.send_packet(b"".join(_cell(v) for v in r))
+            conn.send_eof()
+            return True
+        return False
+
     def _query(self, conn: _Conn, sql: str, user: str):
+        from .failpoint import fail_point
+
         sql = sql.strip().rstrip(";")
-        # connector session boilerplate: accept silently
+        fail_point("mysql::query")
         low = sql.lower()
+        if low.startswith(("kill", "show")) and self._kill_bypass(
+                conn, sql, user):
+            return
+        # connector session boilerplate: accept silently
         if low.startswith(("set ", "commit", "rollback", "start transaction",
                            "use ")) and not low.startswith("set global"):
             try:
                 self._run_as(sql, user)
-            except Exception:
+            except Exception:  # lint: swallow-ok — connector boilerplate
                 pass  # unknown session vars from connectors are non-fatal
             conn.send_ok()
             return
@@ -359,7 +407,7 @@ class MySQLServer:
         except PermissionError as e:
             conn.send_err(1142, str(e), b"42000")
             return
-        except Exception as e:  # noqa: BLE001 — every engine error -> ERR
+        except Exception as e:  # noqa: BLE001  # lint: swallow-ok — every engine error -> ERR
             conn.send_err(1064, f"{type(e).__name__}: {e}", b"42000")
             return
         if res is None:
@@ -400,7 +448,7 @@ class MySQLServer:
         try:
             marks = [t.pos for t in tokenize(sql)
                      if t.kind == "op" and t.value == "?"]
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001  # lint: swallow-ok — ERR packet
             conn.send_err(1064, f"{type(e).__name__}: {e}", b"42000")
             return
         sid = next(stmt_ids)
@@ -431,7 +479,7 @@ class MySQLServer:
             params, types = self._decode_params(
                 arg, pos, len(marks), cached_types)
             entry[2] = types  # drivers send types only on the first execute
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001  # lint: swallow-ok — ERR packet
             conn.send_err(1064, f"bad parameter block: {e}")
             return
         final = self._splice(sql, marks, params)
@@ -440,7 +488,7 @@ class MySQLServer:
         except PermissionError as e:
             conn.send_err(1142, str(e), b"42000")
             return
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001  # lint: swallow-ok — ERR packet
             conn.send_err(1064, f"{type(e).__name__}: {e}", b"42000")
             return
         if res is None or isinstance(res, (str, int, list)):

@@ -212,7 +212,7 @@ class Session:
             self.catalog.mv_defs[n] = text
             try:
                 self._refresh_mv(n)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001  # lint: swallow-ok
                 # defining query no longer runs (e.g. base table dropped
                 # without dropping the MV): keep the definition visible and
                 # unmaterialized; queries against it fail with the real error
@@ -342,17 +342,33 @@ class Session:
         """Execute one statement. Top-level calls append to the catalog's
         query log (information_schema.query_log; reference analog: the FE
         audit log) — nested internal statements (MV refresh bodies,
-        INSERT..SELECT subqueries) don't double-log."""
+        INSERT..SELECT subqueries) don't double-log.
+
+        Every top-level statement runs inside a query lifecycle scope
+        (runtime/lifecycle.py): it is registered for KILL QUERY / SHOW
+        PROCESSLIST, carries the `query_timeout_s` deadline, feeds the
+        memory accountant, and unwinds admission slots + accounting on
+        every exit path. Nested statements ride the outer scope."""
         if getattr(self, "_in_sql", False):
             return self._sql_inner(text)
         import time as _time
 
+        from .lifecycle import query_scope
+
+        group_limit = 0
+        if self.resource_group:
+            g = self.workgroups().get(self.resource_group)
+            if g is not None:
+                group_limit = g.mem_limit_bytes
         self._in_sql = True
         t0 = _time.time()
         entry = {"user": self.current_user, "sql": text.strip(),
                  "state": "OK", "rows": 0, "ms": 0}
         try:
-            res = self._sql_inner(text)
+            with query_scope(text.strip(), user=self.current_user,
+                             group=self.resource_group,
+                             group_limit=group_limit):
+                res = self._sql_inner(text)
             if isinstance(res, QueryResult):
                 entry["rows"] = res.table.num_rows
             elif isinstance(res, int):
@@ -514,6 +530,26 @@ class Session:
             return self._show_partitions(stmt.table.lower())
         if isinstance(stmt, ast.AlterTable):
             return self._alter(stmt)
+        if isinstance(stmt, ast.KillQuery):
+            from .lifecycle import REGISTRY
+
+            a = self.auth()
+            ok = REGISTRY.cancel(stmt.query_id,
+                                 requester=self.current_user,
+                                 admin=a.is_admin(self.current_user))
+            return (f"query {stmt.query_id} cancel delivered (cooperative: "
+                    "takes effect at the next stage boundary)" if ok else
+                    f"query {stmt.query_id} is not running; "
+                    "KILL is a no-op")
+        if isinstance(stmt, ast.ShowProcesslist):
+            from .lifecycle import REGISTRY
+
+            return REGISTRY.snapshot()
+        if isinstance(stmt, ast.AdminSetFailpoint):
+            from . import failpoint
+
+            failpoint.set_from_sql(stmt.name, stmt.value)
+            return None
         if isinstance(stmt, ast.ShowProfile):
             # the reference's SHOW PROFILE: render the last query's
             # RuntimeProfile tree (qe/StmtExecutor profile surface)
@@ -665,7 +701,7 @@ class Session:
                              for tb in meta[0].tables}
                     self.catalog.mv_meta[name] = {"bases": bases,
                                                   "meta": meta}
-        except Exception:  # noqa: BLE001 — rewrite metadata is best-effort
+        except Exception:  # noqa: BLE001  # lint: swallow-ok — rewrite metadata is best-effort
             pass
         # cached optimized plans may have (not) rewritten against this MV
         # under the previous freshness state
@@ -733,7 +769,8 @@ class Session:
                                ast.CreateFunction, ast.DropFunction,
                                ast.CreateExternalTable,
                                ast.CreateResourceGroup,
-                               ast.DropResourceGroup)):
+                               ast.DropResourceGroup,
+                               ast.AdminSetFailpoint)):
             raise PermissionError(
                 f"user {user!r} lacks the admin privileges for DDL")
 
@@ -804,24 +841,29 @@ class Session:
         return a.show_grants(user)
 
     def _query(self, sel) -> QueryResult:
+        from . import lifecycle
         from .profile import RuntimeProfile
 
         profile = RuntimeProfile("query")
         with profile.timer("analyze"):
             plan = Analyzer(self.catalog).analyze(sel)
         self._check_select_privs(plan)
-        release = self._admit(plan)
-        try:
+        lifecycle.checkpoint("session::analyzed")
+        # admission() releases the slot on ANY exit path — including a KILL
+        # unwinding the lifecycle scope before this frame's finally runs
+        with self._admit(plan):
             return self._query_admitted(plan, profile)
-        finally:
-            release()
 
     def _admit(self, plan):
         """Resource-group admission (runtime/workgroup.py): estimate the
         query's scan mass from the catalog and pass the gate. Queries
-        without a SET resource_group run unthrottled (default group)."""
+        without a SET resource_group run unthrottled (default group).
+        Returns a context manager whose exit releases the slot on any
+        path (exception-safe; also registered on the query context)."""
         if self.resource_group is None:
-            return lambda: None
+            import contextlib
+
+            return contextlib.nullcontext()
         from ..sql.logical import LScan, walk_plan
 
         est_rows = est_bytes = 0
@@ -831,8 +873,8 @@ class Session:
                 if h is not None:
                     est_rows += h.row_count
                     est_bytes += h.row_count * 8 * max(len(node.columns), 1)
-        return self.workgroups().admit(self.resource_group, est_rows,
-                                       est_bytes)
+        return self.workgroups().admission(self.resource_group, est_rows,
+                                           est_bytes)
 
     def _query_admitted(self, plan, profile) -> QueryResult:
         if self.dist_shards:

@@ -23,16 +23,30 @@ admission (slots) and the big-query caps.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import time
 from typing import Optional
 
 from .config import config
+from .failpoint import fail_point
+from .metrics import metrics
 
 config.define("query_queue_timeout_s", 10.0, True,
               "seconds a query waits for a resource-group slot before "
               "failing admission (the FE slot-queue timeout analog)")
+
+ADMISSION_REJECTED = metrics.counter(
+    "sr_tpu_admission_rejected_total",
+    "queries rejected by big-query scan/memory caps")
+ADMISSION_TIMEOUT = metrics.counter(
+    "sr_tpu_admission_timeout_total",
+    "queries that timed out waiting for a resource-group slot")
+ADMISSION_RUNNING = metrics.gauge(
+    "sr_tpu_admission_running", "queries holding a resource-group slot")
+ADMISSION_QUEUED = metrics.gauge(
+    "sr_tpu_admission_queued", "queries queued for a resource-group slot")
 
 
 class AdmissionError(RuntimeError):
@@ -102,9 +116,13 @@ class WorkgroupManager:
     # --- admission -----------------------------------------------------------
     def admit(self, group_name: Optional[str], est_scan_rows: int = 0,
               est_scan_bytes: int = 0):
-        """Admission check for one query. Returns a zero-arg release
-        callable (always call it from a finally). Raises AdmissionError on
-        big-query rejection or slot-queue timeout."""
+        """Admission check for one query. Returns an IDEMPOTENT zero-arg
+        release callable — call it from a finally, and/or register it on
+        the query context's cleanup stack (`admission()` below packages
+        both). Raises AdmissionError on big-query rejection or slot-queue
+        timeout; a query KILLed while queued unblocks within ~100ms via
+        its lifecycle checkpoint."""
+        fail_point("workgroup::admit")
         if not group_name:
             return lambda: None
         g = self.get(group_name)
@@ -114,6 +132,7 @@ class WorkgroupManager:
         if g.max_scan_rows and est_scan_rows > g.max_scan_rows:
             with self._lock:
                 self.rejected_total += 1
+            ADMISSION_REJECTED.inc()
             raise AdmissionError(
                 f"query scans ~{est_scan_rows} rows, over resource group "
                 f"{g.name!r} big-query limit {g.max_scan_rows} "
@@ -121,32 +140,43 @@ class WorkgroupManager:
         if g.mem_limit_bytes and est_scan_bytes > g.mem_limit_bytes:
             with self._lock:
                 self.rejected_total += 1
+            ADMISSION_REJECTED.inc()
             raise AdmissionError(
                 f"query reads ~{est_scan_bytes} bytes, over resource group "
                 f"{g.name!r} memory limit {g.mem_limit_bytes}")
         if not g.concurrency_limit:
             return lambda: None
+        from . import lifecycle
+
         deadline = time.monotonic() + float(
             config.get("query_queue_timeout_s"))
         name = g.name
         with self._lock:
             self.queued[name] = self.queued.get(name, 0) + 1
+            ADMISSION_QUEUED.set(sum(self.queued.values()))
             try:
                 while self.running.get(name, 0) >= g.concurrency_limit:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0 or name not in self.groups:
                         if name in self.groups:
                             self.timeout_total += 1
+                            ADMISSION_TIMEOUT.inc()
                             raise AdmissionError(
                                 f"admission queue timeout: resource group "
                                 f"{name!r} held all "
                                 f"{g.concurrency_limit} slot(s) for "
                                 f"{config.get('query_queue_timeout_s')}s")
                         break  # group dropped while queued: run free
-                    self._lock.wait(timeout=remaining)
+                    # a KILL must not wait out the queue timeout: wake
+                    # periodically and let the checkpoint raise (the
+                    # condition variable has no cross-thread cancel signal)
+                    self._lock.wait(timeout=min(remaining, 0.1))
+                    lifecycle.checkpoint("workgroup::queued")
             finally:
                 self.queued[name] = self.queued.get(name, 1) - 1
+                ADMISSION_QUEUED.set(sum(self.queued.values()))
             self.running[name] = self.running.get(name, 0) + 1
+            ADMISSION_RUNNING.set(sum(self.running.values()))
 
         released = [False]
 
@@ -156,9 +186,29 @@ class WorkgroupManager:
                     released[0] = True
                     self.running[name] = max(
                         self.running.get(name, 1) - 1, 0)
+                    ADMISSION_RUNNING.set(sum(self.running.values()))
                     self._lock.notify_all()
 
         return release
+
+    @contextlib.contextmanager
+    def admission(self, group_name: Optional[str], est_scan_rows: int = 0,
+                  est_scan_bytes: int = 0):
+        """Exception-safe admission: the slot releases on ANY exit path,
+        including exits that never reach a caller's finally (the round-9
+        slot-leak class). Also registers the release on the active query
+        context so a KILL unwinding the scope releases it too — release is
+        idempotent, so double-calling is safe."""
+        release = self.admit(group_name, est_scan_rows, est_scan_bytes)
+        from . import lifecycle
+
+        ctx = lifecycle.current()
+        if ctx is not None:
+            ctx.on_exit(release)
+        try:
+            yield release
+        finally:
+            release()
 
     # --- introspection -------------------------------------------------------
     def snapshot(self):

@@ -330,3 +330,24 @@ class DropFunction:
 class CreateExternalTable:
     name: str
     location: str
+
+
+@dataclasses.dataclass(frozen=True)
+class KillQuery:
+    """KILL [QUERY] <id>: cooperative cancellation of a running query
+    (runtime/lifecycle.py registry)."""
+
+    query_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowProcesslist:
+    """SHOW [FULL] PROCESSLIST: the running-query registry."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AdminSetFailpoint:
+    """ADMIN SET failpoint '<name>' = 'enable[:times=N]'|'disable'."""
+
+    name: str
+    value: str

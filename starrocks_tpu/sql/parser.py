@@ -227,7 +227,42 @@ class Parser:
             self.accept_op(";")
             node = ast.Grant if verb == "grant" else ast.Revoke
             return node(tuple(privs), table, user)
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "kill"):
+            self.next()
+            if (self.peek().kind in ("ident", "kw")
+                    and self.peek().value.lower() in ("query", "connection")):
+                self.next()
+            t = self.next()
+            if t.kind != "number":
+                raise ParseError(
+                    f"expected a query id after KILL, got {t.value!r}")
+            self.accept_op(";")
+            return ast.KillQuery(int(t.value))
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "admin"):
+            self.next()
+            self.expect_kw("set")
+            word = self.expect_ident()
+            if word.lower() != "failpoint":
+                raise ParseError(
+                    f"unsupported ADMIN SET target {word!r} "
+                    "(only 'failpoint')")
+            t = self.next()
+            if t.kind != "string":
+                raise ParseError("expected a quoted failpoint name")
+            self.expect_op("=")
+            v = self.next()
+            if v.kind != "string":
+                raise ParseError("expected a quoted failpoint action")
+            self.accept_op(";")
+            return ast.AdminSetFailpoint(t.value, v.value)
         if self.accept_kw("show"):
+            if (self.peek().kind == "ident"
+                    and self.peek().value.lower() == "processlist"):
+                self.next()
+                self.accept_op(";")
+                return ast.ShowProcesslist()
             if (self.peek().kind == "ident"
                     and self.peek().value.lower() == "grants"):
                 self.next()
@@ -262,6 +297,11 @@ class Parser:
                 self.accept_op(";")
                 return ast.ShowResourceGroups()
             full = self.accept_kw("full")
+            if (self.peek().kind == "ident"
+                    and self.peek().value.lower() == "processlist"):
+                self.next()
+                self.accept_op(";")
+                return ast.ShowProcesslist()
             self.expect_kw("tables")
             self.accept_op(";")
             return ast.ShowTables(full)

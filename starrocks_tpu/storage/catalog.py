@@ -581,6 +581,34 @@ class Catalog:
                 ("column_name", T.VARCHAR, cn),
                 ("ndv", T.BIGINT, ndv),
             ])
+        if view in ("queries", "processlist"):
+            # the running-query registry (runtime/lifecycle.py): the SHOW
+            # PROCESSLIST / KILL QUERY id-discovery surface
+            from ..runtime.lifecycle import REGISTRY
+
+            rows = REGISTRY.snapshot()
+            return vtable([
+                ("query_id", T.BIGINT, [r[0] for r in rows]),
+                ("user", T.VARCHAR, [r[1] for r in rows]),
+                ("state", T.VARCHAR, [r[2] for r in rows]),
+                ("elapsed_ms", T.BIGINT, [r[3] for r in rows]),
+                ("resource_group", T.VARCHAR, [r[4] for r in rows]),
+                ("mem_bytes", T.BIGINT, [r[5] for r in rows]),
+                ("stage", T.VARCHAR, [r[6] for r in rows]),
+                ("statement", T.VARCHAR, [r[7] for r in rows]),
+            ])
+        if view == "fail_points":
+            # armed failpoints + lifetime hit counts (the chaos/ops
+            # surface of ADMIN SET failpoint; runtime/failpoint.py)
+            from ..runtime import failpoint as _fp
+
+            rows = _fp.snapshot()
+            return vtable([
+                ("name", T.VARCHAR, [r[0] for r in rows]),
+                ("armed", T.INT, [1 if r[1] else 0 for r in rows]),
+                ("times_remaining", T.BIGINT, [r[2] for r in rows]),
+                ("hits", T.BIGINT, [r[3] for r in rows]),
+            ])
         if view == "query_log":
             log = self.query_log[-1000:]
             return vtable([

@@ -27,6 +27,7 @@ import numpy as np
 from .. import types as T
 from ..column import Field, HostTable, Schema, StringDict
 from ..exprs.ir import Call, Col, Expr, InList, Lit
+from ..runtime.failpoint import fail_point
 
 
 def _type_to_json(t: T.LogicalType) -> dict:
@@ -123,6 +124,10 @@ class TabletStore:
 
     def log(self, op: dict) -> int:
         with self._journal_lock:
+            # injected failures here must release the journal lock (the
+            # with-block guarantees it) and leave the log un-torn: nothing
+            # is appended before this point
+            fail_point("journal::write")
             if self._next_seq is None:
                 self._next_seq = self._scan_seq()
             self.tail_count = (self.tail_count or 0) + 1
@@ -164,6 +169,7 @@ class TabletStore:
         the two leaves covered ops in the log, and replay of an
         already-applied catalog op is idempotent."""
         with self._journal_lock:
+            fail_point("journal::checkpoint")
             if self._next_seq is None:
                 self._next_seq = self._scan_seq()
             seq = self._next_seq
@@ -199,6 +205,7 @@ class TabletStore:
             return json.load(f)
 
     def _write_manifest(self, name: str, m: dict):
+        fail_point("store::manifest_write")
         tmp = self._manifest_path(name) + ".tmp"
         with open(tmp, "w") as f:
             json.dump(m, f, indent=1)
@@ -264,6 +271,7 @@ class TabletStore:
 
         from ..native import hash_partition_i64
 
+        fail_point("store::insert")
         m = self.read_manifest(name)
         nb = m["buckets"]
         bucket = self._bucket_of(m, data)
@@ -344,6 +352,7 @@ class TabletStore:
         mid-rewrite leaves either the old or the new state, never data loss."""
         import numpy as np
 
+        fail_point("store::rewrite")
         m = self.read_manifest(name)
         old_files = [
             f["file"] for rs in m["rowsets"] for f in rs["files"]
@@ -456,6 +465,7 @@ class TabletStore:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
+        fail_point("store::compact")
         m = self.read_manifest(name)
         if len(m["rowsets"]) <= 1 and not any(
             f.get("delvec") for rs in m["rowsets"] for f in rs["files"]
@@ -574,6 +584,7 @@ class TabletStore:
         O(delta) bytes written instead of rewriting the table
         (be/src/storage/tablet_updates.h:108 + del_vector.h). Within one
         batch, last write wins."""
+        fail_point("store::upsert")
         m = self.read_manifest(name)
         keys = [k for ks in m["unique_keys"] for k in ks]
         if not keys:
@@ -680,7 +691,10 @@ class TabletStore:
         import pyarrow.parquet as pq
 
         from ..runtime.config import config
+        from ..runtime import lifecycle
 
+        fail_point("scan::load_table")
+        lifecycle.checkpoint("scan::load_table")
         m = self.read_manifest(name)
         schema = schema_from_json(m["schema"])
         prune_enabled = config.get("enable_zonemap_pruning")

@@ -48,6 +48,14 @@ if "SR_TPU_PLAN_VERIFY_LEVEL" not in os.environ:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: failpoint/kill/timeout/mem-limit fault-injection scenarios "
+        "(tests/test_chaos.py; also run as a dedicated stage in "
+        "tools/run_tier1.sh)")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

@@ -13,5 +13,9 @@ python tools/src_lint.py || exit 1
 echo "== plan_lint --corpus =="
 timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/plan_lint.py --corpus || exit 1
 
+echo "== chaos suite (failpoint/KILL/timeout/mem-limit scenarios) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
+  -q -m chaos -p no:cacheprovider || exit 1
+
 echo "== tier-1 pytest =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST source lint for JAX pitfalls in starrocks_tpu/.
 
-Three rules, all for bug classes that pass every unit test and then burn
+Four rules, all for bug classes that pass every unit test and then burn
 on real hardware (or real traffic):
 
 R1 shard-map-shim: `shard_map` must be imported from parallel/mesh.py (the
@@ -27,6 +27,19 @@ R3 cache-key-knob: inside the query cache's key builders
    this rule pins the STATIC one, and the two meet at the declaration.
    Non-literal reads (`config.get(k) for k in OPT_KEY_KNOBS`) are the
    shared opt-key channel and stay legal.
+
+R4 swallowed-exception: in starrocks_tpu/runtime/, an `except Exception`
+   (or bare `except`) handler must re-raise, convert to a typed query
+   error (any `raise` in the handler body), or carry `# lint: swallow-ok`
+   on its `except` line. A silently swallowed exception in the runtime is
+   how admission slots leak, journals wedge half-written, and killed
+   queries report success — the failure classes tests/test_chaos.py
+   injects. Deliberate swallows (liveness loops, best-effort listeners)
+   stay legal via the tag, which doubles as documentation.
+
+The lint also counts `fail_point()` call sites across the package and
+fails below the chaos-suite floor (MIN_FAILPOINT_SITES): fault-injection
+coverage is an invariant here, not a nice-to-have.
 
 Exit 1 on any finding; each names file:line, the rule, and the offending op.
 """
@@ -173,6 +186,69 @@ class Linter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+RUNTIME_PREFIX = os.path.join("starrocks_tpu", "runtime") + os.sep
+MIN_FAILPOINT_SITES = 25
+
+
+def _is_exception_catch(handler: ast.ExceptHandler) -> bool:
+    """True for `except Exception` / bare `except` (incl. tuples holding
+    Exception). Narrow typed catches are R4-exempt: they name what they
+    swallow."""
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return "Exception" in names or "BaseException" in names
+
+
+def lint_runtime_swallow(path: str, rel: str, src: str, tree) -> list:
+    """R4: see module docstring."""
+    if not rel.startswith(RUNTIME_PREFIX):
+        return []
+    lines = src.splitlines()
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_exception_catch(node):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "lint: swallow-ok" in line:
+            continue
+        if any(isinstance(n, ast.Raise) for b in node.body
+               for n in ast.walk(b)):
+            continue  # re-raises or converts to a typed error
+        findings.append(
+            f"{rel}:{node.lineno}: [runtime-swallow] `except Exception` in "
+            f"runtime/ must re-raise, convert to a typed query error, or "
+            f"carry `# lint: swallow-ok` on the except line")
+    return findings
+
+
+def count_failpoints() -> int:
+    """Static count of fail_point(...) call sites across the package (the
+    chaos-coverage floor reported next to the findings)."""
+    n = 0
+    for root, _dirs, files in os.walk(PKG):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError:
+                    continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call) \
+                        and _call_name(node) == "fail_point":
+                    n += 1
+    return n
+
+
 CACHE_KEY_MODULE = os.path.join("starrocks_tpu", "cache", "keys.py")
 CONFIG_MODULE = os.path.join(PKG, "runtime", "config.py")
 
@@ -249,7 +325,7 @@ def lint_file(path: str) -> list:
     linter.collect(tree)
     for node in tree.body:
         linter.visit(node)
-    return linter.findings
+    return linter.findings + lint_runtime_swallow(path, rel, src, tree)
 
 
 def main():
@@ -259,9 +335,14 @@ def main():
             if fn.endswith(".py"):
                 findings += lint_file(os.path.join(root, fn))
     findings += lint_cache_keys()
+    n_fp = count_failpoints()
+    if n_fp < MIN_FAILPOINT_SITES:
+        findings.append(
+            f"starrocks_tpu/: [failpoint-floor] only {n_fp} fail_point() "
+            f"call sites; the chaos-suite floor is {MIN_FAILPOINT_SITES}")
     for f in findings:
         print(f)
-    print(f"src_lint: {len(findings)} finding(s)")
+    print(f"src_lint: {len(findings)} finding(s); failpoint_sites={n_fp}")
     return 1 if findings else 0
 
 
