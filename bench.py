@@ -352,6 +352,25 @@ def _entry_selected(name: str, only, skip) -> bool:
     return not only or any(matches(t) for t in only)
 
 
+def _concur_findings() -> int:
+    """Warn-level count from the static concurrency analyzers (the
+    unannotated-attr coverage ratchet of analysis/concur_check.py plus any
+    manifest warns) — tracked across rounds in the summary JSON so lock
+    annotation coverage only moves one way. -1 = analyzer crashed (never
+    fail a bench run over a lint)."""
+    try:
+        from starrocks_tpu.analysis import boundary_check, concur_check
+
+        sources = concur_check.astwalk.package_sources()
+        rep = concur_check.check_sources(sources)
+        bfindings = boundary_check.check_imports(
+            boundary_check.load_manifest(), sources)
+        return sum(1 for f in rep.findings + bfindings
+                   if f.severity == "warn")
+    except Exception:  # noqa: BLE001 — a lint bug must not kill the bench
+        return -1
+
+
 def run_suite(sf: float, repeats: int, probe_failed: bool = False,
               only=(), skip=(), qrepeat: int = 0):
     """All BASELINE.json config families.  Headline JSON line prints right
@@ -609,6 +628,7 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         "rf_segments_pruned": rf_totals.get("rf_segments_pruned", 0),
         "rf_bloom_bits": rf_totals.get("rf_bloom_bits", 0),
         "verify_findings": _sr_analysis.findings_total(),
+        "concur_findings": _concur_findings(),
         "qcancelled": chaos["qcancelled"],
         "qtimeout": chaos["qtimeout"],
         **({"qcache_repeat": qrepeat, **qcache_totals} if qrepeat > 1

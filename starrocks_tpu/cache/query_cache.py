@@ -24,9 +24,9 @@ counters.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from collections import OrderedDict
 
+from .. import lockdep
 from ..runtime.config import config
 from ..runtime.failpoint import fail_point
 from ..runtime.metrics import metrics
@@ -85,10 +85,10 @@ class QueryCache:
     the shared catalog's data epochs."""
 
     def __init__(self):
-        self._entries: OrderedDict = OrderedDict()
-        self._bytes = 0
-        self._lock = threading.RLock()
-        self.evictions = 0
+        self._lock = lockdep.rlock("QueryCache._lock")
+        self._entries: OrderedDict = OrderedDict()  # guarded_by: _lock
+        self._bytes = 0                             # guarded_by: _lock
+        self.evictions = 0                          # guarded_by: _lock
 
     # --- full-result tier ----------------------------------------------------
     def lookup_result(self, skey, catalog):
@@ -162,9 +162,10 @@ class QueryCache:
     # --- accounting -----------------------------------------------------------
     @property
     def resident_bytes(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
-    def _put(self, k, e):
+    def _put(self, k, e):  # lint: holds _lock
         old = self._entries.pop(k, None)
         if old is not None:
             self._bytes -= old.nbytes
@@ -178,7 +179,7 @@ class QueryCache:
             QCACHE_EVICTIONS.inc()
         QCACHE_BYTES.set(self._bytes)
 
-    def _drop(self, k):
+    def _drop(self, k):  # lint: holds _lock
         e = self._entries.pop(k, None)
         if e is not None:
             self._bytes -= e.nbytes

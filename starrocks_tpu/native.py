@@ -11,14 +11,17 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from . import lockdep
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libsr_native.so")
 
-_lock = threading.Lock()
+# module-level build lock: guards the one-shot lazy make + dlopen (_lib /
+# _tried are written only inside _load's with-block)
+_lock = lockdep.lock("native._lock")
 _lib = None
 _tried = False
 

@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from .. import lockdep
+
 ALIVE = "ALIVE"
 DEAD = "DEAD"
 
@@ -82,9 +84,9 @@ class ClusterMonitor:
         self.interval_s = interval_s
         self.miss_limit = miss_limit
         self.on_failure = on_failure
-        self._lock = threading.Lock()
-        self._beats: dict = {}   # id -> last beat monotonic
-        self._state: dict = {}   # id -> ALIVE | DEAD
+        self._lock = lockdep.lock("ClusterMonitor._lock")
+        self._beats: dict = {}   # guarded_by: _lock — id -> last beat
+        self._state: dict = {}   # guarded_by: _lock — id -> ALIVE | DEAD
         mon = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -120,7 +122,7 @@ class ClusterMonitor:
         self._srv = http.server.ThreadingHTTPServer((bind_host, port),
                                                     Handler)
         self.port = self._srv.server_address[1]
-        self._threads = [
+        self._threads = [  # lint: unguarded-ok — built once, never mutated
             threading.Thread(target=self._srv.serve_forever, daemon=True),
             threading.Thread(target=self._watchdog, daemon=True),
         ]

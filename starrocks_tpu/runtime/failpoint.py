@@ -9,8 +9,9 @@ paths and raises / calls the injected action when armed. Tests use
 
 from __future__ import annotations
 
-import threading
 from contextlib import contextmanager
+
+from .. import lockdep
 
 
 class FailPointError(RuntimeError):
@@ -19,9 +20,9 @@ class FailPointError(RuntimeError):
 
 class _Registry:
     def __init__(self):
-        self._armed: dict = {}
-        self._hits: dict = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("failpoint._Registry._lock")
+        self._armed: dict = {}  # guarded_by: _lock
+        self._hits: dict = {}   # guarded_by: _lock
 
     def arm(self, name: str, action=None, times: int | None = None):
         """action: None -> raise FailPointError; callable -> invoked."""
@@ -47,10 +48,12 @@ class _Registry:
         ent["action"]()
 
     def hits(self, name: str) -> int:
-        return self._hits.get(name, 0)
+        with self._lock:
+            return self._hits.get(name, 0)
 
     def list(self):
-        return sorted(self._armed)
+        with self._lock:
+            return sorted(self._armed)
 
     def snapshot(self):
         """[(name, armed, times_remaining, hits)] over every failpoint ever

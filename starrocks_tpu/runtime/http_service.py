@@ -20,6 +20,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import lockdep
 from .metrics import metrics
 from .session import Session
 
@@ -157,11 +158,12 @@ def make_handler(session: Session, lock: threading.Lock):
 class SqlHttpServer:
     def __init__(self, session: Session, host: str = "127.0.0.1", port: int = 0):
         self.session = session
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("SqlHttpServer._lock")
         self.httpd = ThreadingHTTPServer(
             (host, port), make_handler(session, self._lock)
         )
         self.port = self.httpd.server_address[1]
+        # lint: unguarded-ok — written once by the owner thread in start()
         self._thread: threading.Thread | None = None
 
     def start(self):

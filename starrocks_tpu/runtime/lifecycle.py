@@ -40,6 +40,7 @@ import itertools
 import threading
 import time
 
+from .. import lockdep
 from .metrics import metrics
 
 QUERIES_CANCELLED = metrics.counter(
@@ -150,10 +151,13 @@ class QueryRegistry:
     reaches a query running on another)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._ids = itertools.count(1)
-        self._running: dict = {}
-        self.last_kill_result = None  # documented no-op visibility (tests)
+        self._lock = lockdep.lock("QueryRegistry._lock")
+        self._ids = itertools.count(1)   # guarded_by: _lock
+        self._running: dict = {}         # guarded_by: _lock
+        # documented no-op visibility (tests): cross-thread shared state —
+        # a KILL lands from any connection's thread — so it lives under
+        # the registry lock like the running set it describes
+        self.last_kill_result = None     # guarded_by: _lock
 
     def register(self, ctx: QueryContext) -> QueryContext:
         with self._lock:
@@ -176,7 +180,8 @@ class QueryRegistry:
         only kill their own queries."""
         ctx = self.get(int(qid))
         if ctx is None:
-            self.last_kill_result = "not-running"
+            with self._lock:
+                self.last_kill_result = "not-running"
             return False
         if requester is not None and not admin and ctx.user != requester:
             raise PermissionError(
@@ -184,8 +189,14 @@ class QueryRegistry:
                 f"{ctx.user!r}")
         ok = ctx.cancel(reason or f"KILL QUERY {qid}"
                         + (f" by {requester!r}" if requester else ""))
-        self.last_kill_result = "delivered" if ok else "not-running"
+        with self._lock:
+            self.last_kill_result = "delivered" if ok else "not-running"
         return ok
+
+    def kill_result(self):
+        """Read `last_kill_result` under the lock (tests; SHOW surfaces)."""
+        with self._lock:
+            return self.last_kill_result
 
     def snapshot(self) -> list:
         """[(qid, user, state, elapsed_ms, group, mem_bytes, stage, sql)]"""
@@ -205,9 +216,9 @@ class MemoryAccountant:
     exits — so a before/after snapshot balancing to zero proves no leak."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self.process_bytes = 0
-        self.group_bytes: dict = {}
+        self._lock = lockdep.lock("MemoryAccountant._lock")
+        self.process_bytes = 0        # guarded_by: _lock
+        self.group_bytes: dict = {}   # guarded_by: _lock
 
     def charge(self, ctx: QueryContext, nbytes: int, stage: str):
         if nbytes <= 0 or ctx.state != "running":

@@ -4,19 +4,27 @@ Reference behavior: be/src/base/metrics.h:354 (MetricRegistry + typed
 counters/gauges, Prometheus endpoint http/action/metrics_action.h) and FE
 MetricRepo.java:120. Process-wide registry; the HTTP surface can serve
 `render_prometheus()` verbatim.
+
+Lock discipline (analysis/concur_check.py enforces the annotations): the
+registry's get-or-create is the classic two-threads-mint-two-instances
+race — both see the miss, both construct, and increments split across
+divergent Counter objects (one of which the registry then forgets). All
+`_metrics` access happens under `_lock`; per-metric `_v` is guarded by
+the metric's own `_lock`, including reads via `value`, so a scrape never
+sees a torn read ordering against `inc`.
 """
 
 from __future__ import annotations
 
-import threading
+from .. import lockdep
 
 
 class Counter:
     def __init__(self, name, help_=""):
         self.name = name
         self.help = help_
-        self._v = 0
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("Counter._lock")
+        self._v = 0  # guarded_by: _lock
 
     def inc(self, n=1):
         with self._lock:
@@ -24,7 +32,8 @@ class Counter:
 
     @property
     def value(self):
-        return self._v
+        with self._lock:
+            return self._v
 
 
 class Gauge(Counter):
@@ -35,20 +44,31 @@ class Gauge(Counter):
 
 class MetricRegistry:
     def __init__(self):
-        self._metrics: dict = {}
+        self._lock = lockdep.lock("MetricRegistry._lock")
+        self._metrics: dict = {}  # guarded_by: _lock
+
+    def _get_or_create(self, name: str, cls, help_: str):
+        # one atomic get-or-create: two threads registering the same name
+        # concurrently must receive the SAME instance (the unlocked
+        # setdefault constructed a throwaway instance per caller, and a
+        # plain get/insert pair could publish two)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help_)
+            return m
 
     def counter(self, name: str, help_: str = "") -> Counter:
-        return self._metrics.setdefault(name, Counter(name, help_))
+        return self._get_or_create(name, Counter, help_)
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
-        m = self._metrics.get(name)
-        if m is None:
-            m = self._metrics[name] = Gauge(name, help_)
-        return m
+        return self._get_or_create(name, Gauge, help_)
 
     def render_prometheus(self) -> str:
+        with self._lock:
+            items = sorted(self._metrics.items())
         out = []
-        for name, m in sorted(self._metrics.items()):
+        for name, m in items:  # m.value takes the metric's own lock
             kind = "gauge" if isinstance(m, Gauge) else "counter"
             if m.help:
                 out.append(f"# HELP {name} {m.help}")

@@ -36,6 +36,7 @@ import socketserver
 import struct
 import threading
 
+from .. import lockdep
 from .. import types as T
 from .session import Session
 
@@ -214,7 +215,9 @@ class MySQLServer:
     def __init__(self, session: Session, host="127.0.0.1", port=9030,
                  lock: threading.Lock | None = None):
         self.session = session
-        self.lock = lock or threading.Lock()
+        # the big session lock: one statement at a time over the shared
+        # Session (KILL bypasses it by design — see lifecycle docstring)
+        self.lock = lock or lockdep.lock("MySQLServer.lock")
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):

@@ -25,10 +25,10 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import threading
 import time
 from typing import Optional
 
+from .. import lockdep
 from .config import config
 from .failpoint import fail_point
 from .metrics import metrics
@@ -79,12 +79,14 @@ class WorkgroupManager:
     """Process-wide admission gate (one per catalog = one per 'BE')."""
 
     def __init__(self):
-        self._lock = threading.Condition()
-        self.groups: dict[str, ResourceGroup] = {}
-        self.running: dict[str, int] = {}
-        self.queued: dict[str, int] = {}
-        self.rejected_total = 0
-        self.timeout_total = 0
+        # a Condition (queued queries wait on it for a freed slot); its
+        # underlying mutex guards every mutable field below
+        self._lock = lockdep.condition("WorkgroupManager._lock")
+        self.groups: dict[str, ResourceGroup] = {}  # guarded_by: _lock
+        self.running: dict[str, int] = {}           # guarded_by: _lock
+        self.queued: dict[str, int] = {}            # guarded_by: _lock
+        self.rejected_total = 0                     # guarded_by: _lock
+        self.timeout_total = 0                      # guarded_by: _lock
 
     # --- DDL -----------------------------------------------------------------
     def create(self, name: str, props: dict, replace: bool = False):
@@ -111,7 +113,8 @@ class WorkgroupManager:
             self._lock.notify_all()
 
     def get(self, name: str) -> Optional[ResourceGroup]:
-        return self.groups.get(name.lower())
+        with self._lock:  # Condition's mutex is reentrant: safe from admit
+            return self.groups.get(name.lower())
 
     # --- admission -----------------------------------------------------------
     def admit(self, group_name: Optional[str], est_scan_rows: int = 0,

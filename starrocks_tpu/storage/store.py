@@ -19,11 +19,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import threading
 from typing import Optional
 
 import numpy as np
 
+from .. import lockdep
 from .. import types as T
 from ..column import Field, HostTable, Schema, StringDict
 from ..exprs.ir import Call, Col, Expr, InList, Lit
@@ -72,15 +72,17 @@ class TabletStore:
         self.log_path = os.path.join(root, "edit_log.jsonl")
         self.image_path = os.path.join(root, "image.json")
         self._pk_index: dict = {}  # table -> {pk tuple: (rowset, file, pos)}
-        self._next_seq = None  # lazily scanned (image seq + log tail)
-        self.tail_count = None  # ops past the image (auto-checkpoint trigger)
         # serializes log() appends against checkpoint()'s snapshot+replace:
         # sessions share one TabletStore and auto-checkpoint fires during
         # statement logging, so an unguarded append between the tail
         # snapshot and os.replace would land on the replaced inode and
         # vanish from the journal (appends are short, checkpoints rare —
         # one lock is cheaper than being right about interleavings)
-        self._journal_lock = threading.RLock()
+        self._journal_lock = lockdep.rlock("TabletStore._journal_lock")
+        # lazily scanned (image seq + log tail)
+        self._next_seq = None   # guarded_by: _journal_lock
+        # ops past the image (auto-checkpoint trigger)
+        self.tail_count = None  # guarded_by: _journal_lock
         # mutation listeners: fn(table, op) fired after every storage-level
         # write (insert/upsert/rewrite/alter/compact/drop). Sessions wire
         # these to catalog data-epoch bumps + cache invalidation so DIRECT
@@ -105,7 +107,7 @@ class TabletStore:
     # checkpoint() snapshots catalog-level metadata into image.json and
     # truncates the log to the ops after the image, so startup replays
     # image + tail instead of the whole history.
-    def _scan_seq(self) -> int:
+    def _scan_seq(self) -> int:  # lint: holds _journal_lock
         img = self.read_image()
         base = img["seq"] if img else 0
         seq = base
@@ -119,8 +121,9 @@ class TabletStore:
 
     def ensure_seq(self):
         """Force the lazy journal scan (startup paths want tail_count)."""
-        if self._next_seq is None:
-            self._next_seq = self._scan_seq()
+        with self._journal_lock:
+            if self._next_seq is None:
+                self._next_seq = self._scan_seq()
 
     def log(self, op: dict) -> int:
         with self._journal_lock:

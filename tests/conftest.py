@@ -14,6 +14,14 @@ and widens the host platform to 8 virtual devices.
 
 import os
 
+# Lock-witness (starrocks_tpu/lockdep.py): run every factory-created lock
+# through DebugLock for the whole tier-1 + chaos run, recording the global
+# lock-ORDER graph; the session-teardown fixture below fails the run on a
+# cycle. Must be set before the FIRST starrocks_tpu import — module-level
+# singletons (metrics registry, failpoint registry, query registry) create
+# their locks at import time. SR_TPU_LOCK_WITNESS=0 opts out.
+os.environ.setdefault("SR_TPU_LOCK_WITNESS", "1")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -54,6 +62,23 @@ def pytest_configure(config):
         "chaos: failpoint/kill/timeout/mem-limit fault-injection scenarios "
         "(tests/test_chaos.py; also run as a dedicated stage in "
         "tools/run_tier1.sh)")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def lock_witness_gate():
+    """Teardown gate of the runtime lock-witness: after the whole session
+    (647 tests' worth of real interleavings) the global lock-order graph
+    must be acyclic — a cycle means two threads CAN deadlock, and the
+    report carries both acquisition stacks. Tests that deliberately seed
+    inversions use private lockdep.Witness instances, so this graph stays
+    clean by construction."""
+    from starrocks_tpu import lockdep
+
+    yield
+    cycles = lockdep.WITNESS.order_cycles()
+    assert not cycles, (
+        "runtime lock-witness found lock-order cycle(s):\n"
+        + lockdep.WITNESS.render(cycles))
 
 
 @pytest.fixture(scope="session")

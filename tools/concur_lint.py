@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Concurrency-contract gate: static lock/guard analysis + module-boundary
+manifest enforcement over starrocks_tpu/.
+
+Runs ahead of pytest in tools/run_tier1.sh (next to src_lint/plan_lint):
+
+- analysis/concur_check.py — lock inventory, the cross-object
+  lock-acquisition graph (lock-order cycles = potential deadlocks,
+  lexical self-nesting of non-reentrant locks = certain deadlocks), and
+  the `# guarded_by:` field discipline, strict: any error finding fails
+  the gate. Warn findings (the unannotated-mutable-attr coverage ratchet)
+  print and count but do not fail — bench.py tracks the count across
+  rounds as `concur_findings`; use --strict-warn to ratchet hard.
+
+- analysis/boundary_check.py — the repo-root module_boundary_manifest.json
+  (the reference's be/module_boundary_manifest.json analog): every
+  package-internal import must match its unit's declared allow/forbid
+  prefixes; undeclared coupling fails.
+
+The checkers are loaded by FILE PATH (not package import): the gate must
+run on a box with no jax install, and starrocks_tpu/__init__.py pulls
+jax. They share one parsed AST per module (analysis/astwalk.py) — the
+same trees src_lint walks.
+
+Exit 1 on any error finding; prints `concur_lint: ...` summary with the
+counts the driver and bench read.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name: str, rel: str):
+    existing = sys.modules.get(name)
+    if existing is not None:
+        return existing
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, rel))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run(strict_warn: bool = False) -> int:
+    astwalk = _load("sr_astwalk", "starrocks_tpu/analysis/astwalk.py")
+    concur_check = _load("sr_concur_check",
+                         "starrocks_tpu/analysis/concur_check.py")
+    boundary_check = _load("sr_boundary_check",
+                           "starrocks_tpu/analysis/boundary_check.py")
+
+    sources = astwalk.package_sources(REPO)
+    rep = concur_check.check_sources(sources)
+    bfindings = boundary_check.check_imports(
+        boundary_check.load_manifest(REPO), sources)
+
+    findings = rep.findings + bfindings
+    errors = [f for f in findings if f.severity == "error"]
+    warns = [f for f in findings if f.severity == "warn"]
+    for f in findings:
+        print(f)
+    st = rep.stats
+    print(f"concur_lint: {len(errors)} error(s), {len(warns)} warn(s); "
+          f"locks={st['locks']} guarded_attrs={st['guarded_attrs']} "
+          f"order_edges={st['edges']} modules={len(sources)}")
+    if errors or (strict_warn and warns):
+        return 1
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="static lock-order + guarded-by + module-boundary gate")
+    ap.add_argument("--strict-warn", action="store_true",
+                    help="fail on warn-level findings too (the coverage "
+                         "ratchet, once annotations reach 100%%)")
+    args = ap.parse_args()
+    return run(strict_warn=args.strict_warn)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

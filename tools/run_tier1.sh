@@ -10,6 +10,9 @@ cd "$(dirname "$0")/.." || exit 1
 echo "== src_lint =="
 python tools/src_lint.py || exit 1
 
+echo "== concur_lint (lock order + guarded-by + module boundaries) =="
+python tools/concur_lint.py || exit 1
+
 echo "== plan_lint --corpus =="
 timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/plan_lint.py --corpus || exit 1
 

@@ -47,12 +47,30 @@ Exit 1 on any finding; each names file:line, the rule, and the offending op.
 from __future__ import annotations
 
 import ast
+import importlib.util
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "starrocks_tpu")
 SHIM = os.path.join("starrocks_tpu", "parallel", "mesh.py")
+
+
+def _astwalk():
+    """The shared AST walk (analysis/astwalk.py): every static gate —
+    src_lint, concur_lint — reads the SAME parsed tree per module instead
+    of re-parsing the package per checker. Loaded by file path: importing
+    the starrocks_tpu package would pull jax, and this lint must run on a
+    bare checkout."""
+    mod = sys.modules.get("sr_astwalk")
+    if mod is not None:
+        return mod
+    spec = importlib.util.spec_from_file_location(
+        "sr_astwalk", os.path.join(PKG, "analysis", "astwalk.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["sr_astwalk"] = mod
+    spec.loader.exec_module(mod)
+    return mod
 
 CALLBACK_FNS = {"pure_callback", "io_callback", "debug_callback"}
 TRACE_BUILDERS = {"compile_plan": {"run"}, "compile_distributed": {"step"}}
@@ -229,23 +247,15 @@ def lint_runtime_swallow(path: str, rel: str, src: str, tree) -> list:
     return findings
 
 
-def count_failpoints() -> int:
+def count_failpoints(sources) -> int:
     """Static count of fail_point(...) call sites across the package (the
     chaos-coverage floor reported next to the findings)."""
     n = 0
-    for root, _dirs, files in os.walk(PKG):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            with open(os.path.join(root, fn)) as f:
-                try:
-                    tree = ast.parse(f.read())
-                except SyntaxError:
-                    continue
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Call) \
-                        and _call_name(node) == "fail_point":
-                    n += 1
+    for ms in sources:
+        for node in ast.walk(ms.tree):
+            if isinstance(node, ast.Call) \
+                    and _call_name(node) == "fail_point":
+                n += 1
     return n
 
 
@@ -313,29 +323,27 @@ def lint_cache_keys() -> list:
     return findings
 
 
-def lint_file(path: str) -> list:
-    rel = os.path.relpath(path, REPO)
-    with open(path) as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [f"{rel}:{e.lineno}: [parse] {e.msg}"]
-    linter = Linter(path, rel, src)
-    linter.collect(tree)
-    for node in tree.body:
+def lint_module(ms) -> list:
+    linter = Linter(ms.path, ms.rel, ms.src)
+    linter.collect(ms.tree)
+    for node in ms.tree.body:
         linter.visit(node)
-    return linter.findings + lint_runtime_swallow(path, rel, src, tree)
+    return linter.findings + lint_runtime_swallow(
+        ms.path, ms.rel, ms.src, ms.tree)
 
 
 def main():
+    try:
+        sources = _astwalk().package_sources(REPO)
+    except SyntaxError as e:
+        print(f"{e.filename}:{e.lineno}: [parse] {e.msg}")
+        print("src_lint: 1 finding(s); failpoint_sites=?")
+        return 1
     findings = []
-    for root, _dirs, files in os.walk(PKG):
-        for fn in sorted(files):
-            if fn.endswith(".py"):
-                findings += lint_file(os.path.join(root, fn))
+    for ms in sources:
+        findings += lint_module(ms)
     findings += lint_cache_keys()
-    n_fp = count_failpoints()
+    n_fp = count_failpoints(sources)
     if n_fp < MIN_FAILPOINT_SITES:
         findings.append(
             f"starrocks_tpu/: [failpoint-floor] only {n_fp} fail_point() "
