@@ -615,6 +615,32 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
             detail["tpu_forensics"] = {"error": f"{type(e).__name__}: {e}"}
             flush_detail()
 
+    # Serving-tier snapshot: a SHORT mixed-workload serve_bench run (8
+    # wire clients, 2s cold + 2s warm) feeds the summary's concurrency
+    # trajectory (tools/serve_bench.py is the full harness).
+    serve: dict = {}
+    try:
+        if _remaining_s() > 90:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            from serve_bench import run_serve_bench
+
+            sres = run_serve_bench(threads=8, seconds=2.0, sf=0.01, pool=4,
+                                   single_thread_ab=False, warm=True)
+            detail["serve"] = sres
+            flush_detail()
+            serve = {
+                "serve_qps": sres["cold"]["qps"],
+                "serve_p50_ms": sres["cold"]["p50_ms"],
+                "serve_p99_ms": sres["cold"]["p99_ms"],
+                "queue_wait_ms": sres["cold"]["queue_wait_ms"],
+                "serve_warm_p50_ms": sres.get("warm", {}).get("p50_ms", 0),
+                "serve_fast_path_rate": sres.get(
+                    "warm", {}).get("fast_path_rate", 0),
+            }
+    except Exception as e:  # noqa: BLE001 — the bench line must print
+        serve = {"serve_error": f"{type(e).__name__}: {e}"}
+
     # Enriched final line: same metric/value as the headline (either line
     # satisfies the driver), plus the suite geomean and runtime-filter
     # pruning totals (rf_rows_pruned / rf_segments_pruned / rf_bloom_bits).
@@ -633,6 +659,7 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         "qtimeout": chaos["qtimeout"],
         **({"qcache_repeat": qrepeat, **qcache_totals} if qrepeat > 1
            else {}),
+        **serve,
     }))
 
 

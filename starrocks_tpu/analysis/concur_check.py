@@ -543,18 +543,83 @@ class _Analyzer:
             for name in mi.functions:
                 self._may_acquire(("func", mi.ms.dotted, name))
 
-    def _lock_node_of_expr(self, mi, ci, expr):
+    def _local_instances(self, mi, fn) -> dict:
+        """Local name -> class qual for `name = <constructor-or-factory>()`
+        bindings inside one function: `c = reg.counter(...)` (the known-
+        factory table), `q = QueryCache()`, and chains through earlier
+        locals — iterated to a small fixpoint so `reg = MetricRegistry();
+        c = reg.counter(...)` resolves both hops. Re-bindings keep the
+        FIRST resolution (an under-approximation, the safe direction)."""
+        out: dict = {}
+        for _ in range(3):
+            changed = False
+            for sub in ast.walk(fn):
+                if not (isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)
+                        and isinstance(sub.value, ast.Call)):
+                    continue
+                name = sub.targets[0].id
+                if name in out:
+                    continue
+                qual = self._call_instance_class(mi, sub.value, out)
+                if qual is not None:
+                    out[name] = qual
+                    changed = True
+            if not changed:
+                break
+        return out
+
+    def _call_instance_class(self, mi, call, local_insts: dict):
+        """Class qual a call expression constructs, resolving the callee
+        through module names, module-level instances, AND function locals
+        (`local_insts`) for the known factory methods."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            r = self.idx.resolve(mi.ms.dotted, f.id)
+            if r and r[0] == "class":
+                return r[1].qual
+            return None
+        if not (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)):
+            return None
+        base = f.value.id
+        owner_qual = local_insts.get(base)
+        if owner_qual is None:
+            r = self.idx.resolve(mi.ms.dotted, base)
+            if r and r[0] == "instance":
+                owner_qual = r[1]
+            elif r and r[0] == "module":
+                r2 = self.idx.resolve(r[1], f.attr)
+                if r2 and r2[0] == "class":
+                    return r2[1].qual
+        if owner_qual is None:
+            return None
+        owner = self.idx.class_by_qual.get(owner_qual)
+        if owner is None:
+            return None
+        ret = _FACTORY_RETURNS.get((owner.name, f.attr))
+        if ret and ret in self.idx.modules[owner.mod].classes:
+            return self.idx.modules[owner.mod].classes[ret].qual
+        return None
+
+    def _lock_node_of_expr(self, mi, ci, expr, local_insts=None):
         """lock node id ("qual._attr", kind) for a with-context expr, or
-        None: self._lock / INSTANCE._lock / mod.INSTANCE._lock."""
+        None: self._lock / INSTANCE._lock / mod.INSTANCE._lock /
+        factory-bound LOCAL._lock."""
         if not isinstance(expr, ast.Attribute):
             return None
         owner = None
         if _is_self(expr.value) and ci is not None:
             owner = ci
         elif isinstance(expr.value, ast.Name):
-            r = self.idx.resolve(mi.ms.dotted, expr.value.id)
-            if r and r[0] == "instance":
-                owner = self.idx.class_by_qual.get(r[1])
+            if local_insts and expr.value.id in local_insts:
+                owner = self.idx.class_by_qual.get(
+                    local_insts[expr.value.id])
+            else:
+                r = self.idx.resolve(mi.ms.dotted, expr.value.id)
+                if r and r[0] == "instance":
+                    owner = self.idx.class_by_qual.get(r[1])
         elif (isinstance(expr.value, ast.Attribute)
               and isinstance(expr.value.value, ast.Name)):
             r = self.idx.resolve(mi.ms.dotted, expr.value.value.id)
@@ -570,7 +635,7 @@ class _Analyzer:
         kind, defining = locks[expr.attr]
         return (f"{defining}.{expr.attr}", kind)
 
-    def _resolve_call(self, mi, ci, call):
+    def _resolve_call(self, mi, ci, call, local_insts=None):
         """-> list of callable keys this call may enter."""
         f = call.func
         out = []
@@ -588,13 +653,16 @@ class _Analyzer:
             if _is_self(v) and ci is not None:
                 target_ci = ci
             elif isinstance(v, ast.Name):
-                r = self.idx.resolve(mi.ms.dotted, v.id)
-                if r and r[0] == "instance":
-                    target_ci = self.idx.class_by_qual.get(r[1])
-                elif r and r[0] == "module":
-                    r2 = self.idx.resolve(r[1], f.attr)
-                    if r2 and r2[0] == "func":
-                        out.append(("func", r2[1], r2[2]))
+                if local_insts and v.id in local_insts:
+                    target_ci = self.idx.class_by_qual.get(local_insts[v.id])
+                else:
+                    r = self.idx.resolve(mi.ms.dotted, v.id)
+                    if r and r[0] == "instance":
+                        target_ci = self.idx.class_by_qual.get(r[1])
+                    elif r and r[0] == "module":
+                        r2 = self.idx.resolve(r[1], f.attr)
+                        if r2 and r2[0] == "func":
+                            out.append(("func", r2[1], r2[2]))
             elif isinstance(v, ast.Attribute) and isinstance(v.value,
                                                              ast.Name):
                 r = self.idx.resolve(mi.ms.dotted, v.value.id)
@@ -630,6 +698,9 @@ class _Analyzer:
         stack = _stack | {key}
         acquired: set = set()
         ms = mi.ms
+        # locals bound from constructors / known factories (c =
+        # reg.counter(...)) participate in call + lock-expr resolution
+        local_insts = self._local_instances(mi, fn)
         locks = self.idx.all_locks(ci) if ci is not None else {}
         held0 = set()
         for h in _parse_holds(ms.line(fn.lineno)):
@@ -664,7 +735,8 @@ class _Analyzer:
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 acq = []
                 for item in node.items:
-                    ln = self._lock_node_of_expr(mi, ci, item.context_expr)
+                    ln = self._lock_node_of_expr(mi, ci, item.context_expr,
+                                                 local_insts)
                     if ln is not None:
                         for h in held:
                             add_edge(h, ln, node.lineno, direct=True)
@@ -675,7 +747,7 @@ class _Analyzer:
                     visit(child, held | set(acq))
                 return
             if isinstance(node, ast.Call):
-                for ck in self._resolve_call(mi, ci, node):
+                for ck in self._resolve_call(mi, ci, node, local_insts):
                     sub = self._may_acquire(ck, stack)
                     for ln in sub:
                         acquired.add(ln)

@@ -111,6 +111,17 @@ class QueryCache:
             QCACHE_HITS.inc()
             return e
 
+    def has_result(self, skey, catalog) -> bool:
+        """Counter-free validity probe (the serving tier's fast-path
+        sniff): True when a lookup_result RIGHT NOW would hit. Stale
+        entries are left for the real lookup to drop."""
+        with self._lock:
+            e = self._entries.get(("r", skey))
+            if e is None:
+                return False
+            return all(catalog.data_version(t) == v
+                       for t, v in e.versions.items())
+
     def store_result(self, skey, table, plan, versions):
         fail_point("qcache::store_result")
         with self._lock:

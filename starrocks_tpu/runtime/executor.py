@@ -17,6 +17,7 @@ import dataclasses
 import jax
 import numpy as np
 
+from .. import lockdep
 from .. import types as T
 from ..column import Chunk, HostTable
 from ..column.column import pad_capacity
@@ -43,30 +44,66 @@ class DeviceCache:
     """Per-(table, column, placement) device arrays + valid masks (the page
     cache analog). Placement None = single-device; (mesh, axis, "sharded"|
     "replicated") = mesh placement for the distributed executor. One cache
-    instance per Session so DML invalidation covers every execution path."""
+    instance per Session — or SHARED by every session of a serving tier
+    (runtime/serving.py), so DML invalidation covers every execution path
+    and warm device columns serve every connection.
+
+    Concurrency: map membership (insert/lookup/evict) is serialized by a
+    lockdep-witnessed rlock; the EXPENSIVE work (host layout, device_put,
+    trace+compile) deliberately runs OUTSIDE the lock so concurrent
+    queries overlap their XLA dispatch — two threads racing the same cold
+    key may both compute, and `setdefault` under the lock picks one
+    winner (a benign duplicated put, never an inconsistent map). The
+    per-plan program-bucket CONTENTS are mutated by the executor's
+    adaptive loop outside this class; entries there are keyed by caps
+    value, so the worst interleaving is a duplicated compile."""
 
     MAX_CACHED_PLANS = 64
 
     def __init__(self):
-        self._cols: dict = {}
-        self._caps: dict = {}
+        self._lock = lockdep.rlock("DeviceCache._lock")
+        self._cols: dict = {}  # guarded_by: _lock
+        self._caps: dict = {}  # guarded_by: _lock
         # compiled-program cache: (tag, plan) -> {"last": caps, "progs":
         # {caps items: entry}}. Plans are frozen value-hashable trees, so
         # identical SQL re-runs skip trace+compile entirely. LRU-bounded.
         from collections import OrderedDict
 
-        self.programs: OrderedDict = OrderedDict()
+        self.programs: OrderedDict = OrderedDict()   # guarded_by: _lock
         # optimized-plan cache: logical plan -> optimize() output. The DP
         # join ordering is O(3^n) subset enumeration in host Python — real
         # milliseconds on repeated multi-join queries. Evicted with programs
         # on DML (stats drive join order / runtime-filter decisions).
-        self.opt_plans: OrderedDict = OrderedDict()
+        self.opt_plans: OrderedDict = OrderedDict()  # guarded_by: _lock
         # two-tier query cache (starrocks_tpu/cache/): full results +
         # per-segment partial-aggregation states. Living here means every
         # existing DML invalidate(table) call covers it for free.
         from ..cache.query_cache import QueryCache
 
         self.qcache = QueryCache()
+        # text -> analyzed-plan cache (the prepared-statement fast path);
+        # has its own lock + schema-epoch validation (cache/plan_cache.py)
+        from ..cache.plan_cache import PlanCache
+
+        self.plan_cache = PlanCache()
+
+    # --- locked map helpers ---------------------------------------------------
+    def _cget(self, key):
+        with self._lock:
+            return self._cols.get(key)
+
+    def _cput(self, key, val):
+        """Insert-if-absent; returns the entry that WON (first writer)."""
+        with self._lock:
+            return self._cols.setdefault(key, val)
+
+    def _cpop(self, key):
+        with self._lock:
+            self._cols.pop(key, None)
+
+    def _cap_for(self, key, default: int) -> int:
+        with self._lock:
+            return self._caps.setdefault(key, default)
 
     def program_bucket(self, key):
         from .udf import registry_epoch
@@ -81,23 +118,38 @@ class DeviceCache:
         # without the declaration — the missing-knob bug class is closed
         # at both ends.
         key = (key, registry_epoch(), config.trace_key())
-        b = self.programs.get(key)
-        if b is None:
-            b = self.programs[key] = {"last": None, "progs": {}}
-            while len(self.programs) > self.MAX_CACHED_PLANS:
-                self.programs.popitem(last=False)
-        else:
-            self.programs.move_to_end(key)
-        return b
+        with self._lock:
+            b = self.programs.get(key)
+            if b is None:
+                b = self.programs[key] = {"last": None, "progs": {}}
+                while len(self.programs) > self.MAX_CACHED_PLANS:
+                    self.programs.popitem(last=False)
+            else:
+                self.programs.move_to_end(key)
+            return b
+
+    def opt_plan_lookup(self, key):
+        with self._lock:
+            opt = self.opt_plans.get(key)
+            if opt is not None:
+                self.opt_plans.move_to_end(key)
+            return opt
+
+    def opt_plan_store(self, key, opt):
+        with self._lock:
+            self.opt_plans[key] = opt
+            while len(self.opt_plans) > self.MAX_CACHED_PLANS:
+                self.opt_plans.popitem(last=False)
+
+    def clear_plans(self):
+        """Drop compiled programs + optimized plans (UDF registry change,
+        MV freshness flip — anything that re-shapes planning wholesale)."""
+        with self._lock:
+            self.programs.clear()
+            self.opt_plans.clear()
 
     def invalidate(self, table: str):
         fail_point("devicecache::invalidate")
-        self._cols = {k: v for k, v in self._cols.items() if k[0] != table}
-        self._caps = {k: v for k, v in self._caps.items() if k[0] != table}
-        # full-result entries that observed this table drop immediately;
-        # per-segment partial states validate by file identity and survive
-        # appends by design (cache/query_cache.py)
-        self.qcache.invalidate_table(table)
         # evict compiled programs that scan this table: traces bake
         # stats-derived constants (dense runtime-filter ranges, multi-key
         # bit widths), which DML can silently outgrow without a shape change
@@ -114,10 +166,21 @@ class DeviceCache:
                             return True
             return False
 
-        for key in [k for k in self.programs if scans_table(k)]:
-            del self.programs[key]
-        for key in [k for k in self.opt_plans if scans_table((k,))]:
-            del self.opt_plans[key]
+        with self._lock:
+            self._cols = {k: v for k, v in self._cols.items()
+                          if k[0] != table}
+            self._caps = {k: v for k, v in self._caps.items()
+                          if k[0] != table}
+            for key in [k for k in self.programs if scans_table(k)]:
+                del self.programs[key]
+            for key in [k for k in self.opt_plans if scans_table((k,))]:
+                del self.opt_plans[key]
+        # full-result entries that observed this table drop immediately;
+        # per-segment partial states validate by file identity and survive
+        # appends by design (cache/query_cache.py). Outside our lock: the
+        # query cache has its own, and nesting the two here would impose
+        # a lock order the serving paths never need.
+        self.qcache.invalidate_table(table)
 
     def build_order_for(self, handle, alias: str, key_cols, bit_widths):
         """Cached argsort permutation of a scan's packed join keys (single
@@ -131,12 +194,13 @@ class DeviceCache:
 
         key = (handle.name, "__border__", tuple(key_cols), bit_widths,
                "local")
-        if key not in self._cols:
+        e = self._cget(key)
+        if e is None:
             chunk = self.chunk_for(handle, alias, tuple(key_cols))
             keys = tuple(_Col(f"{alias}.{c}") for c in key_cols)
             bk, _ = pack_keys(chunk, keys, bit_widths)
-            self._cols[key] = (jnp.argsort(bk, stable=True), None)
-        return self._cols[key][0]
+            e = self._cput(key, (jnp.argsort(bk, stable=True), None))
+        return e[0]
 
     def pruned_handle_for(self, handle, columns, bounds):
         """(handle, scan_stats, tag) for an RF-pruned snapshot of a stored
@@ -151,16 +215,16 @@ class DeviceCache:
 
         tag = "rf:" + ",".join(f"{c}[{lo},{hi}]" for c, lo, hi in bounds)
         key = (handle.name, "__rfscan__", tag, tuple(columns))
-        if key not in self._cols:
+        e = self._cget(key)
+        if e is None:
             fail_point("scan::rf_pruned_load")
-            ht = handle.store.load_table(
+            ht, stats = handle.store.load_table(
                 handle.name, columns=list(columns),
-                rf_predicate=bounds_predicate(bounds))
-            stats = dict(handle.store.last_scan_stats)
+                rf_predicate=bounds_predicate(bounds), with_stats=True)
             ph = TableHandle(handle.name, ht, handle.unique_keys,
                              handle.distribution)
-            self._cols[key] = ((ph, stats, tag), None)
-        return self._cols[key][0]
+            e = self._cput(key, ((ph, dict(stats), tag), None))
+        return e[0]
 
     def chunk_for(self, handle, alias: str, columns, placement=None,
                   cache_tag=None) -> Chunk:
@@ -217,7 +281,7 @@ class DeviceCache:
         if handle.name.startswith("information_schema."):
             cap = default_cap  # virtual tables grow between reads
         else:
-            cap = self._caps.setdefault(cap_key, default_cap)
+            cap = self._cap_for(cap_key, default_cap)
 
         def layout(a, fill):
             """Host layout: pad (range mode) or bucket-slotted (hash mode).
@@ -249,14 +313,18 @@ class DeviceCache:
         for c in columns:
             key = (handle.name, c, tag)
             if not cacheable:
-                self._cols.pop(key, None)
-            if key not in self._cols:
+                self._cpop(key)
+            entry = self._cget(key)
+            if entry is None:
+                # layout + device_put run OUTSIDE the cache lock so
+                # concurrent scans overlap; setdefault picks one winner
                 a = layout(ht.arrays[c], 0)
                 v = ht.valids.get(c)
                 if v is not None:
                     v = layout(v, False)
-                self._cols[key] = (put(a), None if v is None else put(v))
-            d, v = self._cols[key]
+                entry = self._cput(
+                    key, (put(a), None if v is None else put(v)))
+            d, v = entry
             f = ht.schema.field(c)
             st = handle.column_stats(c)
             bounds = (
@@ -274,8 +342,9 @@ class DeviceCache:
             # costs ~50ms at 8M rows — invalidated with the columns on DML
             sel_key = (handle.name, "__sel__", tag)
             if not cacheable:
-                self._cols.pop(sel_key, None)
-            if sel_key not in self._cols:
+                self._cpop(sel_key)
+            sentry = self._cget(sel_key)
+            if sentry is None:
                 if reorder is None:
                     selv = np.arange(cap) < n
                 else:
@@ -284,8 +353,8 @@ class DeviceCache:
                     for b in range(n_shards):
                         cnt = int(per_shard_rows[b])
                         selv[b * shard_cap : b * shard_cap + cnt] = True
-                self._cols[sel_key] = (put(selv), None)
-            sel = self._cols[sel_key][0]
+                sentry = self._cput(sel_key, (put(selv), None))
+            sel = sentry[0]
         out = Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)
         lifecycle.account(out, "scan::chunk_to_device")
         return out
@@ -402,16 +471,12 @@ class Executor:
 
                 opt_key = (plan,) + tuple(
                     config.get(k) for k in OPT_KEY_KNOBS)
-                opt = self.cache.opt_plans.get(opt_key)
+                opt = self.cache.opt_plan_lookup(opt_key)
                 if opt is None:
                     with config.record_reads() as opt_reads:
                         opt = optimize(plan, self.catalog)
                     self._verify_opt_reads(opt_reads, profile)
-                    self.cache.opt_plans[opt_key] = opt
-                    while len(self.cache.opt_plans) > DeviceCache.MAX_CACHED_PLANS:
-                        self.cache.opt_plans.popitem(last=False)
-                else:
-                    self.cache.opt_plans.move_to_end(opt_key)
+                    self.cache.opt_plan_store(opt_key, opt)
                 # subquery resolution executes data-dependent sub-plans —
                 # never cached
                 plan = self._resolve_scalar_subqueries(opt)

@@ -9,8 +9,11 @@ http/rest/ExecuteSqlAction.java). Minimal but real server:
   GET  /profile                 -> last query's RuntimeProfile render
   GET  /tables                  -> catalog listing
 
-Runs on the stdlib http.server (threaded); one Session per server, queries
-serialized by a lock (the engine itself is single-controller).
+Runs on the stdlib http.server (threaded) over a serving tier
+(runtime/serving.py): each request executes on a per-request Session
+sharing the tier's catalog/device-cache/store, dispatched through the
+priority executor pool — concurrent requests genuinely overlap, and warm
+repeats take the tier's inline fast path.
 """
 
 from __future__ import annotations
@@ -20,12 +23,12 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .. import lockdep
 from .metrics import metrics
+from .serving import ServingTier
 from .session import Session
 
 
-def make_handler(session: Session, lock: threading.Lock):
+def make_handler(session: Session, tier: ServingTier):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
             pass  # quiet; metrics cover observability
@@ -84,9 +87,10 @@ def make_handler(session: Session, lock: threading.Lock):
 
             m = re.fullmatch(r"/api/query/(\d+)/cancel", self.path)
             if m is not None:
-                # lock-free by design: the query lock is HELD by the very
-                # query being cancelled; cancellation is a registry flag
-                # the running query observes at its next stage boundary
+                # tier-free by design: the executor pool may be saturated
+                # by the very query being cancelled; cancellation is a
+                # registry flag the running query observes at its next
+                # stage boundary
                 from .lifecycle import REGISTRY
 
                 user = self._auth_user()
@@ -131,13 +135,10 @@ def make_handler(session: Session, lock: threading.Lock):
             t0 = time.time()
             try:
                 fail_point("http::query")
-                with lock:
-                    prev = session.current_user
-                    session.current_user = user
-                    try:
-                        res = session.sql(sql)
-                    finally:
-                        session.current_user = prev
+                # per-request session over the shared tier: user identity
+                # and any SET in this request stay request-local
+                sess = tier.new_session(user)
+                res = tier.execute(sess, sql)
                 if res is None:
                     body = {"ok": True}
                 elif isinstance(res, (list, str, int)):
@@ -155,12 +156,19 @@ def make_handler(session: Session, lock: threading.Lock):
     return Handler
 
 
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    # burst connects from client fleets overflow the default backlog of 5
+    request_queue_size = 128
+
+
 class SqlHttpServer:
-    def __init__(self, session: Session, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, session: Session, host: str = "127.0.0.1",
+                 port: int = 0, tier: ServingTier | None = None):
         self.session = session
-        self._lock = lockdep.lock("SqlHttpServer._lock")
-        self.httpd = ThreadingHTTPServer(
-            (host, port), make_handler(session, self._lock)
+        self.tier = tier or ServingTier(session)
+        self.httpd = _Server(
+            (host, port), make_handler(session, self.tier)
         )
         self.port = self.httpd.server_address[1]
         # lint: unguarded-ok — written once by the owner thread in start()
@@ -177,22 +185,26 @@ class SqlHttpServer:
         self.httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=5)
+        self.tier.shutdown()
 
 
 def serve(data_dir: str | None = None, port: int = 8030,
           mysql_port: int = 9030):
     """CLI entry: python -m starrocks_tpu.runtime.http_service
 
-    Serves BOTH front doors over one session (the reference FE listens on
-    http_port 8030 and query_port 9030 the same way): HTTP JSON on `port`,
-    MySQL protocol on `mysql_port` (0 disables)."""
+    Serves BOTH front doors over ONE serving tier (the reference FE
+    listens on http_port 8030 and query_port 9030 the same way): HTTP
+    JSON on `port`, MySQL protocol on `mysql_port` (0 disables). The
+    shared tier means shared caches, shared admission, one executor
+    pool."""
     s = Session(data_dir=data_dir)
-    srv = SqlHttpServer(s, port=port)
+    tier = ServingTier(s)
+    srv = SqlHttpServer(s, port=port, tier=tier)
     if mysql_port:
         from .mysql_service import MySQLServer
 
         try:
-            my = MySQLServer(s, port=mysql_port, lock=srv._lock).start()
+            my = MySQLServer(s, port=mysql_port, tier=tier).start()
             print(f"starrocks_tpu MySQL protocol on 127.0.0.1:{my.port}")
         except OSError as e:
             # HTTP service must survive a busy query port (9030 may host a

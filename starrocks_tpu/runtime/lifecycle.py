@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import os
 import threading
 import time
 
@@ -101,6 +102,7 @@ class QueryContext:
         self.degraded = False       # soft limit crossed: degrade gracefully
         self.degrade_reason = None
         self.last_stage = "start"
+        self.queue_wait_ms = 0.0    # admission-lane wait (workgroup.py)
         self._cancel_reason = None
         self._cleanups: list = []   # run LIFO on scope exit, every path
 
@@ -112,6 +114,18 @@ class QueryContext:
         if self.state != "running":
             return False
         self._cancel_reason = reason
+        return True
+
+    def nudge(self, reason: str) -> bool:
+        """Soft-degrade hint (any thread): same graceful-degradation path a
+        crossed soft memory limit takes — cache admission declines, spill
+        batches shrink — but triggered by admission back-pressure
+        (workgroup.py preemption hints). Never kills. True when the hint
+        was freshly delivered."""
+        if self.state != "running" or self.degraded:
+            return False
+        self.degraded = True
+        self.degrade_reason = reason
         return True
 
     def check(self, stage: str):
@@ -209,16 +223,54 @@ class QueryRegistry:
         ]
 
 
+try:
+    _PAGE_SIZE = int(os.sysconf("SC_PAGE_SIZE"))
+except (ValueError, OSError, AttributeError):
+    _PAGE_SIZE = 4096
+
+
+def _read_statm_rss() -> int:
+    """Resident-set bytes of this process from /proc/self/statm (field 2,
+    in pages). 0 when the proc surface is unavailable (non-Linux)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
 class MemoryAccountant:
     """Hierarchical (process -> resource group -> query) memory accounting
     fed by real materialized-buffer sizes at stage boundaries. Charges are
     cumulative per query and released wholesale when the query's scope
-    exits — so a before/after snapshot balancing to zero proves no leak."""
+    exits — so a before/after snapshot balancing to zero proves no leak.
 
-    def __init__(self):
+    The PROCESS ceiling additionally consults a real RSS probe
+    (/proc/self/statm, cached for RSS_PROBE_INTERVAL_S): boundary-fed
+    estimates only see buffers the engine materializes, while the
+    interpreter, jax runtime, and compile arenas also occupy the process —
+    `process_mem_limit_bytes` enforces against whichever is larger. The
+    reader is injectable for tests."""
+
+    RSS_PROBE_INTERVAL_S = 0.25
+
+    def __init__(self, rss_reader=None):
         self._lock = lockdep.lock("MemoryAccountant._lock")
         self.process_bytes = 0        # guarded_by: _lock
         self.group_bytes: dict = {}   # guarded_by: _lock
+        self._rss_reader = rss_reader or _read_statm_rss
+        self._rss_at = 0.0            # guarded_by: _lock
+        self._rss_val = 0             # guarded_by: _lock
+
+    def rss_bytes(self) -> int:
+        """Probed process RSS, cached for RSS_PROBE_INTERVAL_S so charge()
+        checkpoints stay a few attribute reads between probes."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._rss_at >= self.RSS_PROBE_INTERVAL_S:
+                self._rss_at = now
+                self._rss_val = int(self._rss_reader() or 0)
+            return self._rss_val
 
     def charge(self, ctx: QueryContext, nbytes: int, stage: str):
         if nbytes <= 0 or ctx.state != "running":
@@ -245,12 +297,18 @@ class MemoryAccountant:
                 f"query {ctx.qid} pushed resource group {ctx.group!r} over "
                 f"mem_limit_bytes={ctx.group_limit} at stage {stage!r} "
                 f"({group_used} bytes across the group)")
-        if ctx.process_limit and process_used > ctx.process_limit:
-            MEMLIMIT_TOTAL.inc()
-            raise MemLimitExceeded(
-                f"query {ctx.qid} pushed the process over "
-                f"process_mem_limit_bytes={ctx.process_limit} at stage "
-                f"{stage!r} ({process_used} bytes)")
+        if ctx.process_limit:
+            # the ceiling enforces against max(accounted, probed RSS):
+            # estimates alone miss interpreter/jax/compile-arena residency
+            # (NEXT 7c — the real-RSS wiring)
+            rss = self.rss_bytes()
+            if max(process_used, rss) > ctx.process_limit:
+                MEMLIMIT_TOTAL.inc()
+                raise MemLimitExceeded(
+                    f"query {ctx.qid} pushed the process over "
+                    f"process_mem_limit_bytes={ctx.process_limit} at stage "
+                    f"{stage!r} ({process_used} bytes accounted, "
+                    f"{rss} bytes RSS)")
         if (ctx.mem_soft_limit and not ctx.degraded
                 and ctx.mem_bytes > ctx.mem_soft_limit):
             ctx.degraded = True

@@ -24,9 +24,11 @@ pymysql to connect and query):
   advertised, so old and new clients both parse us);
 - multi-statement off.
 
-One Session per server; queries serialize on a lock (single-controller
-engine), same as the HTTP service; the connection's authenticated user is
-installed on the session under that lock (privilege checks are per-user).
+One serving tier per server (runtime/serving.py): each connection owns a
+lightweight Session over the shared catalog/device-cache/store, and
+statements execute through the tier's priority pool — concurrent
+connections genuinely overlap. Warm repeats take the tier's inline fast
+path. Privilege checks are per-user on the connection's own session.
 """
 
 from __future__ import annotations
@@ -36,7 +38,6 @@ import socketserver
 import struct
 import threading
 
-from .. import lockdep
 from .. import types as T
 from .session import Session
 
@@ -210,14 +211,19 @@ def _cell(v) -> bytes:
 
 
 class MySQLServer:
-    """Threaded MySQL-protocol server over a shared Session."""
+    """Threaded MySQL-protocol server over a serving tier: every
+    connection gets its own lightweight Session (shared catalog / device
+    cache / store), and statements dispatch through the tier's priority
+    executor pool — independent queries from different connections
+    genuinely overlap (runtime/serving.py). KILL / SHOW PROCESSLIST
+    bypass the tier by design (the victim may hold its gate)."""
 
     def __init__(self, session: Session, host="127.0.0.1", port=9030,
-                 lock: threading.Lock | None = None):
-        self.session = session
-        # the big session lock: one statement at a time over the shared
-        # Session (KILL bypasses it by design — see lifecycle docstring)
-        self.lock = lock or lockdep.lock("MySQLServer.lock")
+                 tier=None):
+        from .serving import ServingTier
+
+        self.session = session  # the tier's template (replayed the store)
+        self.tier = tier or ServingTier(session)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -227,6 +233,9 @@ class MySQLServer:
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
+            # a dashboard fleet connects in bursts; the stdlib default
+            # backlog of 5 drops simultaneous connects on the floor
+            request_queue_size = 128
 
         self.server = Server((host, port), Handler)
         self.port = self.server.server_address[1]
@@ -240,6 +249,7 @@ class MySQLServer:
     def shutdown(self):
         self.server.shutdown()
         self.server.server_close()
+        self.tier.shutdown()
 
     # --- connection lifecycle -------------------------------------------------
     def _authenticate(self, conn: _Conn, salt: bytes):
@@ -297,6 +307,9 @@ class MySQLServer:
         user = self._authenticate(conn, salt)
         if user is None:
             return
+        # per-connection session over the tier's shared catalog/cache:
+        # session state (user, resource group) is private to this client
+        sess = self.tier.new_session(user)
         stmts: dict = {}  # stmt_id -> (sql_text, param_positions)
         stmt_ids = iter(range(1, 1 << 30))
         while True:
@@ -318,14 +331,14 @@ class MySQLServer:
                 conn.send_eof()
                 continue
             if cmd == 0x03:  # COM_QUERY
-                self._query(conn, arg.decode("utf-8", "replace"), user)
+                self._query(conn, arg.decode("utf-8", "replace"), sess)
                 continue
             if cmd == 0x16:  # COM_STMT_PREPARE
                 self._stmt_prepare(conn, arg.decode("utf-8", "replace"),
                                    stmts, stmt_ids)
                 continue
             if cmd == 0x17:  # COM_STMT_EXECUTE
-                self._stmt_execute(conn, arg, stmts, user)
+                self._stmt_execute(conn, arg, stmts, sess)
                 continue
             if cmd == 0x19:  # COM_STMT_CLOSE (no response)
                 if len(arg) >= 4:
@@ -336,14 +349,8 @@ class MySQLServer:
                 continue
             conn.send_err(1295, f"command {cmd:#x} not supported")
 
-    def _run_as(self, sql: str, user: str):
-        with self.lock:
-            prev = self.session.current_user
-            self.session.current_user = user
-            try:
-                return self.session.sql(sql)
-            finally:
-                self.session.current_user = prev
+    def _run_as(self, sql: str, sess):
+        return self.tier.execute(sess, sql)
 
     def _kill_bypass(self, conn: _Conn, sql: str, user: str) -> bool:
         """KILL QUERY / SHOW PROCESSLIST handled WITHOUT the session lock:
@@ -387,26 +394,26 @@ class MySQLServer:
             return True
         return False
 
-    def _query(self, conn: _Conn, sql: str, user: str):
+    def _query(self, conn: _Conn, sql: str, sess):
         from .failpoint import fail_point
 
         sql = sql.strip().rstrip(";")
         fail_point("mysql::query")
         low = sql.lower()
         if low.startswith(("kill", "show")) and self._kill_bypass(
-                conn, sql, user):
+                conn, sql, sess.current_user):
             return
         # connector session boilerplate: accept silently
         if low.startswith(("set ", "commit", "rollback", "start transaction",
                            "use ")) and not low.startswith("set global"):
             try:
-                self._run_as(sql, user)
+                self._run_as(sql, sess)
             except Exception:  # lint: swallow-ok — connector boilerplate
                 pass  # unknown session vars from connectors are non-fatal
             conn.send_ok()
             return
         try:
-            res = self._run_as(sql, user)
+            res = self._run_as(sql, sess)
         except PermissionError as e:
             conn.send_err(1142, str(e), b"42000")
             return
@@ -467,7 +474,7 @@ class MySQLServer:
         if marks:
             conn.send_eof()
 
-    def _stmt_execute(self, conn: _Conn, arg: bytes, stmts: dict, user: str):
+    def _stmt_execute(self, conn: _Conn, arg: bytes, stmts: dict, sess):
         if len(arg) < 9:
             conn.send_err(1064, "malformed COM_STMT_EXECUTE")
             return
@@ -487,7 +494,7 @@ class MySQLServer:
             return
         final = self._splice(sql, marks, params)
         try:
-            res = self._run_as(final, user)
+            res = self._run_as(final, sess)
         except PermissionError as e:
             conn.send_err(1142, str(e), b"42000")
             return

@@ -1,0 +1,347 @@
+"""High-concurrency serving tier: executor pool + statement gate + fast path.
+
+Reference behavior: the FE's session/execution plane (qe/ — ConnectContext
+pool, ConnectScheduler's executor threads, StmtExecutor) multiplexes
+hundreds of client connections over a bounded pool of execution threads.
+Before this tier, both front doors serialized every statement on one big
+session lock — added cores bought zero QPS. Now:
+
+- **ServingTier** owns the shared engine state (catalog, TabletStore, ONE
+  DeviceCache — compiled programs / device columns / query cache / plan
+  cache serve every connection) and mints a lightweight per-connection
+  Session around it (`new_session`), so per-session mutable state
+  (current_user, resource_group, last_profile) never races.
+
+- **ExecutorPool** (`SET serve_pool_size`) dispatches admitted statements
+  across worker threads. The run queue is PRIORITY-ordered with the same
+  aging rule as admission lanes (workgroup.py): a statement's priority is
+  its resource group's, boosted by queue wait / query_queue_aging_s, so
+  low-priority dashboards never starve behind a stream of hot ones.
+  Every worker body runs inside `lifecycle.query_scope` — the statement
+  is registered (SHOW PROCESSLIST / KILL), deadline-armed, and memory-
+  accounted BEFORE any engine code runs; tools/src_lint.py R5 pins this
+  statically (no unregistered statement execution).
+
+- **StatementGate**: queries take the SHARED side and overlap freely
+  (planning, host orchestration, XLA dispatch); catalog-mutating
+  statements (DDL/DML/SET) take the EXCLUSIVE side — writer-preferring,
+  so a queued mutation is not starved by a read stream. This is the
+  catalog's concurrency contract: its schema maps are mutated only under
+  the exclusive side, read freely under the shared side.
+
+- **Warm fast path**: when the statement text's analyzed plan AND its
+  full result are both cached-valid, the statement runs INLINE on the
+  connection thread (no pool hop, no parse/analyze/optimize/compile) —
+  the sub-millisecond dashboard path. The probe is counter-free; the
+  inline execution reuses the exact session.sql path, so a probe/execute
+  race degrades to a normal pool-less execution, never a wrong answer.
+
+KILL QUERY / cancel endpoints bypass the tier entirely (lifecycle
+registry), exactly as they bypass the old session lock: the victim may be
+HOLDING the gate.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import threading
+import time
+
+from .. import lockdep
+from . import workgroup as _workgroup  # noqa: F401 — queue-knob definitions
+from .config import config
+from .metrics import metrics
+from .session import Session
+
+config.define("serve_pool_size", 4, True,
+              "executor threads of the serving tier's statement pool "
+              "(the qe/ ConnectScheduler executor-pool analog); sizing "
+              "applies to tiers created after the SET")
+
+SERVE_STATEMENTS = metrics.counter(
+    "sr_tpu_serve_statements_total", "statements executed by the tier")
+SERVE_FAST_PATH = metrics.counter(
+    "sr_tpu_serve_fast_path_total",
+    "statements answered inline by the warm plan+result fast path")
+SERVE_QUEUE_WAIT_MS = metrics.counter(
+    "sr_tpu_serve_queue_wait_ms_total",
+    "total milliseconds statements waited in the executor-pool queue")
+SERVE_EXCLUSIVE = metrics.counter(
+    "sr_tpu_serve_exclusive_total",
+    "statements that took the exclusive (mutation) side of the gate")
+
+# leading keyword -> shared (read) side of the statement gate; anything
+# else (DML/DDL/SET/ADMIN/...) is exclusive. KILL never reaches the tier.
+_READ_KEYWORDS = frozenset(
+    ("select", "with", "values", "show", "explain", "describe", "desc"))
+
+
+def _is_read_statement(sql: str) -> bool:
+    head = sql.lstrip().split(None, 1)
+    return bool(head) and head[0].lower().rstrip("(") in _READ_KEYWORDS
+
+
+class StatementGate:
+    """Writer-preferring readers/writer gate over one witnessed condition.
+    Readers = queries (overlap freely); writers = catalog mutations."""
+
+    def __init__(self):
+        self._lock = lockdep.condition("StatementGate._lock")
+        self._readers = 0           # guarded_by: _lock
+        self._writer = False        # guarded_by: _lock
+        self._writers_waiting = 0   # guarded_by: _lock
+
+    def try_shared(self) -> bool:
+        """Non-blocking reader entry (the fast path must never queue
+        behind a writer — it falls back to the pool instead)."""
+        with self._lock:
+            if self._writer or self._writers_waiting:
+                return False
+            self._readers += 1
+            return True
+
+    def release_shared(self):
+        with self._lock:
+            self._readers = max(self._readers - 1, 0)
+            if self._readers == 0:
+                self._lock.notify_all()
+
+    @contextlib.contextmanager
+    def shared(self):
+        from . import lifecycle
+
+        with self._lock:
+            # writer preference: queued mutations bar NEW readers
+            while self._writer or self._writers_waiting:
+                self._lock.wait(timeout=0.1)
+                lifecycle.checkpoint("serve::gate_shared")
+            self._readers += 1
+        try:
+            yield
+        finally:
+            self.release_shared()
+
+    @contextlib.contextmanager
+    def exclusive(self):
+        from . import lifecycle
+
+        with self._lock:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._lock.wait(timeout=0.1)
+                    lifecycle.checkpoint("serve::gate_exclusive")
+                self._writer = True
+            finally:
+                self._writers_waiting -= 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._writer = False
+                self._lock.notify_all()
+
+
+@dataclasses.dataclass
+class _Work:
+    """One dispatched statement: inputs, priority, and its reply slot."""
+    session: Session
+    sql: str
+    exclusive: bool
+    prio: float
+    seq: int
+    t0: float
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    result: object = None
+    error: BaseException | None = None
+
+    def eff(self, now: float, aging: float) -> float:
+        if aging > 0:
+            return self.prio + (now - self.t0) / aging
+        return self.prio
+
+
+class ExecutorPool:
+    """Sized statement-executor pool with a priority+aging run queue."""
+
+    def __init__(self, size: int, gate: StatementGate):
+        self.size = max(int(size), 1)
+        self.gate = gate
+        self._lock = lockdep.condition("ExecutorPool._lock")
+        self._queue: list = []     # guarded_by: _lock — pending _Work
+        self._shutdown = False     # guarded_by: _lock
+        self._seq = itertools.count(1)  # guarded_by: _lock
+        # spawned once by the owning tier's thread; never mutated after
+        self._threads = [           # lint: unguarded-ok — owner-thread only
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"sr-serve-{i}")
+            for i in range(self.size)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, session: Session, sql: str, exclusive: bool,
+               prio: float) -> _Work:
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("serving tier is shut down")
+            w = _Work(session, sql, exclusive, prio, next(self._seq),
+                      time.monotonic())
+            self._queue.append(w)
+            self._lock.notify()
+            return w
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def shutdown(self):
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+    def _next_work(self):
+        """Blocking pop of the highest effective-priority statement (the
+        pool-level priority lane; same aging knob as admission)."""
+        with self._lock:
+            while True:
+                if self._shutdown:
+                    return None
+                if self._queue:
+                    now = time.monotonic()
+                    aging = float(config.get("query_queue_aging_s") or 0.0)
+                    best = max(self._queue,
+                               key=lambda w: (w.eff(now, aging), -w.seq))
+                    self._queue.remove(best)
+                    return best
+                self._lock.wait(timeout=0.5)
+
+    def _worker(self):
+        while True:
+            w = self._next_work()
+            if w is None:
+                return
+            try:
+                self._run_statement(w)
+            except BaseException as e:  # noqa: BLE001  # lint: swallow-ok
+                w.error = e  # delivered to the waiting connection thread;
+                #              the worker itself must survive every failure
+            finally:
+                w.done.set()
+
+    def _run_statement(self, w: _Work):
+        """Worker body: EVERY statement runs inside a lifecycle
+        query_scope (registered, killable, deadline-armed, accounted)
+        before any engine code — src_lint R5 enforces this shape."""
+        from . import lifecycle
+
+        SERVE_QUEUE_WAIT_MS.inc(int((time.monotonic() - w.t0) * 1000))
+        SERVE_STATEMENTS.inc()
+        sess = w.session
+        group_limit = 0
+        if sess.resource_group:
+            g = sess.workgroups().get(sess.resource_group)
+            if g is not None:
+                group_limit = g.mem_limit_bytes
+        gate_side = (self.gate.exclusive() if w.exclusive
+                     else self.gate.shared())
+        if w.exclusive:
+            SERVE_EXCLUSIVE.inc()
+        with lifecycle.query_scope(w.sql, user=sess.current_user,
+                                   group=sess.resource_group,
+                                   group_limit=group_limit):
+            with gate_side:
+                w.result = sess.sql(w.sql)
+
+
+class ServingTier:
+    """The shared serving plane both front doors (MySQL + HTTP) ride."""
+
+    def __init__(self, template: Session, pool_size: int | None = None):
+        self.template = template
+        self.catalog = template.catalog
+        self.cache = template.cache
+        self.store = template.store
+        self.gate = StatementGate()
+        size = pool_size if pool_size is not None \
+            else int(config.get("serve_pool_size"))
+        self.pool = ExecutorPool(size, self.gate)
+
+    def new_session(self, user: str = "root") -> Session:
+        """A per-connection session over the SHARED catalog/cache/store:
+        session-scoped state (user, resource group, last profile) is
+        private; everything cacheable is communal."""
+        s = Session(catalog=self.catalog, cache=self.cache, store=self.store,
+                    dist_shards=self.template.dist_shards)
+        s.current_user = user
+        return s
+
+    def execute(self, session: Session, sql: str):
+        """Execute one statement for a connection: warm fast path inline,
+        everything else through the priority pool. Blocks the calling
+        (connection) thread until the statement finishes — wire protocols
+        are synchronous per connection."""
+        sqln = sql.strip().rstrip(";")
+        res = self._try_fast_path(session, sqln)
+        if res is not _FAST_MISS:
+            return res
+        prio = 0.0
+        if session.resource_group:
+            g = session.workgroups().get(session.resource_group)
+            if g is not None:
+                prio = float(g.priority)
+        w = self.pool.submit(session, sqln, not _is_read_statement(sqln),
+                             prio)
+        w.done.wait()
+        # surface the tier's last profile for the /profile endpoint
+        # (best-effort: concurrent statements race benignly)
+        if session.last_profile is not None:
+            self.template.last_profile = session.last_profile
+        if w.error is not None:
+            raise w.error
+        return w.result
+
+    def _try_fast_path(self, session: Session, sql: str):
+        """Inline execution when text -> plan -> result are ALL cached and
+        valid: no pool hop, no parse/analyze/optimize/compile/device —
+        the <1ms warm-dashboard path. Probes are counter-free; the actual
+        execution below re-validates everything through the normal
+        session.sql path, so races only cost speed."""
+        if not (config.get("enable_plan_cache")
+                and config.get("enable_query_cache")):
+            return _FAST_MISS
+        plan = self.cache.plan_cache.peek(sql, self.catalog)
+        if plan is None:
+            return _FAST_MISS
+        from ..cache import keys as cache_keys
+
+        try:
+            skey = cache_keys.full_result_key(plan)
+        except Exception:  # noqa: BLE001  # lint: swallow-ok — unkeyable
+            return _FAST_MISS  # plan shapes simply take the pool path
+        if not self.cache.qcache.has_result(skey, self.catalog):
+            return _FAST_MISS
+        if not self.gate.try_shared():
+            return _FAST_MISS  # a mutation is active/queued: pool path
+        try:
+            SERVE_FAST_PATH.inc()
+            SERVE_STATEMENTS.inc()
+            return session.sql(sql)
+        finally:
+            self.gate.release_shared()
+
+    def stats(self) -> dict:
+        return {
+            "fast_path": SERVE_FAST_PATH.value,
+            "statements": SERVE_STATEMENTS.value,
+            "pool_pending": self.pool.pending(),
+            "plan_cache": self.cache.plan_cache.stats(),
+        }
+
+    def shutdown(self):
+        self.pool.shutdown()
+
+
+_FAST_MISS = object()  # sentinel: fast path declined (None is a result)

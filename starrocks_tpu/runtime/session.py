@@ -12,6 +12,7 @@ from dataclasses import replace as dataclasses_replace
 
 import numpy as np
 
+from .. import lockdep
 from .. import types as T
 from ..column import Field, HostTable, Schema, StringDict
 from ..sql import ast
@@ -22,6 +23,11 @@ from ..sql.parser import parse
 from ..storage.catalog import Catalog
 from .executor import DeviceCache, Executor, QueryResult
 
+# serializes query-log append/trim across connection sessions sharing one
+# catalog (runtime/serving.py runs statements on many threads); the log is
+# the only catalog field mutated by CONCURRENT read statements — schema
+# maps mutate only under the serving tier's exclusive statement gate
+_QLOG_LOCK = lockdep.lock("session._qlog_lock")
 
 
 def _fold_lit(x):
@@ -63,16 +69,23 @@ class Session:
         catalog: Catalog | None = None,
         data_dir: str | None = None,
         dist_shards: int | None = None,
+        cache: DeviceCache | None = None,
+        store=None,
     ):
         self.catalog = catalog or Catalog()
-        self.cache = DeviceCache()
+        # `cache`/`store` let the serving tier (runtime/serving.py) hand
+        # every connection session ONE shared DeviceCache + TabletStore:
+        # warm device columns, compiled programs, and the query cache then
+        # serve all connections, and the store's replay ran exactly once
+        # (in the tier's template session).
+        self.cache = cache or DeviceCache()
         self.last_profile = None  # most recent query's RuntimeProfile
-        self.store = None
+        self.store = store
         self.current_user = "root"  # front doors set this per connection
         self.resource_group = None  # SET resource_group = '...'
         self.dist_shards = dist_shards
         self._dist_executor = None
-        if data_dir is not None:
+        if store is None and data_dir is not None:
             from ..storage.store import TabletStore, schema_from_json
             from ..storage.catalog import StoredTableHandle
 
@@ -381,9 +394,10 @@ class Session:
             self._in_sql = False
             entry["ms"] = int((_time.time() - t0) * 1000)
             log = self.catalog.query_log
-            log.append(entry)
-            if len(log) > 10_000:
-                del log[:5000]
+            with _QLOG_LOCK:
+                log.append(entry)
+                if len(log) > 10_000:
+                    del log[:5000]
             # auto-checkpoint: once the journal tail outgrows the threshold,
             # snapshot catalog metadata + truncate the log (the FE
             # CheckpointController analog, leader/CheckpointController.java:85)
@@ -395,12 +409,24 @@ class Session:
                     pass  # disk hiccup: keep serving; next statement retries
 
     def _sql_inner(self, text: str):
+        from .config import config
+
+        # prepared-statement fast path: statement text -> analyzed plan
+        # (cache/plan_cache.py). A warm hit skips parse+analyze and lands
+        # straight on the result-cache gate — only SELECT plans are ever
+        # stored, so non-query texts always miss. Privileges re-check per
+        # execution on the plan (_check_select_privs).
+        text_key = text.strip().rstrip(";")
+        if config.get("enable_plan_cache"):
+            hit = self.cache.plan_cache.lookup(text_key, self.catalog)
+            if hit is not None:
+                return self._query_planned(hit, from_plan_cache=True)
         stmt = parse(text)
         self._enforce_privileges(stmt)
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt)
         if isinstance(stmt, (ast.Select, ast.SetOp)):
-            return self._query(stmt)
+            return self._query(stmt, cache_text=text_key)
         if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.Grant,
                              ast.Revoke, ast.ShowGrants)):
             return self._auth_stmt(stmt)
@@ -409,15 +435,13 @@ class Session:
 
             create_udf(stmt.name, stmt.params, stmt.ret, stmt.source,
                        replace=stmt.replace)
-            self.cache.programs.clear()  # plans may now resolve differently
-            self.cache.opt_plans.clear()
+            self.cache.clear_plans()  # plans may now resolve differently
             return None
         if isinstance(stmt, ast.DropFunction):
             from .udf import drop_udf
 
             drop_udf(stmt.name, stmt.if_exists)
-            self.cache.programs.clear()
-            self.cache.opt_plans.clear()
+            self.cache.clear_plans()
             return None
         if isinstance(stmt, ast.CreateExternalTable):
             from ..storage.external import ExternalTableHandle
@@ -442,6 +466,7 @@ class Session:
             nm = stmt.name.lower()
             if nm in self.catalog.views:
                 del self.catalog.views[nm]
+                self.catalog.bump_schema_epoch()  # cached plans inlined it
                 self._log_meta({"op": "drop_view", "name": nm})
                 return None
             if nm in self.catalog.mv_defs:
@@ -515,6 +540,9 @@ class Session:
                                 "text": stmt.select_text})
             else:
                 self.catalog.views[name] = stmt.select_text
+                # a cached plan may have failed to resolve (or resolved a
+                # same-named earlier view) under the previous shape
+                self.catalog.bump_schema_epoch()
                 self._log_meta({"op": "create_view", "name": name,
                                 "text": stmt.select_text})
             return None
@@ -705,7 +733,7 @@ class Session:
             pass
         # cached optimized plans may have (not) rewritten against this MV
         # under the previous freshness state
-        self.cache.opt_plans.clear()
+        self.cache.clear_plans()
         return t.num_rows
 
     def _show_create(self, name: str) -> str:
@@ -840,13 +868,30 @@ class Session:
             raise PermissionError("SHOW GRANTS for other users requires admin")
         return a.show_grants(user)
 
-    def _query(self, sel) -> QueryResult:
-        from . import lifecycle
+    def _query(self, sel, cache_text: str | None = None) -> QueryResult:
+        from .config import config
         from .profile import RuntimeProfile
 
         profile = RuntimeProfile("query")
         with profile.timer("analyze"):
             plan = Analyzer(self.catalog).analyze(sel)
+        if cache_text is not None and config.get("enable_plan_cache"):
+            # only top-level statement texts store (internal plans — view
+            # expansions, MV refresh bodies — have no client-visible text)
+            self.cache.plan_cache.store(cache_text, plan, self.catalog)
+        return self._query_planned(plan, profile=profile)
+
+    def _query_planned(self, plan, profile=None,
+                       from_plan_cache: bool = False) -> QueryResult:
+        """Execute an already-analyzed plan (the prepared-statement fast
+        path enters here, skipping parse+analyze entirely)."""
+        from . import lifecycle
+        from .profile import RuntimeProfile
+
+        if profile is None:
+            profile = RuntimeProfile("query")
+        if from_plan_cache:
+            profile.add_counter("plan_cache_hits", 1)
         self._check_select_privs(plan)
         lifecycle.checkpoint("session::analyzed")
         # admission() releases the slot on ANY exit path — including a KILL
@@ -857,10 +902,16 @@ class Session:
     def _admit(self, plan):
         """Resource-group admission (runtime/workgroup.py): estimate the
         query's scan mass from the catalog and pass the gate. Queries
-        without a SET resource_group run unthrottled (default group).
+        without a SET resource_group run unthrottled (default group) —
+        unless a global admission queue is configured
+        (`SET query_queue_concurrency`), which gates every statement.
         Returns a context manager whose exit releases the slot on any
         path (exception-safe; also registered on the query context)."""
-        if self.resource_group is None:
+        from .config import config
+        from . import workgroup as _wg  # noqa: F401 — defines queue knobs
+
+        if self.resource_group is None \
+                and not config.get("query_queue_concurrency"):
             import contextlib
 
             return contextlib.nullcontext()

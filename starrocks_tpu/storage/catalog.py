@@ -176,6 +176,13 @@ class Catalog:
         self.workgroups = None
         # recent statements (sessions append; information_schema.query_log)
         self.query_log: list = []
+        # catalog SHAPE clock: bumped by register/drop/ALTER/view DDL —
+        # the analyzed-plan cache's validity token (cache/plan_cache.py).
+        # DML does NOT bump it: analysis depends on schemas, not data.
+        self.schema_epoch = 0
+
+    def bump_schema_epoch(self):
+        self.schema_epoch += 1
 
     def bump_version(self, name: str):
         n = name.lower()
@@ -216,10 +223,12 @@ class Catalog:
         self.tables[name.lower()] = TableHandle(
             name.lower(), table, unique_keys, distribution
         )
+        self.bump_schema_epoch()
         self.bump_version(name)
 
     def register_handle(self, handle: TableHandle):
         self.tables[handle.name] = handle
+        self.bump_schema_epoch()
         self.bump_version(handle.name)
 
     def drop(self, name: str, if_exists: bool = False):
@@ -228,6 +237,7 @@ class Catalog:
                 return
             raise KeyError(f"unknown table {name}")
         del self.tables[name.lower()]
+        self.bump_schema_epoch()
         self.bump_version(name)
 
     def get_table(self, name: str) -> Optional[TableHandle]:
@@ -288,8 +298,9 @@ class Catalog:
                 ("max_scan_rows", T.BIGINT, [r[2] for r in rows]),
                 ("mem_limit_bytes", T.BIGINT, [r[3] for r in rows]),
                 ("cpu_weight", T.BIGINT, [r[4] for r in rows]),
-                ("running", T.BIGINT, [r[5] for r in rows]),
-                ("queued", T.BIGINT, [r[6] for r in rows]),
+                ("priority", T.BIGINT, [r[5] for r in rows]),
+                ("running", T.BIGINT, [r[6] for r in rows]),
+                ("queued", T.BIGINT, [r[7] for r in rows]),
             ])
         if view == "schemata":
             return vtable([

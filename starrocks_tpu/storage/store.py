@@ -71,7 +71,15 @@ class TabletStore:
         os.makedirs(root, exist_ok=True)
         self.log_path = os.path.join(root, "edit_log.jsonl")
         self.image_path = os.path.join(root, "image.json")
-        self._pk_index: dict = {}  # table -> {pk tuple: (rowset, file, pos)}
+        # guards the scan/index bookkeeping a thread fan-out races on:
+        # _pk_index map membership, the listener list, and the last-scan
+        # stats snapshot. DML CONTENT stays single-writer (the serving
+        # tier's exclusive statement gate); this lock makes the maps safe
+        # against concurrent readers.
+        self._state_lock = lockdep.lock("TabletStore._state_lock")
+        # table -> {pk tuple: (rowset, file, pos)}
+        self._pk_index: dict = {}   # guarded_by: _state_lock
+        self.last_scan_stats: dict = {}  # guarded_by: _state_lock
         # serializes log() appends against checkpoint()'s snapshot+replace:
         # sessions share one TabletStore and auto-checkpoint fires during
         # statement logging, so an unguarded append between the tail
@@ -88,18 +96,32 @@ class TabletStore:
         # these to catalog data-epoch bumps + cache invalidation so DIRECT
         # store mutations (e.g. an explicit compact_table) invalidate the
         # query cache exactly like session DML does.
-        self._listeners: list = []
+        self._listeners: list = []  # guarded_by: _state_lock
 
     def add_listener(self, fn):
-        if fn not in self._listeners:
-            self._listeners.append(fn)
+        with self._state_lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
 
     def _notify(self, table: str, op: str):
-        for fn in list(self._listeners):
-            try:
+        with self._state_lock:
+            listeners = list(self._listeners)
+        for fn in listeners:  # called OUTSIDE the lock: listeners invalidate
+            try:              # caches that take their own locks
                 fn(table, op)
             except Exception:  # noqa: BLE001 — listeners must never fail a write
                 pass
+
+    def scan_stats(self) -> dict:
+        """Snapshot of the most recent load_table's pruning stats. Under
+        concurrency prefer load_table(..., with_stats=True), which returns
+        the stats of THAT scan instead of whichever scan finished last."""
+        with self._state_lock:
+            return dict(self.last_scan_stats)
+
+    def _drop_pk_index(self, name: str):
+        with self._state_lock:
+            self._pk_index.pop(name, None)
 
     # --- edit log + image checkpoint -----------------------------------------
     # The journal is the FE EditLog/image pair (fe persist/EditLog.java:133 +
@@ -247,7 +269,7 @@ class TabletStore:
                       "partition_by": partition_by})
 
     def drop_table(self, name: str, record: bool = True):
-        self._pk_index.pop(name, None)
+        self._drop_pk_index(name)
         tdir = self._tdir(name)
         if os.path.isdir(tdir):
             for f in os.listdir(tdir):
@@ -371,7 +393,7 @@ class TabletStore:
         else:
             m["rowsets"] = []
         m["next_rowset"] = rid + 1
-        self._pk_index.pop(name, None)
+        self._drop_pk_index(name)
         self._write_manifest(name, m)  # atomic swap: new state is now durable
         for f in old_files:
             try:
@@ -445,7 +467,7 @@ class TabletStore:
                     fmeta["cols"] = [c for c in fmeta["cols"] if c != column]
         m["schema"] = schema_to_json(Schema(fields))
         self._write_manifest(name, m)
-        self._pk_index.pop(name, None)
+        self._drop_pk_index(name)
         if record:
             self.log({"op": "alter", "table": name, "action": action,
                       "column": column})
@@ -518,7 +540,7 @@ class TabletStore:
         )
         m["next_rowset"] = rid + 1
         self._write_manifest(name, m)
-        self._pk_index.pop(name, None)  # positions changed
+        self._drop_pk_index(name)  # positions changed
         for f in old_files:
             try:
                 os.remove(os.path.join(self._tdir(name), f))
@@ -539,8 +561,10 @@ class TabletStore:
         parquet round-trips agree."""
         import pyarrow.parquet as pq
 
-        if name in self._pk_index:
-            return self._pk_index[name]
+        with self._state_lock:
+            cached = self._pk_index.get(name)
+        if cached is not None:
+            return cached
         schema = schema_from_json(m["schema"])
         index: dict = {}
         for ri, rs in enumerate(m["rowsets"]):
@@ -559,8 +583,10 @@ class TabletStore:
                     if pos in dead:
                         continue
                     index[kv] = (ri, fi, pos)
-        self._pk_index[name] = index
-        return index
+        # the lock guards MAP membership; index CONTENT mutation (upsert's
+        # incremental maintenance) is single-writer by the DML gate
+        with self._state_lock:
+            return self._pk_index.setdefault(name, index)
 
     @staticmethod
     def _canon_key_rows(data: HostTable, keys):
@@ -676,7 +702,8 @@ class TabletStore:
     def load_table(
         self, name: str, columns=None, predicate: Optional[Expr] = None,
         rf_predicate: Optional[Expr] = None, files=None,
-    ) -> HostTable:
+        with_stats: bool = False,
+    ):
         """Read the table (optionally only some columns), pruning files whose
         zonemaps prove the predicate false (segment zonemap filtering analog).
 
@@ -729,10 +756,12 @@ class TabletStore:
                     rf_pruned += 1
                     continue
                 chosen.append(fmeta)
-        self.last_scan_stats = {
+        stats = {
             "files": total, "pruned": pruned, "partition_pruned": part_pruned,
             "rf_pruned": rf_pruned,
         }
+        with self._state_lock:
+            self.last_scan_stats = stats
         if not chosen:
             # empty table with correct schema (wide layouts keep rank 2)
             sub = schema if columns is None else Schema(
@@ -748,7 +777,8 @@ class TabletStore:
                     return np.zeros((0, f.type.wide_width), dtype=np.int8)
                 return np.zeros(0, dtype=f.type.np_dtype)
 
-            return HostTable(sub, {f.name: empty(f) for f in sub}, {})
+            out = HostTable(sub, {f.name: empty(f) for f in sub}, {})
+            return (out, stats) if with_stats else out
         import pyarrow as pa
 
         want = list(columns) if columns else [f.name for f in schema]
@@ -777,7 +807,8 @@ class TabletStore:
         merged = pa.concat_tables(tables, promote_options="default")
         ht = HostTable.from_arrow(merged)
         # re-type to declared schema (decimals/dates read back as declared)
-        return _conform(ht, schema, columns)
+        out = _conform(ht, schema, columns)
+        return (out, stats) if with_stats else out
 
 
 def _to_arrow(data: HostTable):

@@ -100,6 +100,76 @@ class Exec:
     assert not rep.errors
 
 
+def test_factory_bound_local_resolution():
+    # round-12 extension: a LOCAL bound from a known factory
+    # (`c = reg.counter(...)`) resolves to the factory's return class, so
+    # calling its locking method while holding another lock records the
+    # cross-object edge — previously locals were invisible to the graph
+    src = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def inc(self):
+        with self._lock:
+            pass
+
+class MetricRegistry:
+    def counter(self, name):
+        return Counter()
+
+reg = MetricRegistry()
+
+class Exec:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def step(self):
+        c = reg.counter("x")
+        with self._mu:
+            c.inc()
+'''
+    rep = concur_check.check_fixture(src)
+    assert not rep.errors
+    assert rep.stats["edges"] == 1  # Exec._mu -> Counter._lock witnessed
+
+
+def test_factory_local_chain_through_constructor():
+    # two-hop fixpoint: local registry constructed locally, then a local
+    # counter minted from it — still resolves
+    src = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def inc(self):
+        with self._lock:
+            pass
+
+class MetricRegistry:
+    def counter(self, name):
+        return Counter()
+
+class Exec:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def step(self):
+        reg = MetricRegistry()
+        c = reg.counter("x")
+        with self._mu:
+            with c._lock:
+                pass
+'''
+    rep = concur_check.check_fixture(src)
+    assert not rep.errors
+    assert rep.stats["edges"] == 1
+
+
 def test_direct_self_nest_nonreentrant_rejected():
     src = '''
 import threading

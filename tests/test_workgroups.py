@@ -28,7 +28,7 @@ def test_create_show_drop_and_set():
     s.sql("create resource group rg1 with (concurrency_limit = 2, "
           "max_scan_rows = 1000, cpu_weight = 5)")
     rows = s.sql("show resource groups")
-    assert rows == [("rg1", 2, 1000, 0, 5, 0, 0)]
+    assert rows == [("rg1", 2, 1000, 0, 5, 0, 0, 0)]
     # information_schema surface
     r = s.sql("select name, concurrency_limit, max_scan_rows from "
               "information_schema.resource_groups").rows()

@@ -37,6 +37,15 @@ R4 swallowed-exception: in starrocks_tpu/runtime/, an `except Exception`
    injects. Deliberate swallows (liveness loops, best-effort listeners)
    stay legal via the tag, which doubles as documentation.
 
+R5 serve-query-scope: the serving tier's executor-pool worker body
+   (runtime/serving.py `_run_statement`) must execute its statement via
+   `session.sql(...)` INSIDE a `with ... query_scope(...)` block, and
+   nothing in serving.py may call the session's internal execution
+   surfaces (`_sql_inner` / `_query_planned` / `_query_admitted` /
+   `execute_logical`) directly. A statement that runs outside a
+   query_scope is invisible to SHOW PROCESSLIST, unkillable, deadline-
+   free, and unaccounted — the exact bug class thread fan-out invites.
+
 The lint also counts `fail_point()` call sites across the package and
 fails below the chaos-suite floor (MIN_FAILPOINT_SITES): fault-injection
 coverage is an invariant here, not a nice-to-have.
@@ -323,6 +332,55 @@ def lint_cache_keys() -> list:
     return findings
 
 
+SERVING_MODULE = os.path.join("starrocks_tpu", "runtime", "serving.py")
+_SESSION_INTERNALS = {"_sql_inner", "_query_planned", "_query_admitted",
+                      "execute_logical"}
+
+
+def lint_serving_scope(sources) -> list:
+    """R5: see module docstring."""
+    ms = next((m for m in sources if m.rel == SERVING_MODULE), None)
+    if ms is None:
+        return [f"{SERVING_MODULE}:1: [serve-query-scope] serving tier "
+                f"module missing (the executor pool is a tier-1 surface)"]
+    findings = []
+    run_fn = None
+    for node in ast.walk(ms.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "_run_statement":
+            run_fn = node
+        if isinstance(node, ast.Call) \
+                and _call_name(node) in _SESSION_INTERNALS:
+            findings.append(
+                f"{ms.rel}:{node.lineno}: [serve-query-scope] serving "
+                f"code must execute statements via session.sql inside a "
+                f"query_scope, never {_call_name(node)}() directly")
+    if run_fn is None:
+        findings.append(
+            f"{ms.rel}:1: [serve-query-scope] missing `_run_statement` "
+            f"worker body (the pool's single statement entry point)")
+        return findings
+    scoped_ok = False
+    for node in ast.walk(run_fn):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if not any(isinstance(i.context_expr, ast.Call)
+                   and _call_name(i.context_expr) == "query_scope"
+                   for i in node.items):
+            continue
+        inner = {_call_name(c) for b in node.body for c in ast.walk(b)
+                 if isinstance(c, ast.Call)}
+        if "sql" in inner:
+            scoped_ok = True
+    if not scoped_ok:
+        findings.append(
+            f"{ms.rel}:{run_fn.lineno}: [serve-query-scope] "
+            f"_run_statement must call session.sql(...) INSIDE `with "
+            f"query_scope(...)` — unregistered statement execution is "
+            f"unkillable, deadline-free, and unaccounted")
+    return findings
+
+
 def lint_module(ms) -> list:
     linter = Linter(ms.path, ms.rel, ms.src)
     linter.collect(ms.tree)
@@ -343,6 +401,7 @@ def main():
     for ms in sources:
         findings += lint_module(ms)
     findings += lint_cache_keys()
+    findings += lint_serving_scope(sources)
     n_fp = count_failpoints(sources)
     if n_fp < MIN_FAILPOINT_SITES:
         findings.append(
