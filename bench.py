@@ -142,6 +142,11 @@ def _bench_sql(session, text, rows_base, repeats, oracle=None, qrepeat=0):
               if k.startswith("rf_")}
         if rf:
             out["rf"] = rf
+        # join-engine effectiveness (hybrid skew lanes + multiway fusion)
+        jn = {k: int(v) for k, (v, _) in prof.counters.items()
+              if k.startswith("join_")}
+        if jn:
+            out["join"] = jn
     if qrepeat > 1:
         # cold-vs-warm through the query cache (runs AFTER the uncached
         # timings above so device_ms/compile_s stay comparable across
@@ -568,6 +573,13 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
             for k, v in (d.get("rf") or {}).items():
                 rf_totals[k] = rf_totals.get(k, 0) + v
     detail["rf_totals"] = rf_totals
+    # join-engine totals (hybrid lanes + multiway fusion) for the summary
+    join_totals: dict = {}
+    for d in detail.values():
+        if isinstance(d, dict):
+            for k, v in (d.get("join") or {}).items():
+                join_totals[k] = join_totals.get(k, 0) + v
+    detail["join_totals"] = join_totals
     # query-cache effectiveness (--repeat N): per-query cold/warm dicts sum
     # into suite totals for the summary line
     qcache_totals: dict = {}
@@ -653,6 +665,10 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         "rf_rows_pruned": rf_totals.get("rf_rows_pruned", 0),
         "rf_segments_pruned": rf_totals.get("rf_segments_pruned", 0),
         "rf_bloom_bits": rf_totals.get("rf_bloom_bits", 0),
+        "join_spilled_partitions": join_totals.get(
+            "join_spilled_partitions", 0),
+        "join_skew_keys": join_totals.get("join_skew_keys", 0),
+        "join_multiway_hits": join_totals.get("join_multiway_hits", 0),
         "verify_findings": _sr_analysis.findings_total(),
         "concur_findings": _concur_findings(),
         "qcancelled": chaos["qcancelled"],

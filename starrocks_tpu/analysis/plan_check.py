@@ -538,6 +538,82 @@ def check_distribution(plan: LogicalPlan, catalog, scan_modes: dict | None
     return findings
 
 
+# --- pass 6: multiway-join fusion invariants ----------------------------------
+
+
+def check_multiway(plan: LogicalPlan, catalog) -> list:
+    """The compiler may fuse an inner-join region into ONE multiway probe
+    (sql/physical.multiway_join_chain behind SET join_multiway_strategy).
+    Re-verify every fused level's load-bearing invariants INDEPENDENTLY of
+    the eligibility code, so a compiler-side relaxation cannot silently
+    ship a wrong fusion: each build must be provably unique on its key
+    (the dense LUT keeps ONE row per slot — duplicates would silently
+    drop matches), neither key side may be a dictionary-coded string (code
+    vs value comparison), the declared dense range must cover the build
+    key's catalog bounds, and level payloads must stay disjoint."""
+    from ..sql.optimizer import col_origin
+    from ..sql.physical import (
+        LUT_JOIN_MAX_RANGE, multiway_join_chain, unique_sets,
+    )
+
+    findings = []
+
+    def rec(p):
+        for c in p.children:
+            rec(c)
+        if not isinstance(p, LJoin):
+            return
+        try:
+            chain = multiway_join_chain(p, catalog)
+        except Exception:  # noqa: BLE001 — fuzz plans: no fusion, no finding
+            return
+        if chain is None:
+            return
+        base, levels = chain
+        seen = set(base.output_names())
+        for jn, (pk, bk, lo, hi) in levels:
+            pay = set(jn.right.output_names())
+            if seen & pay:
+                findings.append(Finding(
+                    "plan_check", "multiway-fusion", repr(p),
+                    f"fused level payload collides with earlier outputs: "
+                    f"{sorted(seen & pay)}"))
+            seen |= pay
+            if not any(u <= frozenset((bk.name,))
+                       for u in unique_sets(jn.right, catalog)):
+                findings.append(Finding(
+                    "plan_check", "multiway-fusion", repr(p),
+                    f"fused build side is not provably unique on "
+                    f"{bk.name}: the one-row-per-slot LUT would drop "
+                    f"duplicate matches"))
+            tl = _col_type(jn.left, pk.name, catalog)
+            tr = _col_type(jn.right, bk.name, catalog)
+            if (tl is not None and tl.is_string) or (
+                    tr is not None and tr.is_string):
+                findings.append(Finding(
+                    "plan_check", "multiway-fusion", repr(p),
+                    f"fused level keys {pk.name}={bk.name} involve a "
+                    f"dictionary-coded string column"))
+            if hi - lo + 1 > LUT_JOIN_MAX_RANGE:
+                findings.append(Finding(
+                    "plan_check", "multiway-fusion", repr(p),
+                    f"fused level LUT range {hi - lo + 1} exceeds the "
+                    f"planner cap {LUT_JOIN_MAX_RANGE}"))
+            origin = col_origin(jn.right, bk.name)
+            t = catalog.get_table(origin[0]) if origin else None
+            st = t.column_stats(origin[1]) if t is not None else None
+            if st is not None and st.min is not None and (
+                    st.min < lo or st.max > hi):
+                findings.append(Finding(
+                    "plan_check", "multiway-fusion", repr(p),
+                    f"dense range [{lo}, {hi}] does not cover the build "
+                    f"key's catalog bounds [{st.min}, {st.max}]: "
+                    f"out-of-range build rows would silently drop"))
+
+    rec(plan)
+    return findings
+
+
 def check_plan(plan: LogicalPlan, catalog) -> list:
     """All structural passes (distribution in managed mode — the per-query
     hook must hold for single-chip plans too, where exchanges are moot)."""
@@ -546,4 +622,5 @@ def check_plan(plan: LogicalPlan, catalog) -> list:
     findings += check_dtypes(plan, catalog)
     findings += check_capacities(plan, catalog)
     findings += check_null_semantics(plan, catalog)
+    findings += check_multiway(plan, catalog)
     return findings

@@ -91,13 +91,13 @@ def try_partial_cached(executor, plan, profile):
         from ..runtime.failpoint import fail_point
         from ..runtime.session import concat_tables
 
-        if not caps.values and bucket["last"]:
-            caps.values.update(bucket["last"])
+        executor.cache.bucket_adopt_last(bucket, caps)
         group_cap = caps.get(CAP_KEY, config.get("default_agg_groups"))
-        progs = bucket["progs"]
-        if group_cap not in progs:
-            progs[group_cap] = make_programs(bp, group_cap)
-        jpartial, jfinal = progs[group_cap]
+        pair = executor.cache.bucket_prog_get(bucket, group_cap)
+        if pair is None:  # compile outside the lock; setdefault picks winner
+            pair = executor.cache.bucket_prog_put(
+                bucket, group_cap, make_programs(bp, group_cap))
+        jpartial, jfinal = pair
 
         states, max_ng = [], 0
         hits = saved = fresh_rows = 0
@@ -131,7 +131,7 @@ def try_partial_cached(executor, plan, profile):
             if ng > group_cap:
                 # truncated state: report the overflow so _adaptive grows
                 # the capacity; segments already cached stay (they fit)
-                bucket["last"] = caps.values
+                executor.cache.bucket_last_set(bucket, caps.values)
                 return None, [(CAP_KEY, max_ng)]
             st = HostTable.from_chunk(out)
             lifecycle.account(st, "qcache::partial_segment")
@@ -145,7 +145,7 @@ def try_partial_cached(executor, plan, profile):
             merged = concat_tables(merged, st, target_schema=merged.schema)
         out, ng = jfinal(merged.to_chunk())
         ng = int(ng)
-        bucket["last"] = caps.values
+        executor.cache.bucket_last_set(bucket, caps.values)
         if lifecycle.degraded():
             p.set_info("qcache_declined", "mem-soft-degraded")
         else:

@@ -142,6 +142,153 @@ def topn_select_pallas(neg, k: int, block: int = 1024,
     return vals, idx
 
 
+# --- join hash table: open-addressing build + vectorized probe ---------------
+
+_EMPTY = (1 << 63) - 1  # int64 max: the engine-wide NULL/dead key sentinel
+
+
+def _mix64(x):
+    """splitmix64 finalizer (ops/common.mix64 inlined so the kernel body
+    stays dependency-free for Mosaic lowering)."""
+    z = jnp.asarray(x, jnp.uint64)
+    z = (z ^ (z >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return z ^ (z >> 31)
+
+
+def _hash_build_kernel(keys_ref, tkey_ref, trow_ref, *, table_size: int):
+    """Open-addressing (linear probing) hash-table BUILD over unique keys,
+    branch-free: each round every unplaced key claims its current probe
+    slot with a scatter-min of its row id; winners write (key, row) and
+    park, losers advance their displacement. Keys equal to the engine's
+    NULL/dead sentinel never insert. Termination: the table has spare
+    capacity (load factor <= 0.5), every key's probe sequence walks the
+    whole pow-2 table, and displacements only grow — the while_loop drains
+    in O(max displacement) rounds (reference analog: the linear-probing
+    insert of be/src/exec/join_hash_map.h, re-designed as data-parallel
+    claim rounds for the VPU)."""
+    import jax.numpy as jnp
+
+    keys = keys_ref[...]                       # [N] int64
+    n = keys.shape[0]
+    mask = table_size - 1
+    h = jnp.asarray(_mix64(keys.view(jnp.uint64)), jnp.int64) & mask
+    rowid = jnp.arange(n, dtype=jnp.int32)
+
+    def round_(state):
+        tkey, trow, disp, placed = state
+        slot = (h + disp) & mask
+        occupied = tkey[slot] != _EMPTY
+        want = (~placed) & (~occupied)
+        cand = jnp.where(want, slot, table_size)   # parked rows scatter-drop
+        claim = jnp.full((table_size + 1,), n, jnp.int32).at[cand].min(
+            rowid, mode="drop")
+        won = want & (claim[jnp.minimum(slot, table_size)] == rowid)
+        wslot = jnp.where(won, slot, table_size)
+        tkey = tkey.at[wslot].set(keys, mode="drop")
+        trow = trow.at[wslot].set(rowid, mode="drop")
+        placed = placed | won
+        disp = disp + jnp.where(placed, 0, 1)
+        return tkey, trow, disp, placed
+
+    init = (
+        jnp.full((table_size,), _EMPTY, jnp.int64),
+        jnp.full((table_size,), -1, jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        keys == _EMPTY,  # sentinel (NULL/dead) rows never insert
+    )
+    tkey, trow, _, _ = jax.lax.while_loop(
+        lambda s: jnp.any(~s[3]), round_, init)
+    tkey_ref[...] = tkey
+    trow_ref[...] = trow
+
+
+def hash_build_pallas(keys, table_size: int, interpret: bool = False):
+    """Build the open-addressing table for `keys` ([N] int64, unique except
+    the NULL/dead sentinel): returns (table_key [T] int64, table_row [T]
+    int32, row -1 = empty). table_size must be a power of 2 >= 2*N (load
+    factor <= 0.5 keeps expected probe chains ~1.5). Flag-gated behind
+    `SET join_probe_strategy = 'pallas'`; interpret mode off-TPU."""
+    import jax.experimental.pallas as pl
+
+    assert table_size & (table_size - 1) == 0, "table size must be pow-2"
+    assert table_size >= 2 * keys.shape[0], "load factor must be <= 0.5"
+    kernel = functools.partial(_hash_build_kernel, table_size=table_size)
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((keys.shape[0],), lambda i: (0,))],
+        out_specs=[
+            pl.BlockSpec((table_size,), lambda i: (0,)),
+            pl.BlockSpec((table_size,), lambda i: (0,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((table_size,), jnp.int64),
+            jax.ShapeDtypeStruct((table_size,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(keys)
+
+
+def _hash_probe_kernel(tkey_ref, trow_ref, probe_ref, out_ref, *,
+                       table_size: int):
+    """Vectorized linear-probing LOOKUP of one probe block against the
+    table resident in VMEM: every lane walks its probe chain in lockstep
+    until it hits its key (matched) or an empty slot (no match — open
+    addressing guarantees the chain for a key is empty-terminated).
+    Sentinel probes (NULL/dead) never match."""
+    import jax.numpy as jnp
+
+    tkey = tkey_ref[...]
+    trow = trow_ref[...]
+    probe = probe_ref[...]                     # [B] int64
+    mask = table_size - 1
+    h = jnp.asarray(_mix64(probe.view(jnp.uint64)), jnp.int64) & mask
+
+    def step(state):
+        disp, row, done = state
+        slot = (h + disp) & mask
+        k = tkey[slot]
+        hit = (~done) & (k == probe)
+        miss = (~done) & (k == _EMPTY)
+        row = jnp.where(hit, trow[slot], row)
+        return disp + 1, row, done | hit | miss
+
+    init = (
+        jnp.zeros(probe.shape, jnp.int32),
+        jnp.full(probe.shape, -1, jnp.int32),
+        probe == _EMPTY,
+    )
+    _, row, _ = jax.lax.while_loop(lambda s: jnp.any(~s[2]), step, init)
+    out_ref[...] = row
+
+
+def hash_probe_pallas(table_key, table_row, probe, block: int = 2048,
+                      interpret: bool = False):
+    """Probe the open-addressing table: returns [M] int32 matched build row
+    ids (-1 = no match). Probe blocks stream through the grid while the
+    table stays resident — one HBM pass over the probe, zero sorts
+    anywhere (the sort+searchsorted replacement of the unique join)."""
+    import jax.experimental.pallas as pl
+
+    n = probe.shape[0]
+    t = int(table_key.shape[0])
+    assert n % block == 0, f"probe {n} must be a multiple of block {block}"
+    kernel = functools.partial(_hash_probe_kernel, table_size=t)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((t,), lambda i: (0,)),
+            pl.BlockSpec((t,), lambda i: (0,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((block,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        interpret=interpret,
+    )(table_key, table_row, probe)
+
+
 # --- join probe: the searchsorted ladder as an explicit kernel ---------------
 
 
@@ -171,8 +318,8 @@ def probe_searchsorted_pallas(sorted_build, probe, block: int = 2048,
     """jnp.searchsorted(sorted_build, probe, side='left') as a Pallas grid
     kernel: the build side stays resident in VMEM while probe blocks
     stream through (one HBM pass over the probe). Flag-gated behind
-    `SET join_probe_strategy = 'pallas'` (ops/join.py) — interpret mode on
-    CPU for correctness tests, compiled on TPU."""
+    `SET join_probe_strategy = 'pallas_sorted'` (ops/join.py) — interpret
+    mode on CPU for correctness tests, compiled on TPU."""
     import jax.experimental.pallas as pl
 
     n = probe.shape[0]

@@ -355,6 +355,16 @@ def match_grace_join(plan: LogicalPlan, catalog):
 
 GRACE_GROUP_KEY = "grace_agg"
 
+# live spilled-partition gauge: incremented when a hybrid execution takes
+# ownership of its spilled partitions, decremented as each is consumed and
+# on EVERY unwind path (the chaos suite asserts it returns to zero after
+# KILL/deadline/mem-limit mid-partitioned-join)
+from .metrics import metrics as _metrics  # noqa: E402
+
+SPILL_PARTS_LIVE = _metrics.gauge(
+    "sr_tpu_join_spill_partitions_live",
+    "hybrid-join spilled partitions materialized but not yet consumed")
+
 
 def _grace_part_plan(gp: GraceJoinPlan):
     """The per-partition JOIN plan (no aggregate: groups span partitions, so
@@ -471,6 +481,16 @@ def execute_grace_join(
 
     fail_point("grace::final")
     lifecycle.checkpoint("grace::final")
+    out = _finalize_partition_outputs(gp, outs, caps, programs_cache,
+                                      checks_max)
+    return out, list(checks_max.items())
+
+
+def _finalize_partition_outputs(gp: GraceJoinPlan, outs, caps,
+                                programs_cache, checks_max: dict):
+    """Shared tail of the partitioned join executors (grace + hybrid):
+    merge the per-pass outputs and run FINAL aggregation + the top chain
+    (or just the top chain when the plan has no aggregate)."""
     if gp.agg is not None:
         merged = concat_many(outs)
         final_group_by = tuple((n, Col(n)) for n, _ in gp.agg.group_by)
@@ -489,9 +509,278 @@ def execute_grace_join(
             programs_cache[fkey] = audited_jit(final_fn, "grace_final")
         out, ng = programs_cache[fkey](merged)
         checks_max[gkey] = max(checks_max.get(gkey, 0), int(ng))
+        return out
+    return _apply_top_chain(concat_many(outs), gp.top_chain)
+
+
+# --- Hybrid skew-aware hash join: dynamic build-side partitioning -------------
+#
+# The grace path above is all-or-nothing: every row of BOTH inputs is
+# partitioned and every partition pair streams through the device, so one
+# hot key (whose rows all hash to one partition) forces the whole build
+# side through the spill loop. The hybrid executor (Design Trade-offs for a
+# Robust Dynamic Hybrid Hash Join, arXiv 2112.02480, + JSPIM's skew lanes)
+# replaces that with per-partition decisions keyed on the BUILD side:
+#
+# - heavy-hitter keys (exact partition-time top-k counts, gated by
+#   plan-time NDV/unique-key stats) route to a dedicated replicated-
+#   broadcast lane: their build rows stay device-resident while the
+#   matching probe rows stream — a hot key never inflates a partition;
+# - the remaining build hash-partitions; the LARGEST partitions stay
+#   resident together while their builds fit one batch budget;
+# - only the overflow partitions spill, each consumed as its own
+#   build-resident/probe-streamed loop.
+#
+# Probe sides always stream in batch-sized slices (soft-mem degradation
+# halves the slice mid-stream), every lane reuses ONE compiled partition
+# program, and lane sizes feed the MemoryAccountant + join_* profile
+# counters. Routing is a pure function of the key value, so each probe row
+# meets exactly the build rows with an equal key — INNER/LEFT/SEMI/ANTI
+# semantics hold per lane.
+
+
+@dataclasses.dataclass
+class HybridParts:
+    """Host routing decision of one hybrid join execution (computed once
+    per query, reused across adaptive attempts)."""
+
+    skew_keys: object   # np.ndarray of heavy-hitter key values
+    hot: tuple | None   # (probe_idx, build_idx) of the broadcast lane
+    resident: tuple | None  # (probe_idx, build_idx), builds merged on device
+    spilled: list       # [(probe_idx, build_idx), ...] overflow partitions
+    n_parts: int
+    resident_parts: int
+    lcap: int           # probe-slice capacity (shared by every lane)
+    rcap_hot: int       # broadcast-lane build capacity (0 = no hot lane)
+    rcap_cold: int      # resident/spilled build capacity — deliberately
+    # SEPARATE from the hot lane's: cold passes must not pay a compiled
+    # program sized for the heavy-hitter build (the whole point of the
+    # skew lane is that one hot key stops inflating every partition pass)
+    batch_rows: int
+
+
+def hybrid_partitions(gp: GraceJoinPlan, catalog, batch_rows: int
+                      ) -> HybridParts:
+    """Partition-time half of the hybrid join: heavy-hitter detection plus
+    build-side hash partitioning with a greedy residency budget."""
+    import numpy as np
+
+    from .config import config
+    from ..native import hash_partition_i64
+
+    fail_point("hybrid::partition")
+    lifecycle.checkpoint("hybrid::partition")
+    lht = catalog.get_table(gp.left_scan.table).table
+    rht = catalog.get_table(gp.right_scan.table).table
+    lk = np.asarray(lht.arrays[gp.probe_key], dtype=np.int64)
+    rk = np.asarray(rht.arrays[gp.build_key], dtype=np.int64)
+    kind = gp.join.kind
+
+    # heavy hitters: plan-time stats gate the exact counting scan (a build
+    # key covered by a declared unique key, or with NDV ~ row count,
+    # cannot repeat past the threshold), exact top-k counts decide
+    skew_keys = np.empty(0, np.int64)
+    handle = catalog.get_table(gp.right_scan.table)
+    ndv = handle.column_ndv(gp.build_key)
+    # only a unique key consisting of EXACTLY the join column proves the
+    # key can't repeat (a wider unique key still allows duplicates on it)
+    unique_build = any(tuple(k) == (gp.build_key,)
+                       for k in handle.unique_keys) \
+        or (ndv is not None and ndv >= 0.99 * max(len(rk), 1))
+    thresh = max(batch_rows // max(config.get("join_skew_factor"), 1), 1)
+    if not unique_build and len(rk):
+        uniq, counts = np.unique(rk, return_counts=True)
+        hot_mask = counts > thresh
+        if hot_mask.any():
+            cand, ccnt = uniq[hot_mask], counts[hot_mask]
+            top = np.argsort(ccnt, kind="stable")[::-1]
+            top = top[:max(config.get("join_skew_keys_max"), 0)]
+            skew_keys = np.sort(cand[top])
+
+    if len(skew_keys):
+        r_hot = np.isin(rk, skew_keys)
+        l_hot = np.isin(lk, skew_keys)
     else:
-        out = _apply_top_chain(concat_many(outs), gp.top_chain)
-    return out, list(checks_max.items())
+        r_hot = np.zeros(len(rk), bool)
+        l_hot = np.zeros(len(lk), bool)
+
+    # hash-partition the cold build; the probe co-partitions by the same
+    # function so routing is a pure function of the key value
+    ncold = int((~r_hot).sum())
+    n_parts = max(1, -(-ncold // batch_rows))
+    rb = hash_partition_i64(rk, n_parts)
+    lb = hash_partition_i64(lk, n_parts)
+    cold_counts = np.bincount(rb[~r_hot], minlength=n_parts)
+
+    # residency: biggest build partitions first, while they fit ONE batch
+    # budget together; partitions larger than the budget spill alone (a
+    # hash partition cannot be split further by key)
+    resident_mask = np.zeros(n_parts, bool)
+    acc = 0
+    for p in np.argsort(cold_counts, kind="stable")[::-1]:
+        c = int(cold_counts[p])
+        if c and acc + c <= batch_rows:
+            resident_mask[p] = True
+            acc += c
+
+    hot = None
+    if len(skew_keys) and l_hot.any():
+        hot = (np.flatnonzero(l_hot), np.flatnonzero(r_hot))
+
+    res_p = np.flatnonzero(resident_mask[lb] & ~l_hot)
+    res_b = np.flatnonzero(resident_mask[rb] & ~r_hot)
+    resident = None
+    if res_p.size and (res_b.size or kind in ("left", "anti")):
+        resident = (res_p, res_b)
+
+    spilled = []
+    for part in range(n_parts):
+        if resident_mask[part]:
+            continue
+        pi = np.flatnonzero((lb == part) & ~l_hot)
+        if pi.size == 0:
+            continue  # no probe rows -> no output rows, any join kind
+        bi = np.flatnonzero((rb == part) & ~r_hot)
+        if bi.size == 0 and kind not in ("left", "anti"):
+            continue  # INNER/SEMI against an empty build matches nothing
+        spilled.append((pi, bi))
+
+    rcap_hot = pad_capacity(int(hot[1].size)) if hot is not None else 0
+    cold_builds = [res_b.size if resident is not None else 0]
+    cold_builds.extend(bi.size for _, bi in spilled)
+    rcap_cold = pad_capacity(max(max(cold_builds, default=0), 1))
+    lcap = pad_capacity(max(min(batch_rows, max(len(lk), 1)), 1))
+    return HybridParts(
+        skew_keys=skew_keys, hot=hot, resident=resident, spilled=spilled,
+        n_parts=n_parts, resident_parts=int(resident_mask.sum()),
+        lcap=lcap, rcap_hot=rcap_hot, rcap_cold=rcap_cold,
+        batch_rows=batch_rows)
+
+
+def execute_hybrid_join(
+    gp: GraceJoinPlan, catalog, caps, profile_node, parts: HybridParts,
+    programs_cache, executor,
+):
+    """One adaptive attempt of the hybrid join: broadcast lane, resident
+    lane, then each spilled partition — every lane streams its probe rows
+    in batch slices against a device-resident build through ONE compiled
+    partition program; merge runs FINAL aggregation + the top chain."""
+    import numpy as np
+
+    from ..sql.physical import compile_plan
+
+    lht = catalog.get_table(gp.left_scan.table).table
+    rht = catalog.get_table(gp.right_scan.table).table
+    profile_node.set_info("hybrid_partitions", parts.n_parts)
+    profile_node.set_info("hybrid_resident", parts.resident_parts)
+    profile_node.set_info("hybrid_spilled", len(parts.spilled))
+    profile_node.set_info("hybrid_skew_keys", len(parts.skew_keys))
+
+    part_plan = _grace_part_plan(gp)
+    pgkey = GRACE_GROUP_KEY + "_partial"
+    pgcap = caps.get(pgkey, 4096) if gp.agg is not None else 0
+
+    def get_prog(rcap: int):
+        """One compiled partition program per BUILD capacity: the hot
+        lane's program is sized for the heavy-hitter build, the cold
+        lanes share a (much smaller) one — partition passes never pay
+        the hot key's capacity."""
+        prog_key = ("hybrid", part_plan, tuple(sorted(caps.values.items())),
+                    parts.lcap, rcap)
+        if prog_key not in programs_cache:
+            compiled = compile_plan(part_plan, catalog, caps,
+                                    cached_build_sort=False)
+
+            def run_part(inputs, _fn=compiled.fn):
+                c, checks = _fn(inputs)
+                if gp.agg is not None:
+                    out, ng = hash_aggregate(
+                        c, gp.agg.group_by, gp.agg.aggs, pgcap,
+                        mode=PARTIAL)
+                    checks = dict(checks)
+                    checks[pgkey] = ng
+                    return out, checks
+                return c, checks
+            programs_cache[prog_key] = (
+                audited_jit(run_part, "hybrid_part"), compiled.scans)
+        return programs_cache[prog_key]
+
+    outs = []
+    checks_max: dict = {}
+
+    def run_lane(probe_idx, build_idx, rcap: int, site: str):
+        jpart, scans = get_prog(rcap)
+        bchunk = slice_scan_chunk(rht, gp.right_scan.alias,
+                                  gp.right_scan.columns, build_idx, rcap)
+        lifecycle.account(bchunk, site)
+        total = len(probe_idx)
+        b_rows = parts.batch_rows
+        lo = 0
+        ran = False
+        while lo < total or not ran:
+            fail_point(site)
+            lifecycle.checkpoint(site)
+            hi = min(lo + b_rows, total)
+            pslice = slice_scan_chunk(lht, gp.left_scan.alias,
+                                      gp.left_scan.columns,
+                                      probe_idx[lo:hi], parts.lcap)
+            inputs = []
+            for table, alias, cols in scans:
+                if alias == gp.left_scan.alias:
+                    inputs.append(pslice)
+                elif alias == gp.right_scan.alias:
+                    inputs.append(bchunk)
+                else:  # replicated small side inside chains (not expected)
+                    inputs.append(executor.cache.chunk_for(
+                        catalog.get_table(table), alias, cols))
+            out, checks = jpart(inputs)
+            lifecycle.account(out, site)
+            outs.append(out)
+            for k, v in checks.items():
+                checks_max[k] = max(checks_max.get(k, 0), int(v))
+            lo = hi
+            ran = True
+            # soft-mem degradation: halve the remaining probe slices
+            # (smaller slices into the same compiled capacity are free)
+            if lifecycle.degraded() and b_rows > 1024:
+                b_rows = max(b_rows // 2, 1024)
+
+    empty = np.empty(0, np.int64)
+    remaining_spill = len(parts.spilled)
+    SPILL_PARTS_LIVE.inc(remaining_spill)
+    try:
+        if parts.hot is not None:
+            run_lane(parts.hot[0], parts.hot[1], parts.rcap_hot,
+                     "hybrid::broadcast_lane")
+        if parts.resident is not None:
+            run_lane(parts.resident[0], parts.resident[1], parts.rcap_cold,
+                     "hybrid::resident_lane")
+        for pi, bi in parts.spilled:
+            run_lane(pi, bi, parts.rcap_cold, "hybrid::spill_partition")
+            remaining_spill -= 1
+            SPILL_PARTS_LIVE.inc(-1)
+        if not outs:
+            # degenerate (empty inputs / all lanes skipped): one empty pass
+            # keeps the output schema + FINAL agg shape intact
+            run_lane(empty, empty, parts.rcap_cold,
+                     "hybrid::resident_lane")
+    finally:
+        # unwind (KILL/deadline/mem-limit/failpoint): unconsumed spilled
+        # partitions are released with the execution — never leaked
+        if remaining_spill:
+            SPILL_PARTS_LIVE.inc(-remaining_spill)
+
+    fail_point("hybrid::merge")
+    lifecycle.checkpoint("hybrid::merge")
+    out = _finalize_partition_outputs(gp, outs, caps, programs_cache,
+                                      checks_max)
+    checks = list(checks_max.items())
+    checks.append(("~ctr_join_skew_keys", len(parts.skew_keys)))
+    checks.append(("~ctr_join_spilled_partitions", len(parts.spilled)))
+    checks.append(("~ctr_join_resident_partitions", parts.resident_parts))
+    if parts.hot is not None:
+        checks.append(("~ctr_join_skew_probe_rows", len(parts.hot[0])))
+    return out, checks
 
 
 # --- spilled ORDER BY: device-evaluated keys, host global order ---------------

@@ -252,10 +252,47 @@ config.define("enable_sort_timing", False, True,
               "sync points: diagnostics only, keep off for benchmarks)",
               trace=True)
 config.define("join_probe_strategy", "auto", True,
-              "auto | pallas: route the unique-join probe searchsorted "
-              "ladder through the explicit Pallas kernel "
-              "(ops/pallas_kernels.probe_searchsorted_pallas; interpret "
-              "mode off-TPU) instead of jnp.searchsorted",
+              "auto | pallas | pallas_sorted: unique-join probe strategy. "
+              "pallas = open-addressing hash-table build+probe Pallas "
+              "kernels (ops/pallas_kernels.hash_build_pallas/"
+              "hash_probe_pallas — replaces sort+searchsorted entirely); "
+              "pallas_sorted = keep the sorted build but run the "
+              "searchsorted ladder as an explicit Pallas kernel; auto = "
+              "XLA jnp.searchsorted. Interpret mode off-TPU for both "
+              "kernel paths",
+              trace=True)
+config.define("join_multiway_strategy", "auto", True,
+              "auto | off: fuse a left-deep chain of 2+ unique-build "
+              "single-key LUT-eligible INNER joins (3+ tables — the "
+              "SSB/TPC-DS star shape) into ONE compiled multiway probe, "
+              "a Free-Join-style flattened trie over the shared key "
+              "columns (arXiv 2301.10841): every build side's dense LUT "
+              "probes the fact column-at-a-time, the AND-ed match mask "
+              "compacts ONCE, and payloads gather at the compacted "
+              "capacity — no per-binary-join intermediate "
+              "rematerialization. off = chained binary joins (A/B anchor)",
+              trace=True)
+config.define("join_hybrid_strategy", "auto", True,
+              "auto | grace: executor for equi joins past the spill "
+              "threshold. auto = skew-aware hybrid hash join (dynamic "
+              "build-side partitioning per arXiv 2112.02480: heavy-hitter "
+              "keys route to a replicated-broadcast lane, in-budget "
+              "partitions stay device-resident, only overflow partitions "
+              "spill; per-partition decisions feed the memory accountant "
+              "and join_* profile counters); grace = the legacy "
+              "all-or-nothing Grace partition loop (A/B anchor)",
+              trace=True)
+config.define("join_skew_factor", 8, True,
+              "hybrid-join heavy-hitter gate: a build key whose exact "
+              "partition-time row count exceeds spill-batch-rows / this "
+              "factor is routed to the broadcast lane (plan-time NDV "
+              "stats only decide whether the detection scan runs at "
+              "all). Smaller = more aggressive skew routing",
+              trace=True)
+config.define("join_skew_keys_max", 64, True,
+              "max heavy-hitter keys the hybrid join routes to its "
+              "replicated-broadcast lane (top-k by build row count; "
+              "the rest stay in hash partitions)",
               trace=True)
 config.define("compilation_cache_dir", "", False,
               "persistent XLA compilation cache directory (survives process "

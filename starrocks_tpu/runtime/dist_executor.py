@@ -97,7 +97,11 @@ class DistExecutor(Executor):
                 (k, int(np.asarray(v).max())) for k, v in checks.items()
             ]
 
-        return self._adaptive(profile, attempt)
+        def publish(vals):
+            self.cache.bucket_last_set(
+                self.cache.program_bucket(("dist", self.n, plan)), vals)
+
+        return self._adaptive(profile, attempt, publish)
 
     def _place(self, scans_meta):
         return tuple(

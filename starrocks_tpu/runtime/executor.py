@@ -54,9 +54,10 @@ class DeviceCache:
     queries overlap their XLA dispatch — two threads racing the same cold
     key may both compute, and `setdefault` under the lock picks one
     winner (a benign duplicated put, never an inconsistent map). The
-    per-plan program-bucket CONTENTS are mutated by the executor's
-    adaptive loop outside this class; entries there are keyed by caps
-    value, so the worst interleaving is a duplicated compile."""
+    per-plan program-bucket CONTENTS ("last" caps + the per-caps progs
+    map) are accessed ONLY through the locked bucket_* methods below
+    (executor and batched loops go through _BucketProgs); "last" is a
+    snapshot copy, so no live caps dict is ever aliased across threads."""
 
     MAX_CACHED_PLANS = 64
 
@@ -127,6 +128,32 @@ class DeviceCache:
             else:
                 self.programs.move_to_end(key)
             return b
+
+    # --- locked program-bucket accessors --------------------------------------
+    # The adaptive loop used to mutate bucket CONTENTS ("last" caps, the
+    # per-caps progs map) outside the lock — worst case a duplicated
+    # compile, but an unlocked mutation all the same. All bucket reads and
+    # writes now go through these methods; "last" is stored as a SNAPSHOT
+    # copy (no more cross-thread aliasing of a live caps dict).
+    def bucket_adopt_last(self, bucket, caps):
+        """Seed empty caps from the bucket's last successful capacities."""
+        with self._lock:
+            if not caps.values and bucket["last"]:
+                caps.values.update(bucket["last"])
+
+    def bucket_last_set(self, bucket, vals):
+        with self._lock:
+            bucket["last"] = dict(vals)
+
+    def bucket_prog_get(self, bucket, key):
+        with self._lock:
+            return bucket["progs"].get(key)
+
+    def bucket_prog_put(self, bucket, key, val):
+        """Insert-if-absent; returns the entry that WON (first writer) —
+        two threads racing a cold key both compile, one result is kept."""
+        with self._lock:
+            return bucket["progs"].setdefault(key, val)
 
     def opt_plan_lookup(self, key):
         with self._lock:
@@ -358,6 +385,30 @@ class DeviceCache:
         out = Chunk(Schema(tuple(fields)), tuple(data), tuple(valid), sel)
         lifecycle.account(out, "scan::chunk_to_device")
         return out
+
+
+class _BucketProgs:
+    """Locked dict-like view over one program bucket's per-key compiled
+    programs: the batched/grace/hybrid/spill loops get-or-create entries
+    through the DeviceCache lock (miss + compile run OUTSIDE the lock;
+    `__setitem__` is setdefault, so two threads racing a cold key keep one
+    winner — a benign duplicated compile, never an inconsistent map)."""
+
+    def __init__(self, cache: DeviceCache, bucket):
+        self._cache = cache
+        self._bucket = bucket
+
+    def __contains__(self, key):
+        return self._cache.bucket_prog_get(self._bucket, key) is not None
+
+    def __getitem__(self, key):
+        val = self._cache.bucket_prog_get(self._bucket, key)
+        if val is None:
+            raise KeyError(key)
+        return val
+
+    def __setitem__(self, key, val):
+        self._cache.bucket_prog_put(self._bucket, key, val)
 
 
 @dataclasses.dataclass
@@ -773,10 +824,14 @@ class Executor:
         return rec(plan)
 
     # --- execution with adaptive recompile ------------------------------------
-    def _adaptive(self, profile: RuntimeProfile, attempt_fn) -> Chunk:
+    def _adaptive(self, profile: RuntimeProfile, attempt_fn,
+                  publish=None) -> Chunk:
         """Shared overflow-recompile loop (used by single-chip + distributed).
 
         attempt_fn(caps, attempt_profile) -> (chunk, [(cap_key, true_count)]).
+        `publish(caps_values)` runs after the post-success tightening pass
+        so the bucket's "last" capacities (now a locked SNAPSHOT, no longer
+        an aliased live dict) pick the tightened values up for the next run.
         """
         caps = Caps({})
         max_recompiles = config.get("max_recompiles")
@@ -856,6 +911,8 @@ class Executor:
                                 floors.get(key, 0))
                     if tight * 2 <= caps.values.get(key, 0):
                         caps.values[key] = tight
+                if publish is not None:
+                    publish(caps.values)
                 return out
             RECOMPILES.inc()
             fail_point("executor::before_recompile")
@@ -931,7 +988,11 @@ class Executor:
             )
             return out, [(k, int(v)) for k, v in checks.items()]
 
-        return self._adaptive(profile, attempt)
+        def publish(vals):
+            self.cache.bucket_last_set(
+                self.cache.program_bucket(("local", plan)), vals)
+
+        return self._adaptive(profile, attempt, publish)
 
     def _try_partial_cache(self, plan, profile):
         """Per-segment partial-aggregation tier (cache/partial.py): for a
@@ -969,7 +1030,8 @@ class Executor:
                     cache = self.cache.program_bucket(("spillsort", plan))
                     node = profile.child("spill_sort")
                     return execute_spill_sort(
-                        sp, self.catalog, batch_rows, cache["progs"], node)
+                        sp, self.catalog, batch_rows,
+                        _BucketProgs(self.cache, cache), node)
             # spilled WINDOW: partitions hash-split to HBM-sized groups
             from .batched import execute_spill_window, match_spill_window
 
@@ -986,10 +1048,14 @@ class Executor:
                     cache = self.cache.program_bucket(("spillwin", plan))
                     node = profile.child("spill_window")
                     return execute_spill_window(
-                        wp, self.catalog, batch_rows, cache["progs"], node)
+                        wp, self.catalog, batch_rows,
+                        _BucketProgs(self.cache, cache), node)
         if bp is None:
-            # Grace join: both sides host-partitioned by the join key when
-            # either exceeds the streaming threshold
+            # partitioned join: both sides host-routed by the join key when
+            # either exceeds the streaming threshold. `join_hybrid_strategy`
+            # picks the executor: auto = skew-aware hybrid (heavy-hitter
+            # broadcast lane + resident partitions + spill-only-overflow),
+            # grace = the legacy all-or-nothing partition loop (A/B anchor)
             gp = match_grace_join(plan, self.catalog)
             if gp is None:
                 return None
@@ -999,28 +1065,40 @@ class Executor:
                 lh.row_count, rh.row_count
             ) <= batch_threshold:
                 return None
-            from .batched import grace_partitions
+            from .batched import (
+                execute_hybrid_join, grace_partitions, hybrid_partitions,
+            )
 
-            bucket = self.cache.program_bucket(("grace", plan))
-            parts = grace_partitions(gp, self.catalog, batch_rows)
+            if config.get("join_hybrid_strategy") == "grace":
+                bucket = self.cache.program_bucket(("grace", plan))
+                parts = grace_partitions(gp, self.catalog, batch_rows)
+                runner = execute_grace_join
+            else:
+                bucket = self.cache.program_bucket(("hybrid", plan))
+                parts = hybrid_partitions(gp, self.catalog, batch_rows)
+                runner = execute_hybrid_join
 
             def attempt(caps, p):
                 # adopt-last protocol (mirrors _cached_attempt): cached
                 # partition programs return checks for capacity keys that
                 # only exist in the caps they were compiled with
-                if not caps.values and bucket["last"]:
-                    caps.values.update(bucket["last"])
-                out = execute_grace_join(
-                    gp, self.catalog, caps, p, parts, bucket["progs"], self
+                self.cache.bucket_adopt_last(bucket, caps)
+                out = runner(
+                    gp, self.catalog, caps, p, parts,
+                    _BucketProgs(self.cache, bucket), self
                 )
-                bucket["last"] = caps.values
+                self.cache.bucket_last_set(bucket, caps.values)
                 return out
 
-            return self._adaptive(profile, attempt)
+            def publish(vals):
+                self.cache.bucket_last_set(bucket, vals)
+
+            return self._adaptive(profile, attempt, publish)
         handle = self.catalog.get_table(bp.scan.table)
         if handle is None or handle.row_count <= batch_threshold:
             return None
-        prog_cache = self.cache.program_bucket(("batched", plan))["progs"]
+        prog_cache = _BucketProgs(
+            self.cache, self.cache.program_bucket(("batched", plan)))
 
         def attempt(caps, p):
             return execute_batched(
@@ -1041,11 +1119,11 @@ class Executor:
         un-jitted traceable program, handed to the trace auditor on every
         fresh compile (cache hits were audited when first compiled)."""
         bucket = self.cache.program_bucket(cache_key)
-        if not caps.values and bucket["last"]:
-            # adopt the last successful capacities: skips re-discovering
-            # overflows (and usually any recompile) on repeated queries
-            caps.values.update(bucket["last"])
-        hit = bucket["progs"].get(tuple(sorted(caps.values.items())))
+        # adopt the last successful capacities: skips re-discovering
+        # overflows (and usually any recompile) on repeated queries
+        self.cache.bucket_adopt_last(bucket, caps)
+        hit = self.cache.bucket_prog_get(
+            bucket, tuple(sorted(caps.values.items())))
         raw = reads = None
         if hit is None:
             fail_point("executor::before_compile")
@@ -1072,10 +1150,11 @@ class Executor:
         if raw is not None:
             self._verify_compile(raw, inputs, reads, p)
         # caps defaults fill during the first trace; record entries after it
-        bucket["progs"].setdefault(tuple(sorted(caps.values.items())), (fn, scans))
-        # store by REFERENCE: the adaptive loop tightens over-seeded caps
-        # after a successful run, and the next execution should adopt them
-        bucket["last"] = caps.values
+        self.cache.bucket_prog_put(
+            bucket, tuple(sorted(caps.values.items())), (fn, scans))
+        # snapshot store: the adaptive loop's post-success tightening
+        # republishes via its publish callback (no live-dict aliasing)
+        self.cache.bucket_last_set(bucket, caps.values)
         return out, checks
 
 

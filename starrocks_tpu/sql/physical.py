@@ -363,6 +363,111 @@ def build_order_desc(p, catalog):
     return (p.right.table, p.right.alias, tuple(key_cols), bit_widths)
 
 
+def multiway_level(p, catalog):
+    """Eligibility of ONE join as a level of the fused multiway probe: the
+    hash_join_lut conditions — INNER, exactly one Col=Col equi key, no
+    residual conjuncts, build side provably unique on the key with a
+    stats-bounded dense range. Returns (probe_key, build_key, lo, hi) or
+    None. Shared by the compiler (fusion decision) and the plan checker
+    (analysis/plan_check.check_multiway re-verifies every fused level)."""
+    if not isinstance(p, LJoin) or p.kind != "inner" or p.condition is None:
+        return None
+    probe_keys, build_keys, residual = join_equi_keys(p)
+    if len(probe_keys) != 1 or residual:
+        return None
+    pk, bk = probe_keys[0], build_keys[0]
+    if not (isinstance(pk, Col) and isinstance(bk, Col)):
+        return None
+    bit_widths, residual, unique = choose_key_packing(
+        p, probe_keys, build_keys, [], catalog)
+    if residual or bit_widths is not None or not unique:
+        return None
+    rng = dense_rf_range(p.left, p.right, probe_keys, build_keys, catalog,
+                         max_range=LUT_JOIN_MAX_RANGE)
+    if rng is None:
+        return None
+    return pk, bk, rng[0], rng[1]
+
+
+def multiway_join_chain(p, catalog):
+    """Free-Join-style multiway fusion target (arXiv 2301.10841): an
+    inner-join REGION of 3+ relations where one fact/probe relation
+    reaches every other through single-column equi keys and every other
+    relation is a LUT-eligible unique build — the SSB/TPC-DS star shape,
+    including snowflake arms (a level keyed by a lower level's payload,
+    e.g. lineitem -> orders -> customer). The region is decomposed
+    independently of the optimizer's binary join ORDER (DP may have built
+    a bushy dim x dim plan — for inner joins any re-association that
+    consumes the same conjunct set is equivalent), which is exactly Free
+    Join's freedom to pick a variable order over the hypergraph.
+
+    Returns (base_plan, levels) with levels = [(synthesized_join_node,
+    (probe_key, build_key, lo, hi)), ...] in probe order, or None when the
+    shape doesn't qualify — any region conjunct that is not consumed as a
+    level key (residuals, composite keys, non-Col operands) falls the
+    whole region back to the binary plan, so no predicate is ever lost.
+    Gated behind `SET join_multiway_strategy = auto|off` (trace=True: the
+    decision is baked into the compiled program and keys its cache)."""
+    from ..runtime.config import config as _cfg
+
+    if _cfg.get("join_multiway_strategy") != "auto":
+        return None
+    if not isinstance(p, LJoin) or p.kind not in ("inner", "cross"):
+        return None
+    from .optimizer import _flatten_join_region
+
+    rels: list = []
+    conjuncts: list = []
+    _flatten_join_region(p, rels, conjuncts)
+    if len(rels) < 3:
+        return None
+    for c in conjuncts:
+        if not (isinstance(c, Call) and c.fn == "eq" and len(c.args) == 2
+                and isinstance(c.args[0], Col)
+                and isinstance(c.args[1], Col)):
+            return None
+    base_i = max(range(len(rels)),
+                 key=lambda i: estimate_rows(rels[i], catalog))
+    base = rels[base_i]
+    remaining = [r for i, r in enumerate(rels) if i != base_i]
+    out_sets = {id(r): frozenset(r.output_names()) for r in rels}
+    avail = set(base.output_names())
+    unused = list(conjuncts)
+    cur = base
+    levels = []
+    progress = True
+    while remaining and progress:
+        progress = False
+        for r in list(remaining):
+            rcols = out_sets[id(r)]
+            if rcols & avail:
+                return None  # ambiguous duplicate output names
+            for c in list(unused):
+                a, b = c.args
+                if a.name in avail and b.name in rcols:
+                    pk_c, bk_c = a, b
+                elif b.name in avail and a.name in rcols:
+                    pk_c, bk_c = b, a
+                else:
+                    continue
+                jn = LJoin(cur, r, "inner", Call("eq", pk_c, bk_c))
+                lev = multiway_level(jn, catalog)
+                if lev is None:
+                    continue
+                levels.append((jn, lev))
+                unused.remove(c)
+                avail |= rcols
+                remaining.remove(r)
+                cur = jn
+                progress = True
+                break
+            if progress:
+                break
+    if remaining or unused or len(levels) < 2:
+        return None
+    return base, levels
+
+
 # --- compilation -------------------------------------------------------------
 
 
@@ -622,7 +727,86 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
                 return out
             raise PlanError(f"cannot compile {type(p).__name__}")
 
+        def emit_multiway(p: LJoin, base, levels):
+            """Free-Join fused multiway probe: every level's unique build
+            scatters into a dense row LUT (a one-level trie over its key
+            column), the fact probes all LUTs column-at-a-time in ONE
+            program, the AND-ed match mask compacts ONCE, and payloads
+            gather at the compacted capacity — the vectorized analog of
+            Free Join's COLT (column-at-a-time lazy trie): no binary-join
+            intermediate is ever materialized. Snowflake keys (a level
+            keyed by a lower level's payload, e.g. o_custkey) gather just
+            that ONE key column pre-compaction."""
+            import jax.numpy as jnp
+
+            from .. import types as T
+            from ..column.column import Field, Schema
+            from ..column import Chunk
+            from ..ops.join import _I64MAX, pack_keys
+
+            lc = emit(base)
+            lc = maybe_compact(base, lc, f"{ordinal(p)}mwb")
+            sel = lc.sel_mask()
+            builds = []   # (build chunk, payload names, matched row ids)
+            src = {}      # payload column name -> index into builds
+            match_all = None
+            for jn, (pk_e, bk_e, lo, hi) in levels:
+                rc = emit(jn.right)
+                size = int(hi - lo + 1)
+                bk, b_ok = pack_keys(rc, (bk_e,))
+                idxb = jnp.where(b_ok, bk - lo, size)
+                lut = jnp.full((size,), -1, jnp.int32).at[idxb].set(
+                    jnp.arange(rc.capacity, dtype=jnp.int32), mode="drop")
+                j = src.get(pk_e.name)
+                if j is None:
+                    # key from the base fact chunk
+                    pkd, ok = pack_keys(lc, (pk_e,))
+                else:
+                    # snowflake: key gathered from a lower level's payload
+                    prc, _, prow = builds[j]
+                    i = prc.schema.index(pk_e.name)
+                    kd = jnp.asarray(prc.data[i], jnp.int64)[prow]
+                    kv = prc.valid[i]
+                    ok = sel if kv is None else (sel & kv[prow])
+                    pkd = jnp.where(ok, kd, _I64MAX)
+                idxp = pkd - lo
+                m = ok & (idxp >= 0) & (idxp < size)
+                row = lut[jnp.clip(idxp, 0, size - 1)]
+                m = m & (row >= 0)
+                row = jnp.clip(row, 0, rc.capacity - 1)
+                match_all = m if match_all is None else (match_all & m)
+                builds.append((rc, list(jn.right.output_names()), row))
+                for nm in jn.right.output_names():
+                    src[nm] = len(builds) - 1
+            checks[f"~ctr_join_multiway_hits@{ordinal(p)}"] = jnp.asarray(
+                len(levels), jnp.int64)
+            # one compaction carries the probe AND every level's row ids
+            nbase = len(lc.schema.fields)
+            wide = lc.with_columns(
+                [Field(f"__mw_{i}", T.INT, False)
+                 for i in range(len(builds))],
+                [b[2] for b in builds], [None] * len(builds))
+            wide = wide.and_sel(match_all)
+            wide = maybe_compact(p, wide, f"{ordinal(p)}mw",
+                                 est=estimate_rows(p, catalog))
+            data = list(wide.data[:nbase])
+            valid = list(wide.valid[:nbase])
+            out_fields = list(wide.schema.fields[:nbase])
+            for (rc, names, _), rowc in zip(builds, wide.data[nbase:]):
+                for nm in names:
+                    i = rc.schema.index(nm)
+                    d = rc.data[i][rowc]
+                    v = rc.valid[i]
+                    out_fields.append(rc.schema.fields[i])
+                    data.append(d)
+                    valid.append(None if v is None else v[rowc])
+            return Chunk(Schema(tuple(out_fields)), tuple(data),
+                         tuple(valid), wide.sel)
+
         def emit_join(p: LJoin):
+            chain = multiway_join_chain(p, catalog)
+            if chain is not None:
+                return emit_multiway(p, chain[0], chain[1])
             lc = emit(p.left)
             rc = emit(p.right)
             rc0 = rc  # pristine build (cached sort orders key off it)

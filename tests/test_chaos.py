@@ -533,3 +533,113 @@ def test_kill_permissions():
             s.sql("select b, sum(a) from t group by b")
     assert "cannot kill" in seen["err"] and seen["own"] is True
     _probe_correct(s)
+
+
+# --- 22: hybrid skew-aware join — zero leaked spill partitions on unwind -----
+
+
+def _mk_skew_join_session(rows: int = 400) -> Session:
+    """Two join tables sized past a 50-row threshold, the build side
+    carrying one heavy-hitter key (half its rows), so the hybrid executor
+    runs all three lanes: broadcast, resident, and spilled partitions."""
+    s = Session()
+    s.sql("create table jl (k int, v int)")
+    s.sql("create table jr (k int, w int)")
+    # jr is the SMALLER relation (so the optimizer keeps it on the build
+    # side); hot key 1 owns half of it, cold keys appear ~2x each (below
+    # the 50-row-batch skew threshold), spread over several partitions
+    lv = ", ".join(f"({i % 101}, {i})" for i in range(2 * rows))
+    rv = ", ".join(f"({1 if i % 2 else i % 101}, {i})" for i in range(rows))
+    s.sql(f"insert into jl values {lv}")
+    s.sql(f"insert into jr values {rv}")
+    return s
+
+
+_Q_HYBRID = "select sum(jl.v + jr.w) from jl, jr where jl.k = jr.k"
+
+
+def _join_counters(s: Session) -> dict:
+    out = {}
+
+    def walk(p):
+        out.update({k: v for k, (v, _) in p.counters.items()})
+        for c in p.children:
+            walk(c)
+
+    walk(s.last_profile)
+    return out
+
+
+def test_hybrid_spill_fault_leaks_no_partitions():
+    from starrocks_tpu.runtime import batched
+
+    s = _mk_skew_join_session()
+    config.set("batch_rows_threshold", 50)
+    exp = s.sql(_Q_HYBRID).rows()
+    cs = _join_counters(s)
+    assert cs.get("join_skew_keys", 0) >= 1, cs       # the lane under test
+    assert cs.get("join_spilled_partitions", 0) >= 1, cs
+    before = _leak_snapshot(s)
+    with failpoint.scoped("hybrid::spill_partition"):
+        with pytest.raises(FailPointError):
+            s.sql(_Q_HYBRID)
+    # the unwind released every materialized-but-unconsumed partition
+    assert batched.SPILL_PARTS_LIVE.value == 0
+    _assert_clean(s, before)
+    assert s.sql(_Q_HYBRID).rows() == exp
+
+
+def test_hybrid_kill_mid_broadcast_lane_unwinds_clean():
+    from starrocks_tpu.runtime import batched
+
+    s = _mk_skew_join_session()
+    config.set("batch_rows_threshold", 50)
+    exp = s.sql(_Q_HYBRID).rows()
+
+    def kill_current():
+        ctx = lifecycle.current()
+        assert ctx is not None
+        REGISTRY.cancel(ctx.qid, requester="root", admin=True)
+
+    before = _leak_snapshot(s)
+    with failpoint.scoped("hybrid::broadcast_lane", action=kill_current):
+        with pytest.raises(QueryCancelledError, match="cancelled at stage"):
+            s.sql(_Q_HYBRID)
+    assert batched.SPILL_PARTS_LIVE.value == 0
+    _assert_clean(s, before)
+    assert s.sql(_Q_HYBRID).rows() == exp
+
+
+def test_hybrid_deadline_mid_spill_partition():
+    from starrocks_tpu.runtime import batched
+
+    s = _mk_skew_join_session()
+    config.set("batch_rows_threshold", 50)
+    exp = s.sql(_Q_HYBRID).rows()
+    config.set("query_timeout_s", 0.05)
+    before = _leak_snapshot(s)
+    with failpoint.scoped("hybrid::spill_partition",
+                          action=lambda: time.sleep(0.06)):
+        with pytest.raises(QueryTimeoutError, match="query_timeout_s"):
+            s.sql(_Q_HYBRID)
+    assert batched.SPILL_PARTS_LIVE.value == 0
+    _assert_clean(s, before)
+    config.set("query_timeout_s", 0.0)
+    assert s.sql(_Q_HYBRID).rows() == exp
+
+
+def test_hybrid_mem_hard_limit_names_stage_and_frees_partitions():
+    from starrocks_tpu.runtime import batched
+
+    s = _mk_skew_join_session()
+    config.set("batch_rows_threshold", 50)
+    exp = s.sql(_Q_HYBRID).rows()
+    config.set("query_mem_limit_bytes", 1)  # any charge breaks it
+    before = _leak_snapshot(s)
+    with pytest.raises(MemLimitExceeded) as ei:
+        s.sql(_Q_HYBRID)
+    assert "at stage" in str(ei.value)
+    assert batched.SPILL_PARTS_LIVE.value == 0
+    _assert_clean(s, before)
+    config.set("query_mem_limit_bytes", 0)
+    assert s.sql(_Q_HYBRID).rows() == exp

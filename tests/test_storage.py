@@ -378,9 +378,19 @@ def test_grace_join_spill():
              "WHERE fact.k = dim.k GROUP BY w ORDER BY w")
         r = s.sql(q).rows()
         prof = s.last_profile
-        assert "grace_partitions" in prof.render(), prof.render()[:500]
+        # the partitioned-join executor fired (hybrid by default; grace is
+        # the legacy A/B anchor behind SET join_hybrid_strategy='grace')
+        assert ("hybrid_partitions" in prof.render()
+                or "grace_partitions" in prof.render()), prof.render()[:500]
         # re-execution reuses cached programs + adopted capacities
         assert s.sql(q).rows() == r
+        # forced legacy grace path agrees
+        config.set("join_hybrid_strategy", "grace")
+        try:
+            assert s.sql(q).rows() == r
+            assert "grace_partitions" in s.last_profile.render()
+        finally:
+            config.set("join_hybrid_strategy", "auto")
     finally:
         config.set("batch_rows_threshold", old_t)
         config.set("spill_batch_rows", old_b)
