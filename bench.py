@@ -147,6 +147,17 @@ def _bench_sql(session, text, rows_base, repeats, oracle=None, qrepeat=0):
               if k.startswith("join_")}
         if jn:
             out["join"] = jn
+        # fragment-IR topology + exchange volume (distributed runs only):
+        # fragments/exchanges ride profile infos, the byte/row totals are
+        # counters summed over the query's exchange edges
+        frags = prof.infos.get("fragments") if hasattr(prof, "infos") else 0
+        if frags:
+            out["fragments"] = int(frags)
+            out["exchanges"] = int(prof.infos.get("exchanges", 0))
+            out["exchange_rows"] = int(
+                prof.counters.get("exchange_rows", (0,))[0])
+            out["exchange_bytes"] = int(
+                prof.counters.get("exchange_bytes", (0,))[0])
     if qrepeat > 1:
         # cold-vs-warm through the query cache (runs AFTER the uncached
         # timings above so device_ms/compile_s stay comparable across

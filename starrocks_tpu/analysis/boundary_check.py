@@ -28,8 +28,15 @@ Semantics — longest-prefix-wins over allow ∪ forbid:
 Import-target resolution: ``from ..runtime import lifecycle`` counts as
 ``runtime.lifecycle`` when that module exists (an attribute import like
 ``from ..column import Chunk`` counts as ``column``); ``import
-starrocks_tpu.x.y`` counts as ``x.y``. External imports (jax, numpy,
-stdlib) are out of scope here.
+starrocks_tpu.x.y`` counts as ``x.y``.
+
+External imports: most (numpy, stdlib) are out of scope, but the manifest's
+``external_governed`` list names externals whose reach is part of the layer
+contract — jax (the accelerator dependency: compute layers only, so storage
+/cache/lockdep/native stay importable without an accelerator runtime) and
+socket/socketserver/http (wire protocol: the runtime service modules only).
+A governed external import must match the unit's (or module_rule's)
+``external`` allow-prefix list; nested/lazy imports count too.
 
 Standalone-loadable like concur_check (tools/ gates must not import jax
 through the package __init__).
@@ -135,6 +142,28 @@ def module_imports(ms, mod_names) -> list:
     return out
 
 
+def external_imports(ms) -> list:
+    """[(lineno, dotted external target)] for one module — absolute imports
+    that do not resolve into the package (nested function-level imports
+    included: a lazy ``import socket`` is still a socket dependency)."""
+    out = []
+    for node in ast.walk(ms.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.level or not node.module:
+                continue  # relative = internal
+            if node.module == "starrocks_tpu" or node.module.startswith(
+                    "starrocks_tpu."):
+                continue
+            out.append((node.lineno, node.module))
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "starrocks_tpu" or a.name.startswith(
+                        "starrocks_tpu."):
+                    continue
+                out.append((node.lineno, a.name))
+    return out
+
+
 def _match(target: str, prefixes) -> int:
     """Length (in segments) of the longest prefix matching target at
     dotted boundaries; -1 if none. '*' matches everything at length 0."""
@@ -173,6 +202,19 @@ def check_imports(manifest: dict, sources) -> list:
         allow = (override or rule).get("allow", [])
         forbid = (override or rule).get("forbid", [])
         scope = f"module_rules[{pkg_rel!r}]" if override else f"unit {unit!r}"
+        governed = manifest.get("external_governed", [])
+        if governed:
+            ext_allow = (override or rule).get("external", [])
+            for lineno, target in external_imports(ms):
+                if _match(target, governed) < 0:
+                    continue  # numpy/stdlib: out of contract scope
+                if _match(target, ext_allow) < 0:
+                    findings.append(Finding(
+                        "error", "external-import", f"{ms.rel}:{lineno}",
+                        f"governed external {target!r} is not allow-listed "
+                        f"for {scope}: add it to the manifest's 'external' "
+                        f"list (a reviewed contract change) or drop the "
+                        f"dependency"))
         for lineno, target in module_imports(ms, mod_names):
             a = _match(target, allow)
             f = _match(target, forbid)

@@ -399,10 +399,17 @@ def check_distribution(plan: LogicalPlan, catalog, scan_modes: dict | None
     managed_exchanges=True verifies that the plan ADMITS a legal lowering
     (the compiler inserts shuffles/gathers where needed — only structurally
     illegal combinations flag). managed_exchanges=False verifies a DECLARED
-    physical plan with no implicit exchanges: any partition-sensitive op
-    whose operands are not already aligned is a finding — the golden-fixture
-    surface for plans that would compute per-shard garbage."""
-    from ..sql.distributed import REPLICATED, SHARDED, plan_scan_modes
+    physical plan with NO implicit exchanges: every repartition must appear
+    as an explicit LExchange node (the fragment IR produced by
+    sql/fragments.py), and any partition-sensitive op whose operands are not
+    aligned by placement or by a declared exchange is a finding. The pass
+    checks the DECLARATIONS — it never re-runs the compiler's placement
+    simulation, so a compiler bug that emits a wrong exchange set surfaces
+    here instead of being mirrored."""
+    from ..sql.distributed import (
+        RANGE_SHARDED, REPLICATED, SHARDED, plan_scan_modes,
+    )
+    from ..sql.logical import LExchange
     from ..sql.physical import join_equi_keys
 
     if scan_modes is None:
@@ -417,6 +424,36 @@ def check_distribution(plan: LogicalPlan, catalog, scan_modes: dict | None
         return mode != REPLICATED
 
     def rec(p):
+        if isinstance(p, LExchange):
+            m = rec(p.child)
+            if not managed_exchanges:
+                # declaration consistency: kind must support the declared
+                # post-exchange placement
+                if p.kind in ("broadcast", "gather") and p.mode != REPLICATED:
+                    findings.append(Finding(
+                        "plan_check", "distribution", repr(p),
+                        f"{p.kind} exchange declares non-replicated output "
+                        f"mode {p.mode!r}"))
+                if p.kind == "hash" and not (
+                        p.mode == SHARDED or hash_col(p.mode) is not None):
+                    findings.append(Finding(
+                        "plan_check", "distribution", repr(p),
+                        f"hash exchange declares output mode {p.mode!r} "
+                        f"(expected sharded or a hash-placement token)"))
+                if p.kind == "hash" and not p.keys:
+                    findings.append(Finding(
+                        "plan_check", "distribution", repr(p),
+                        "hash exchange declares no partition keys"))
+                if p.kind == "range" and p.mode != RANGE_SHARDED:
+                    findings.append(Finding(
+                        "plan_check", "distribution", repr(p),
+                        f"range exchange declares output mode {p.mode!r}"))
+                if not is_dist(m):
+                    findings.append(Finding(
+                        "plan_check", "distribution", repr(p),
+                        "exchange over an already-replicated input (dead "
+                        "data movement)", severity="warn"))
+            return p.mode
         if isinstance(p, LScan):
             mode = scan_modes.get(id(p), REPLICATED)
             hc = hash_col(mode)
@@ -437,11 +474,14 @@ def check_distribution(plan: LogicalPlan, catalog, scan_modes: dict | None
                         m = ("hash", n)
                         break
             return m
-        if isinstance(p, LFilter):
-            return rec(p.child)
+        if isinstance(p, (LFilter, LUnnest)):
+            return rec(p.child)  # mode passthrough (unnest appends a column)
         if isinstance(p, LJoin):
             lm = rec(p.left)
             rm = rec(p.right)
+            # joins reorder rows: range order is lost, placement survives
+            lm = SHARDED if lm == RANGE_SHARDED else lm
+            rm = SHARDED if rm == RANGE_SHARDED else rm
             if not is_dist(lm) and not is_dist(rm):
                 return REPLICATED
             try:
@@ -454,6 +494,33 @@ def check_distribution(plan: LogicalPlan, catalog, scan_modes: dict | None
                 and any(isinstance(pk, Col) and isinstance(bk, Col)
                         and pk.name == lhc and bk.name == rhc
                         for pk, bk in zip(probe_keys, build_keys)))
+            if not colocated and not managed_exchanges:
+                # a declared hash exchange can align a side beyond what the
+                # ("hash", col) placement token expresses: shuffling by the
+                # full equated key tuple (or by the single key equated to
+                # the other side's placement column) keeps matching rows
+                # together even when keys are expressions or multi-column
+                lex = p.left if isinstance(p.left, LExchange) else None
+                rex = p.right if isinstance(p.right, LExchange) else None
+
+                def pos_of(mode, keys_):
+                    hc = hash_col(mode)
+                    return {i for i, k in enumerate(keys_)
+                            if isinstance(k, Col) and k.name == hc}
+
+                lpos, rpos = pos_of(lm, probe_keys), pos_of(rm, build_keys)
+                if lex is not None and lex.kind == "hash" and rpos:
+                    colocated = any(tuple(lex.keys) == (probe_keys[i],)
+                                    for i in rpos)
+                if not colocated and rex is not None and rex.kind == "hash" \
+                        and lpos:
+                    colocated = any(tuple(rex.keys) == (build_keys[i],)
+                                    for i in lpos)
+                if not colocated and lex is not None and rex is not None \
+                        and lex.kind == "hash" and rex.kind == "hash":
+                    colocated = bool(probe_keys) and (
+                        tuple(lex.keys) == tuple(probe_keys)
+                        and tuple(rex.keys) == tuple(build_keys))
             if managed_exchanges:
                 # the compiler can always legalize: broadcast the build,
                 # or hash-shuffle both sides on the equi keys (needs at
@@ -486,8 +553,22 @@ def check_distribution(plan: LogicalPlan, catalog, scan_modes: dict | None
             if not is_dist(m):
                 return REPLICATED
             hc = hash_col(m)
-            keys = {e.name for _, e in p.group_by if isinstance(e, Col)}
-            aligned = hc is not None and hc in keys
+            # placement tokens name CHILD-scope columns when they come from
+            # a scan/join placement, but OUTPUT group names when a declared
+            # exchange moves PARTIAL states (keyed by the agg's own output
+            # columns) — accept either scope
+            child_keys = {e.name for _, e in p.group_by
+                          if isinstance(e, Col)}
+            out_keys = {n for n, _ in p.group_by}
+            aligned = hc is not None and hc in (child_keys | out_keys)
+            ex = p.child if isinstance(p.child, LExchange) else None
+            if not aligned and ex is not None and ex.kind == "hash":
+                # multi-key shuffle of partial states: placed on the FULL
+                # group key tuple => every group on exactly one shard
+                knames = {k.name for k in ex.keys if isinstance(k, Col)}
+                aligned = (len(knames) == len(ex.keys)
+                           and bool(knames)
+                           and knames <= (child_keys | out_keys))
             if managed_exchanges:
                 return SHARDED if p.group_by else REPLICATED
             if not aligned:
@@ -504,24 +585,75 @@ def check_distribution(plan: LogicalPlan, catalog, scan_modes: dict | None
                         "plan_check", "distribution", repr(p),
                         f"non-decomposable aggregate {n}={a.fn} over a "
                         f"sharded input requires an exchange"))
-            return SHARDED if p.group_by else REPLICATED
-        if isinstance(p, (LSort, LWindow)):
+            if not p.group_by:
+                return REPLICATED
+            # propagate the colocate placement on the OUTPUT group name so
+            # a parent join/agg can prove alignment without an exchange
+            if hc is not None and hc in out_keys:
+                return ("hash", hc)
+            if hc is not None and hc in child_keys:
+                out_n = next((n for n, e in p.group_by
+                              if isinstance(e, Col) and e.name == hc), None)
+                if out_n is not None:
+                    return ("hash", out_n)
+            return SHARDED
+        if isinstance(p, LWindow):
+            m = rec(p.child)
+            if not is_dist(m):
+                return REPLICATED
+            hc = hash_col(m)
+            aligned = hc is not None and any(
+                isinstance(e, Col) and e.name == hc for e in p.partition_by)
+            ex = p.child if isinstance(p.child, LExchange) else None
+            if not aligned and ex is not None and ex.kind == "hash":
+                aligned = tuple(ex.keys) == tuple(p.partition_by)
+            if managed_exchanges:
+                return SHARDED
+            if not aligned:
+                findings.append(Finding(
+                    "plan_check", "distribution", repr(p),
+                    "LWindow is partition-sensitive (partitions must be "
+                    "shard-local) but its sharded input is not placed on "
+                    "the partition keys and no exchange precedes it"))
+            return m if aligned else SHARDED
+        if isinstance(p, LSort):
             m = rec(p.child)
             if not is_dist(m):
                 return REPLICATED
             if managed_exchanges:
                 return SHARDED
+            if m == RANGE_SHARDED:
+                # range-exchanged input: local sorts concatenate into
+                # global order; verify the exchange ranges on the leading
+                # sort key
+                ex = p.child if isinstance(p.child, LExchange) else None
+                if ex is not None and tuple(ex.keys) != (p.keys[0][0],):
+                    findings.append(Finding(
+                        "plan_check", "distribution", repr(p),
+                        f"range exchange partitions by {ex.keys!r}, not "
+                        f"the leading sort key {p.keys[0][0]!r}"))
+                return RANGE_SHARDED
             findings.append(Finding(
                 "plan_check", "distribution", repr(p),
-                f"{type(p).__name__} is partition-sensitive but consumes a "
-                f"sharded input with no declared exchange"))
+                "LSort is partition-sensitive but consumes a sharded "
+                "input with no declared exchange"))
             return SHARDED
         if isinstance(p, LLimit):
-            rec(p.child)
+            m = rec(p.child)
+            if not managed_exchanges and is_dist(m):
+                findings.append(Finding(
+                    "plan_check", "distribution", repr(p),
+                    "LIMIT over a sharded input with no declared gather "
+                    "exchange (per-shard limits are not the global limit)"))
             return REPLICATED  # the compiler always gathers at LIMIT
         if isinstance(p, LUnion):
             for c in p.inputs:
-                rec(c)
+                m = rec(c)
+                if not managed_exchanges and is_dist(m):
+                    findings.append(Finding(
+                        "plan_check", "distribution", repr(p),
+                        "UNION branch stays sharded with no declared "
+                        "gather exchange"))
             return REPLICATED
         if p.children:
             for c in p.children:

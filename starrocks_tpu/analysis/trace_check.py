@@ -14,6 +14,14 @@ a value is "shard-variant" when it depends on a sharded input (or
 axis_index) and has not passed through an all-reduce (psum/pmax/pmin) or
 all_gather. A `~ctr_` output that is shard-variant would be max-merged by
 the host into ONE shard's count — the exact round-6 review bug.
+
+Cross-process merge invariant: an all-reduce with `axis_index_groups` is
+invariant only WITHIN each device subgroup. On a multi-process mesh the
+subgroups land on different processes, so a counter merged with a grouped
+psum still holds a per-process partial — a later host sum across processes
+then double-counts or drops groups. Counters must psum over the FULL
+intra-slice axis before any host merge; grouped reductions are flagged as
+`subgroup-psum-counter`.
 """
 
 from __future__ import annotations
@@ -172,9 +180,19 @@ def _check_counters(jaxpr, counter_indices) -> list:
             if pos >= len(body.outvars):
                 continue
             if eqn.primitive.name == "shard_map":
-                tainted = _shard_taint(body, eqn)
+                tainted, grouped = _shard_taint(body, eqn)
                 bv = body.outvars[pos]
-                if not _is_literal(bv) and bv in tainted:
+                if not _is_literal(bv) and bv in grouped:
+                    findings.append(Finding(
+                        "trace_check", "subgroup-psum-counter",
+                        f"outvar[{idx}]",
+                        "profile counter merged with a GROUPED all-reduce "
+                        "(axis_index_groups): each process subgroup keeps "
+                        "its own partial, so a host merge across processes "
+                        "reports one group's value — psum over the full "
+                        "intra-slice axis before any cross-process host "
+                        "merge"))
+                elif not _is_literal(bv) and bv in tainted:
                     findings.append(Finding(
                         "trace_check", "non-psum-counter",
                         f"outvar[{idx}]",
@@ -188,8 +206,15 @@ def _check_counters(jaxpr, counter_indices) -> list:
 
 
 def _shard_taint(body, eqn):
-    """Variables in a shard_map body whose value may DIFFER across shards."""
+    """Variables in a shard_map body whose value may DIFFER across shards.
+
+    Returns (tainted, grouped): `tainted` is the plain shard-variance set;
+    `grouped` ⊆ tainted marks values whose only merge was a grouped
+    all-reduce (axis_index_groups) — per-subgroup partials that a host
+    merge across processes would mis-aggregate.
+    """
     tainted = set()
+    grouped = set()
     in_names = eqn.params.get("in_names")
     if in_names is None:
         in_names = [{} for _ in body.invars]
@@ -203,14 +228,24 @@ def _shard_taint(body, eqn):
             tainted.update(sub_eqn.outvars)
             continue
         if name in _SHARD_INVARIANT_PRIMS:
-            continue  # outputs identical across shards
+            if sub_eqn.params.get("axis_index_groups") is not None:
+                # grouped all-reduce: invariant only WITHIN each subgroup;
+                # across processes each group keeps its own partial
+                if any(not _is_literal(v) and v in tainted
+                       for v in sub_eqn.invars):
+                    tainted.update(sub_eqn.outvars)
+                    grouped.update(sub_eqn.outvars)
+            continue  # full-axis all-reduce: identical across shards
         # jax literals (constants) are shard-invariant and unhashable —
         # only proper Vars participate in the taint set
         if any(not _is_literal(v) and v in tainted for v in sub_eqn.invars):
             # conservative: any tainted operand taints every output
             # (incl. through pjit/scan/while/cond sub-calls)
             tainted.update(sub_eqn.outvars)
-    return tainted
+            if any(not _is_literal(v) and v in grouped
+                   for v in sub_eqn.invars):
+                grouped.update(sub_eqn.outvars)
+    return tainted, grouped
 
 
 def _is_literal(v) -> bool:

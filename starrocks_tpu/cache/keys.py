@@ -55,6 +55,29 @@ def fragment_key(agg, scan_chain, scan) -> tuple:
             registry_epoch())
 
 
+def fragment_program_key(n_shards: int, plan, frag) -> tuple:
+    """Program-bucket key of ONE fragment of a fragment-IR plan. The whole
+    logical plan pins the query shape; the fingerprint pins the fragment's
+    identity WITHIN it: its ordinal, the declared placements (its own
+    out_mode plus the boundary mode each upstream feed arrives in), the
+    sink flag, and the output-edge exchange declaration. Placement is part
+    of the key — not just the fid — because the recorder may legally emit
+    a different exchange plan for the same subtree when scan layouts
+    change (e.g. a table re-bucketed onto a new hash column flips an edge
+    from colocated to shuffled), and a program compiled for the old
+    placement must miss, not serve. Trace knobs and the UDF epoch join in
+    DeviceCache.program_bucket, the shared entry point."""
+    ex = frag.exchange
+    placement = (
+        frag.out_mode,
+        tuple(sorted(
+            (slot, mode) for slot, mode in frag.boundary.values())),
+        frag.sink,
+        None if ex is None else (ex.kind, ex.payload, ex.out_mode),
+    )
+    return ("frag", n_shards, plan, frag.fid, placement)
+
+
 def segment_version(store, table: str, fmeta: dict):
     """Identity token of one manifest data file, or None when the file is
     unreadable (a vanished segment is never cached against). Rowset files

@@ -334,9 +334,12 @@ def _f_materialize(cc, a):
 
 @function("host_name")
 def _f_host_name(cc):
-    import socket
+    # platform.node() == uname nodename: same value as gethostname()
+    # without pulling socket into the expression layer (the boundary
+    # manifest reserves sockets for the runtime service modules)
+    import platform
 
-    return _const_str(cc, socket.gethostname())
+    return _const_str(cc, platform.node())
 
 
 @function("current_timezone")

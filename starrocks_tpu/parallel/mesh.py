@@ -39,11 +39,37 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
 
 
 def make_mesh(n_devices: int | None = None, axis: str = DATA_AXIS) -> Mesh:
+    """Mesh over the first n global devices. Under jax.distributed,
+    jax.devices() spans every process (4 local CPU devices x 2 processes =
+    8 global), so the same call builds the multi-process DCN mesh — the
+    caller only ever sees one axis of n shards."""
     devs = jax.devices()
     n = n_devices or len(devs)
     if len(devs) < n:
         raise ValueError(f"need {n} devices, have {len(devs)}")
     return Mesh(np.array(devs[:n]), (axis,))
+
+
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh's devices live in more than one process — host
+    transfers must then go through make_array_from_callback (each process
+    materializes only its addressable shards) instead of device_put."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def put_global(x, sharding):
+    """Place a host array onto a (possibly multi-process) sharding.
+
+    Single-process: plain device_put. Multi-process: the callback path —
+    jax invokes it once per LOCAL device with that shard's global index
+    range, so each process materializes only its slice of the table (the
+    per-process TabletStore slice; remote shards are never built here).
+    """
+    arr = np.asarray(x)
+    if not mesh_spans_processes(sharding.mesh):
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx, a=arr: a[idx])
 
 
 def shard_host_table(table, mesh: Mesh, axis: str = DATA_AXIS) -> Chunk:

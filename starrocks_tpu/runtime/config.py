@@ -182,6 +182,12 @@ config.define("bitmap_default_domain", 65536, True,
               "(values outside [0, domain) are dropped like the reference's "
               "non-uint32 to_bitmap inputs)",
               trace=True)
+config.define("dist_fragments", True, True,
+              "execute distributed plans as fragment-IR programs (one "
+              "shard_map program per fragment, explicit exchange edges, "
+              "declared placements verified by plan_check) instead of one "
+              "monolithic SPMD program (the pre-IR A/B anchor)",
+              trace=True)
 config.define("enable_mv_rewrite", True, True,
               "transparently rewrite queries onto FRESH matching "
               "materialized views (SPJG containment; sql/mv_rewrite.py)")

@@ -294,7 +294,11 @@ class DeviceCache:
             sharding = NamedSharding(mesh, spec)
 
             def put(x):
-                return jax.device_put(x, sharding)
+                # multi-process meshes route through the callback path so
+                # each process materializes only its addressable shards
+                from ..parallel.mesh import put_global
+
+                return put_global(x, sharding)
 
         n = ht.num_rows
         cap_key = (handle.name, tag)
@@ -571,9 +575,12 @@ class Executor:
             return
         report(check_opt_reads(reads), profile, where="optimize")
 
-    def _verify_compile(self, raw_fn, inputs, reads, profile):
+    def _verify_compile(self, raw_fn, inputs, reads, profile,
+                        extra_args=()):
         """Fresh-compile verification: program cache-key completeness from
-        the recorded knob read-set, plus the jaxpr trace audit."""
+        the recorded knob read-set, plus the jaxpr trace audit. extra_args
+        ride along for programs with secondary inputs (fragment boundary
+        chunks)."""
         from ..analysis import report, verify_level
         from ..analysis.key_check import check_trace_reads
 
@@ -583,7 +590,8 @@ class Executor:
         if config.get("plan_verify_trace"):
             from ..analysis import trace_check
 
-            findings += trace_check.audit_program(raw_fn, inputs)
+            findings += trace_check.audit_program(raw_fn, inputs,
+                                                  extra_args)
         report(findings, profile, where="compile")
 
     # --- group_concat orchestration -------------------------------------------

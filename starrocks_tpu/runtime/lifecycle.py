@@ -116,6 +116,14 @@ class QueryContext:
         self._cancel_reason = reason
         return True
 
+    def cancelled(self) -> bool:
+        """True once a kill has been requested (it lands at the next
+        checkpoint; queued statements are reaped by their waiter)."""
+        return self._cancel_reason is not None
+
+    def cancel_reason(self):
+        return self._cancel_reason
+
     def nudge(self, reason: str) -> bool:
         """Soft-degrade hint (any thread): same graceful-degradation path a
         crossed soft memory limit takes — cache admission declines, spill
@@ -391,6 +399,18 @@ def account(obj, stage: str):
         ACCOUNTANT.charge(ctx, n, stage)
 
 
+def finalize_queued(ctx: QueryContext):
+    """Unwind a pre-registered context whose statement was removed from
+    the pool queue by a KILL before any worker adopted it: same terminal
+    bookkeeping as a cancelled query_scope exit (state, counter, cleanup
+    stack, accountant, registry), run by the waiting connection thread."""
+    ctx.state = "cancelled"
+    QUERIES_CANCELLED.inc()
+    ctx.run_cleanups()
+    ACCOUNTANT.release_query(ctx)
+    REGISTRY.deregister(ctx)
+
+
 def degraded() -> bool:
     """True when the active query crossed its soft memory limit: callers
     degrade gracefully (decline cache admission, shrink batch capacity)."""
@@ -400,17 +420,27 @@ def degraded() -> bool:
 
 @contextlib.contextmanager
 def query_scope(sql: str, user: str = "root", group: str | None = None,
-                group_limit: int = 0):
+                group_limit: int = 0, ctx: QueryContext | None = None):
     """Enter a query lifecycle scope. Re-entrant: nested statements (MV
     refresh bodies, INSERT..SELECT subqueries) ride the outer query's
-    context — its deadline and kill cover the whole statement tree."""
+    context — its deadline and kill cover the whole statement tree.
+
+    `ctx` adopts a context the serving tier pre-registered at pool
+    ENQUEUE (stage serve::queued): the statement was already killable
+    while waiting for a worker, and its queue wait counts against the
+    deadline. A kill that landed while queued raises at entry, before
+    any engine code runs."""
     outer = current()
     if outer is not None:
         yield outer
         return
-    ctx = REGISTRY.register(QueryContext(sql, user, group, group_limit))
+    adopted = ctx is not None
+    if not adopted:
+        ctx = REGISTRY.register(QueryContext(sql, user, group, group_limit))
     _tls.ctx = ctx
     try:
+        if adopted:
+            ctx.check("serve::start")
         yield ctx
         if ctx.state == "running":
             ctx.state = "done"

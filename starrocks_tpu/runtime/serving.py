@@ -20,7 +20,11 @@ session lock — added cores bought zero QPS. Now:
   Every worker body runs inside `lifecycle.query_scope` — the statement
   is registered (SHOW PROCESSLIST / KILL), deadline-armed, and memory-
   accounted BEFORE any engine code runs; tools/src_lint.py R5 pins this
-  statically (no unregistered statement execution).
+  statically (no unregistered statement execution). Registration happens
+  at ENQUEUE (stage `serve::queued`), so KILL QUERY reaches statements
+  still waiting for a pool slot: the waiting connection thread reaps a
+  killed queued work itself; once a worker claims it, the adopted
+  context kills it at the first checkpoint.
 
 - **StatementGate**: queries take the SHARED side and overlap freely
   (planning, host orchestration, XLA dispatch); catalog-mutating
@@ -157,6 +161,10 @@ class _Work:
         default_factory=threading.Event)
     result: object = None
     error: BaseException | None = None
+    # lifecycle context registered at ENQUEUE (stage serve::queued) so
+    # KILL QUERY reaches statements still waiting for a pool slot; the
+    # worker adopts it via query_scope(ctx=...)
+    ctx: object = None
 
     def eff(self, now: float, aging: float) -> float:
         if aging > 0:
@@ -184,14 +192,41 @@ class ExecutorPool:
 
     def submit(self, session: Session, sql: str, exclusive: bool,
                prio: float) -> _Work:
+        from . import lifecycle
+
+        # register for KILL/PROCESSLIST at ENQUEUE, not worker start: a
+        # statement stuck behind a saturated pool is already visible and
+        # killable (its queue wait also counts against the deadline)
+        group_limit = 0
+        if session.resource_group:
+            g = session.workgroups().get(session.resource_group)
+            if g is not None:
+                group_limit = g.mem_limit_bytes
+        ctx = lifecycle.QueryContext(sql, user=session.current_user,
+                                     group=session.resource_group,
+                                     group_limit=group_limit)
+        ctx.last_stage = "serve::queued"
+        lifecycle.REGISTRY.register(ctx)
         with self._lock:
             if self._shutdown:
+                lifecycle.REGISTRY.deregister(ctx)
                 raise RuntimeError("serving tier is shut down")
             w = _Work(session, sql, exclusive, prio, next(self._seq),
-                      time.monotonic())
+                      time.monotonic(), ctx=ctx)
             self._queue.append(w)
             self._lock.notify()
             return w
+
+    def abandon(self, w: _Work) -> bool:
+        """Remove a still-queued work (KILL landed while it waited for a
+        slot). False once a worker has claimed it — the kill then lands
+        at the worker's first lifecycle checkpoint instead."""
+        with self._lock:
+            try:
+                self._queue.remove(w)
+            except ValueError:
+                return False
+            return True
 
     def pending(self) -> int:
         with self._lock:
@@ -251,7 +286,7 @@ class ExecutorPool:
             SERVE_EXCLUSIVE.inc()
         with lifecycle.query_scope(w.sql, user=sess.current_user,
                                    group=sess.resource_group,
-                                   group_limit=group_limit):
+                                   group_limit=group_limit, ctx=w.ctx):
             with gate_side:
                 w.result = sess.sql(w.sql)
 
@@ -294,7 +329,20 @@ class ServingTier:
                 prio = float(g.priority)
         w = self.pool.submit(session, sqln, not _is_read_statement(sqln),
                              prio)
-        w.done.wait()
+        from . import lifecycle
+
+        # the wait doubles as the queued-kill reaper: if a KILL lands
+        # while the work still sits in the pool queue, pull it out and
+        # unwind here — the victim must not wait for a worker to free up
+        # just to die (NEXT 7f)
+        while not w.done.wait(0.05):
+            ctx = w.ctx
+            if (ctx is not None and ctx.cancelled()
+                    and self.pool.abandon(w)):
+                lifecycle.finalize_queued(ctx)
+                raise lifecycle.QueryCancelledError(
+                    f"query {ctx.qid} cancelled at stage 'serve::queued': "
+                    f"{ctx.cancel_reason()}")
         # surface the tier's last profile for the /profile endpoint
         # (best-effort: concurrent statements race benignly)
         if session.last_profile is not None:

@@ -52,7 +52,7 @@ from ..parallel.mesh import DATA_AXIS
 from .analyzer import _conjuncts
 from .logical import (
     LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion,
-    LUnnest, LWindow, LogicalPlan,
+    LUnnest, LWindow, LogicalPlan, walk_plan,
 )
 from .optimizer import and_all
 from .physical import Caps, PlanError, _equi_pair, _key_bit_width, unique_sets
@@ -188,13 +188,34 @@ def _hash_col(mode):
 def compile_distributed(
     plan: LogicalPlan, catalog, caps: Caps, n_shards: int,
     axis: str = DATA_AXIS, scan_modes: dict | None = None,
+    recorder=None, fragment=None,
 ) -> DistCompiled:
+    """recorder: optional fragments.ExchangeRecorder — `note`d immediately
+    before every collective with the plan edge it implements (the fragment-IR
+    annotation source; zero drift from the lowering by construction).
+    fragment: optional fragments.Fragment — compile only the subtree rooted
+    at fragment.root, resolving fragment.boundary nodes from the extra `bnd`
+    argument of step instead of emitting them (the per-fragment program)."""
     scan_modes = scan_modes or plan_scan_modes(plan, catalog)
     scans: list = []
     node_ord: dict = {}
+    # deterministic pre-order ordinals: capacity/check keys (shufL_3,
+    # agg_5, ...) must be identical whether the plan compiles as one
+    # monolithic program or one fragment at a time — fragments share the
+    # adaptive capacity state and the partial-state cache under these keys
+    for _n in walk_plan(plan):
+        node_ord.setdefault(_n, len(node_ord))
 
     def ordinal(p) -> int:
         return node_ord.setdefault(p, len(node_ord))
+
+    if recorder is not None:
+        note = recorder.note
+    else:
+        def note(*a, **k):
+            return None
+
+    root_node = plan if fragment is None else fragment.root
 
     scan_index: dict = {}
     scan_mode_list: list = []
@@ -215,10 +236,12 @@ def compile_distributed(
             return chunk
         return all_gather_chunk(chunk, axis)  # range- and hash-sharded alike
 
-    def step(inputs):
+    def step(inputs, bnd=()):
         """Traced SPMD program; all mutable trace state lives inside (see
         compile_plan) so cached jitted versions retrace safely. Overflow
-        checks return as {key: [1]-array} merged across shards by the host."""
+        checks return as {key: [1]-array} merged across shards by the host.
+        `bnd` carries fragment-boundary chunks (upstream fragment outputs)
+        positionally; empty for monolithic compiles."""
         emit_memo: dict = {}
         checks: dict = {}
 
@@ -230,6 +253,13 @@ def compile_distributed(
             return out
 
         def _emit(p):
+            if fragment is not None and p in fragment.boundary:
+                # fragment edge: the subtree below p ran in an upstream
+                # fragment; resume from its output in the recorded mode
+                # (checked FIRST so the sink fragment — root == plan ∈
+                # boundary — resolves to the boundary, not a re-emission)
+                slot, bmode = fragment.boundary[p]
+                return bnd[slot], bmode
             if isinstance(p, LScan):
                 i = scan_index[id(p)]
                 return inputs[i], scan_mode_list[i]
@@ -268,14 +298,23 @@ def compile_distributed(
                     kcap = pad_capacity(k)
                     if kcap < c.capacity:
                         c, _ = compact(c, kcap)  # live <= k: no overflow
+                if _is_dist(m):
+                    note(p, 0, p.child, "gather", (), REPLICATED, "limit",
+                         m, c)
                 return limit_chunk(gather(c, m), p.limit, p.offset), REPLICATED
             if isinstance(p, LUnion):
                 from ..ops.setops import union_all
 
                 out, m = emit(p.inputs[0])
+                if _is_dist(m):
+                    note(p, 0, p.inputs[0], "gather", (), REPLICATED,
+                         "rows", m, out)
                 out = gather(out, m)
-                for child in p.inputs[1:]:
+                for i, child in enumerate(p.inputs[1:], start=1):
                     c2, m2 = emit(child)
+                    if _is_dist(m2):
+                        note(p, i, child, "gather", (), REPLICATED,
+                             "rows", m2, c2)
                     out = union_all(out, gather(c2, m2))
                 return out, REPLICATED
             if isinstance(p, LAggregate):
@@ -311,6 +350,9 @@ def compile_distributed(
                 return out
 
             if not p.partition_by or not _is_dist(m):
+                if _is_dist(m):
+                    note(p, 0, p.child, "gather", (), REPLICATED,
+                         "rows", m, c)
                 c = gather(c, m)
                 return win(c, False), REPLICATED
             hc = _hash_col(m)
@@ -321,14 +363,16 @@ def compile_distributed(
             )
             out_mode = m if aligned else SHARDED
             if not aligned:
+                if len(p.partition_by) == 1 and isinstance(p.partition_by[0], Col):
+                    out_mode = ("hash", p.partition_by[0].name)
                 key = f"win_{ordinal(p)}"
                 bcap = caps.get(key, _default_bucket_cap(c.capacity, n_shards))
+                note(p, 0, p.child, "hash", tuple(p.partition_by), out_mode,
+                     "rows", m, c)
                 c, mxb = shuffle_chunk(
                     c, tuple(p.partition_by), axis, n_shards, bcap
                 )
                 checks[key] = mxb[None]
-                if len(p.partition_by) == 1 and isinstance(p.partition_by[0], Col):
-                    out_mode = ("hash", p.partition_by[0].name)
             return win(c, True), out_mode
 
         def emit_sort(p: LSort):
@@ -351,16 +395,21 @@ def compile_distributed(
                 kcap = pad_capacity(p.limit)
                 if kcap < local.capacity:
                     local, _ = compact(local, kcap)  # live<=limit: no overflow
+                note(p, 0, p.child, "gather", (), REPLICATED, "topn",
+                     m, local)
                 gathered = all_gather_chunk(local, axis)
                 return sort_chunk(gathered, p.keys, p.limit), REPLICATED
             rank = _single_sort_rank(c, p.keys)
             if rank is None:
+                note(p, 0, p.child, "gather", (), REPLICATED, "rows", m, c)
                 return sort_chunk(gather(c, m), p.keys, None), REPLICATED
             # full distributed sort: range exchange by sampled splitters,
             # then local sort — shards end range-ordered, so the final
             # tiled all_gather concatenates into global order
             key = f"sort_{ordinal(p)}"
             bcap = caps.get(key, _default_bucket_cap(c.capacity, n_shards))
+            note(p, 0, p.child, "range", (p.keys[0][0],), RANGE_SHARDED,
+                 "rows", m, c)
             part, mxb = range_partition_chunk(c, rank, axis, n_shards, bcap)
             checks[key] = mxb[None]
             return sort_chunk(part, p.keys, None), RANGE_SHARDED
@@ -407,6 +456,7 @@ def compile_distributed(
                 # holistic aggregates (percentile family) need every group
                 # value in one place and the input is not colocated on the
                 # group keys: gather rows, aggregate COMPLETE.
+                note(p, 0, p.child, "gather", (), REPLICATED, "rows", m, c)
                 gathered = all_gather_chunk(c, axis)
                 kwargs = {}
                 if any(a.fn == "array_agg" for _, a in p.aggs):
@@ -435,6 +485,14 @@ def compile_distributed(
                     bkey, pad_capacity(max(cap // max(n_shards // 2, 1), 16))
                 )
                 key_cols = tuple(Col(n) for n, _ in p.group_by)
+                # output is hash-placed on the (single) group column's
+                # values with the standard shuffle recipe -> colocate-able
+                out_mode = (
+                    ("hash", p.group_by[0][0]) if len(p.group_by) == 1
+                    else SHARDED
+                )
+                note(p, 0, p.child, "hash", key_cols, out_mode, "partial",
+                     m, part)
                 merged, mxb = shuffle_chunk(part, key_cols, axis, n_shards, bcap)
                 checks[bkey] = mxb[None]
                 # final capacity = received capacity: group count there is
@@ -443,16 +501,11 @@ def compile_distributed(
                     merged, final_group_by, final_agg_exprs(p.aggs),
                     n_shards * bcap, mode=FINAL,
                 )
-                # output is hash-placed on the (single) group column's
-                # values with the standard shuffle recipe -> colocate-able
-                out_mode = (
-                    ("hash", p.group_by[0][0]) if len(p.group_by) == 1
-                    else SHARDED
-                )
                 return out, out_mode
             # two-phase: local partial -> all_gather -> final
             cap = caps.get(key, agg_default)
             part, png = hash_aggregate(c, p.group_by, p.aggs, cap, mode=PARTIAL)
+            note(p, 0, p.child, "gather", (), REPLICATED, "partial", m, part)
             merged = all_gather_chunk(part, axis)
             out, ng = hash_aggregate(
                 merged, final_group_by, final_agg_exprs(p.aggs), cap, mode=FINAL
@@ -474,6 +527,10 @@ def compile_distributed(
         def emit_join(p: LJoin):
             lc, lm = emit(p.left)
             rc, rm = emit(p.right)
+            # pre-degrade modes: what emit(child) actually returned — the
+            # fragment-boundary mode a consumer fragment resumes with (it
+            # re-applies the degrade/claim-drop rules below itself)
+            lm0, rm0 = lm, rm
             # joins reorder rows: a range-ordered input degrades to plain
             # sharded (placement survives, global ordering does not)
             lm = SHARDED if lm == RANGE_SHARDED else lm
@@ -502,6 +559,8 @@ def compile_distributed(
                 if _is_dist(lm) and _is_dist(rm):
                     # shuffling a constant key would funnel everything onto one
                     # shard; gather the build side and cross-join locally
+                    note(p, 1, p.right, "broadcast", (), REPLICATED,
+                         "rows", rm0, rc)
                     rc = all_gather_chunk(rc, axis)
                     rm = REPLICATED
             else:
@@ -536,6 +595,8 @@ def compile_distributed(
                         if ((pe.dict is not None or be.dict is not None)
                                 and not (isinstance(pk_x, Col)
                                          and isinstance(bk_x, Col))):
+                            note(p, 1, p.right, "broadcast", (), REPLICATED,
+                                 "rows", rm0, rc)
                             rc = all_gather_chunk(rc, axis)
                             rm = REPLICATED
                             break
@@ -617,6 +678,13 @@ def compile_distributed(
                     checks[key_name] = mx[None]
                     return out
 
+                def shuf_mode(keys_):
+                    # post-shuffle placement: hash-placed on the single Col
+                    # key (colocate token) or plain sharded otherwise
+                    if len(keys_) == 1 and isinstance(keys_[0], Col):
+                        return ("hash", keys_[0].name)
+                    return SHARDED
+
                 # colocate when both sides sit on the same equated pair; a
                 # single aligned side pulls the other to ITS placement
                 # (shuffle by just the equated column); else shuffle both
@@ -624,13 +692,23 @@ def compile_distributed(
                 if li is not None and ri == li:
                     anchor = li
                 elif li is not None:
-                    rc = shuffle_side(rc, [build_keys[li]], f"shufR_{ordinal(p)}")
+                    ks = [build_keys[li]]
+                    note(p, 1, p.right, "hash", tuple(ks), shuf_mode(ks),
+                         "rows", rm0, rc)
+                    rc = shuffle_side(rc, ks, f"shufR_{ordinal(p)}")
                     anchor = li
                 elif ri is not None:
-                    lc = shuffle_side(lc, [probe_keys[ri]], f"shufL_{ordinal(p)}")
+                    ks = [probe_keys[ri]]
+                    note(p, 0, p.left, "hash", tuple(ks), shuf_mode(ks),
+                         "rows", lm0, lc)
+                    lc = shuffle_side(lc, ks, f"shufL_{ordinal(p)}")
                     anchor = ri
                 else:
+                    note(p, 0, p.left, "hash", tuple(probe_keys),
+                         shuf_mode(probe_keys), "rows", lm0, lc)
                     lc = shuffle_side(lc, probe_keys, f"shufL_{ordinal(p)}")
+                    note(p, 1, p.right, "hash", tuple(build_keys),
+                         shuf_mode(build_keys), "rows", rm0, rc)
                     rc = shuffle_side(rc, build_keys, f"shufR_{ordinal(p)}")
                     anchor = 0 if len(probe_keys) == 1 else None
                 if anchor is not None and isinstance(probe_keys[anchor], Col):
@@ -638,6 +716,8 @@ def compile_distributed(
                 else:
                     out_mode = SHARDED
             elif _is_dist(rm):  # probe replicated, build sharded -> gather build
+                note(p, 1, p.right, "broadcast", (), REPLICATED,
+                     "rows", rm0, rc)
                 rc = all_gather_chunk(rc, axis)
                 out_mode = REPLICATED if lm == REPLICATED else lm
             else:
@@ -692,11 +772,15 @@ def compile_distributed(
                 out = filter_chunk(out, and_all(residual))
             return out, out_mode
 
-        chunk, mode = emit(plan)
-        if mode != REPLICATED:
+        chunk, mode = emit(root_node)
+        if mode != REPLICATED and (fragment is None or fragment.sink):
+            # result delivery: the coordinator gather (sink fragments only —
+            # interior fragments hand their sharded output to the consumer)
+            note(None, 0, root_node, "gather", (), REPLICATED, "rows",
+                 mode, chunk)
             chunk = all_gather_chunk(chunk, axis)
         return chunk, checks
 
     return DistCompiled(
-        step, scans, scan_mode_list, None, plan.output_names(), n_shards
+        step, scans, scan_mode_list, None, root_node.output_names(), n_shards
     )

@@ -183,6 +183,48 @@ class LLimit(LogicalPlan):
         return f"Limit[{self.limit} offset {self.offset}]"
 
 
+@dataclasses.dataclass(frozen=True)
+class LExchange(LogicalPlan):
+    """Explicit repartition boundary — the fragment-IR edge (reference:
+    the FE's ExchangeNode between plan fragments, fe
+    sql/plan/PlanFragment + qe scheduler). The node DECLARES the data
+    movement the consumer requires; the distributed compiler lowers it
+    to the matching in-mesh collective (all_to_all hash shuffle,
+    all_gather broadcast/gather, range exchange by sampled splitters),
+    and analysis/plan_check.py verifies the declarations instead of
+    re-simulating the compiler (`managed_exchanges=False`).
+
+    kind:    "hash" | "broadcast" | "gather" | "range"
+    keys:    partition keys (exprs) for hash/range kinds; () otherwise
+    mode:    declared POST-exchange placement token — "sharded",
+             "replicated", "range_sharded", or ("hash", col)
+    payload: what representation crosses the wire — "rows" for plain row
+             chunks, "partial" for partial aggregation states, "topn" /
+             "limit" for pre-truncated row sets. Exchanges that move a
+             derived payload sit at the operator boundary whose lowering
+             performs them (e.g. a two-phase aggregate's shuffle of
+             PARTIAL states is declared between child and aggregate).
+    """
+
+    child: LogicalPlan
+    kind: str
+    keys: tuple = ()
+    mode: object = "sharded"
+    payload: str = "rows"
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def output_names(self):
+        return self.child.output_names()
+
+    def __repr__(self):
+        ks = f" by {list(self.keys)}" if self.keys else ""
+        pl = f" payload={self.payload}" if self.payload != "rows" else ""
+        return f"Exchange[{self.kind}{ks} -> {self.mode}{pl}]"
+
+
 def plan_tree_str(p: LogicalPlan, indent: int = 0) -> str:
     """EXPLAIN-style tree rendering (golden-plan test surface)."""
     s = "  " * indent + repr(p) + "\n"

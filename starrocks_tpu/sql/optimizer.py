@@ -26,8 +26,8 @@ import dataclasses
 from ..exprs.ir import AggExpr, Call, Case, Cast, Col, Expr, InList, Lambda, Lit
 from .analyzer import ScalarSubquery, SemiJoinMark, _conjuncts
 from .logical import (
-    LAggregate, LFilter, LJoin, LLimit, LProject, LScan, LSort, LUnion,
-    LUnnest, LWindow, LogicalPlan, walk_plan,
+    LAggregate, LExchange, LFilter, LJoin, LLimit, LProject, LScan, LSort,
+    LUnion, LUnnest, LWindow, LogicalPlan, walk_plan,
 )
 
 
@@ -1063,6 +1063,10 @@ def _filter_selectivity(pred, child, catalog) -> float:
 
 
 def estimate_rows(plan: LogicalPlan, catalog) -> float:
+    if isinstance(plan, LExchange):
+        # repartition moves rows, it doesn't create or drop them — stats
+        # walkers see through annotated (fragment-IR) plans unchanged
+        return estimate_rows(plan.child, catalog)
     if isinstance(plan, LScan):
         t = catalog.get_table(plan.table)
         return float(t.row_count if t is not None else 1000)
@@ -1315,7 +1319,7 @@ def col_origin(plan, name: str):
         if alias == plan.alias and base in plan.columns:
             return plan.table, base
         return None
-    if isinstance(plan, (LFilter, LSort, LLimit, LWindow)):
+    if isinstance(plan, (LFilter, LSort, LLimit, LWindow, LExchange)):
         return col_origin(plan.child, name)
     if isinstance(plan, LProject):
         for n, e in plan.exprs:

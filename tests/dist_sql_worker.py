@@ -1,0 +1,96 @@
+"""Worker process for the cross-process fragment-IR SQL test.
+
+Launched twice by tests/test_dist_fragments.py. Each process joins the
+global mesh via jax.distributed (2 processes x 4 virtual CPU devices = 8
+global shards; on TPU pods the same code spans hosts over DCN), builds
+the SAME deterministic TPC-H catalog, and runs one SQL statement through
+the fragment-IR executor:
+
+    sharded lineitem scan -> hash-partition exchange (shuffle-final
+    aggregation by l_orderkey) -> TopN gather
+
+Placement goes through make_array_from_callback, so each process
+materializes only ITS shards of the table (the per-process TabletStore
+slice); the hash exchange and the runtime counters' psums run over the
+full 8-shard axis, crossing the process boundary on gloo (the CPU
+stand-in for DCN). Both processes must agree with a host-side numpy
+oracle computed from the full table.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+
+def main():
+    pid = int(sys.argv[1])
+    coord = sys.argv[2]  # jax.distributed coordinator addr
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from starrocks_tpu.runtime.cluster import init_multihost
+
+    devices = init_multihost(coord, num_processes=2, process_id=pid,
+                             local_device_count=4)
+    assert len(devices) == 8, devices
+
+    import starrocks_tpu.sql.distributed as D
+
+    # tiny tables must still take the distributed path, and the multi-key
+    # group-by must take the shuffle-final (hash exchange) strategy
+    D.SHARD_THRESHOLD_ROWS = 10_000
+    D.SHUFFLE_AGG_MIN_GROUPS = 100
+
+    from starrocks_tpu.parallel.mesh import mesh_spans_processes
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.session import Session
+    from starrocks_tpu.storage.catalog import tpch_catalog
+
+    cat = tpch_catalog(sf=0.01)
+    sess = Session(cat, dist_shards=8)
+    sql = ("select l_suppkey, l_linestatus, sum(l_quantity) q "
+           "from lineitem group by l_suppkey, l_linestatus "
+           "order by q desc, l_suppkey, l_linestatus limit 5")
+
+    def run(fragments):
+        config.set("dist_fragments", fragments)
+        rs = sess.sql(sql)
+        return [list(r.values()) if isinstance(r, dict) else list(r)
+                for r in rs.rows()]
+
+    rows = run(True)
+    rows_mono = run(False)  # pre-IR monolithic program, same global mesh
+    config.set("dist_fragments", True)
+    ok = rows == rows_mono and len(rows) == 5
+
+    # host-side oracle: the global sum must cover EVERY process's rows
+    # (a per-process partial would be ~half of it)
+    total = sess.sql("select sum(l_quantity) t from lineitem").rows()
+    tv = list(total[0].values())[0] if isinstance(total[0], dict) \
+        else total[0][0]
+    ht = cat.get_table("lineitem").table
+    expected_total = float(np.asarray(
+        ht.arrays["l_quantity"], dtype=np.float64).sum())
+    ok = ok and np.isclose(float(tv), expected_total)
+
+    de = sess._dist_executor
+    spans = mesh_spans_processes(de.mesh)
+    kinds = sorted({ev.kind for (ir, _) in de._frag_ir_memo.values()
+                    for ev in ir.events})
+    nfrag = max(len(ir.fragments)
+                for (ir, _) in de._frag_ir_memo.values())
+    print(f"proc {pid}: sql ok={ok} spans_processes={spans} "
+          f"exchange_kinds={kinds} fragments={nfrag} rows={rows}",
+          flush=True)
+    if not (ok and spans and "hash" in kinds and nfrag >= 2):
+        sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

@@ -379,6 +379,39 @@ def test_module_rule_override_tighter_than_unit():
     assert any(f.rule == "undeclared-import" for f in fs)
 
 
+def test_governed_external_rejected_outside_allow_list():
+    # sockets are service-layer-only; a storage module opening one fails
+    manifest = {
+        "external_governed": ["jax", "socket"],
+        "units": {"ops": {"allow": ["ops"], "external": ["jax"]},
+                  "column": {"allow": ["column"], "external": ["jax"]},
+                  "runtime": {"allow": ["*"], "external": ["jax", "socket"]}},
+    }
+    srcs = _fixture_sources(
+        ("starrocks_tpu/ops/leaky.py",
+         "def f():\n    import socket\n    return socket.gethostname()\n"))
+    fs = boundary_check.check_imports(manifest, srcs)
+    assert any(f.rule == "external-import" and "'socket'" in f.message
+               for f in fs), fs
+    # jax is allow-listed for ops: no finding
+    srcs = _fixture_sources(
+        ("starrocks_tpu/ops/fine.py", "from jax.sharding import Mesh\n"))
+    assert not boundary_check.check_imports(manifest, srcs)
+
+
+def test_real_manifest_governs_externals():
+    m = boundary_check.load_manifest()
+    assert "socket" in m["external_governed"]
+    assert "jax" in m["external_governed"]
+    # sockets are granted ONLY via service-module pins, never unit-wide
+    for unit, rule in m["units"].items():
+        assert "socket" not in rule.get("external", []), unit
+    assert "socket" in m["module_rules"]["runtime/mysql_service.py"][
+        "external"]
+    # the static gates stay stdlib-only, externally too
+    assert m["module_rules"]["analysis/boundary_check.py"]["external"] == []
+
+
 # --- the real package must hold its own contract -------------------------------
 
 def test_package_concur_strict_clean():

@@ -1,0 +1,209 @@
+"""Fragment IR: golden plan shapes, declared-placement verification,
+byte-identity with the monolithic SPMD path, and a REAL two-process mesh
+running a hash-partition exchange through SQL.
+
+The tentpole contract: physical plans split at repartition boundaries
+into fragments whose edges are explicit Exchange nodes (the reference's
+PlanFragment/ExchangeNode pair); each fragment compiles as its own
+program with a DECLARED placement that analysis/plan_check.py verifies
+(managed_exchanges=False) instead of re-simulating the compiler; and with
+`SET dist_fragments = false` the pre-IR monolithic program remains the
+byte-identity anchor.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import starrocks_tpu.sql.distributed as D
+from starrocks_tpu.analysis import plan_check
+from starrocks_tpu.runtime.config import config
+from starrocks_tpu.runtime.session import Session
+from starrocks_tpu.sql.distributed import REPLICATED, SHARDED
+from starrocks_tpu.sql.logical import LExchange, walk_plan
+from starrocks_tpu.storage.catalog import tpch_catalog
+
+from tpch_queries import QUERIES
+
+
+@pytest.fixture(scope="module")
+def sess(eight_devices):
+    old = D.SHARD_THRESHOLD_ROWS
+    old_sh = D.SHUFFLE_AGG_MIN_GROUPS
+    D.SHARD_THRESHOLD_ROWS = 10_000  # SF0.01: lineitem+orders(>=15k) shard
+    D.SHUFFLE_AGG_MIN_GROUPS = 4_000
+    yield Session(tpch_catalog(sf=0.01), dist_shards=8)
+    D.SHARD_THRESHOLD_ROWS = old
+    D.SHUFFLE_AGG_MIN_GROUPS = old_sh
+
+
+def _ir_of(sess, sql):
+    """Run `sql` in fragment mode and return its FragmentIR."""
+    if sess.__dict__.get("_dist_executor"):
+        sess._dist_executor._frag_ir_memo.clear()
+    config.set("dist_fragments", True)
+    sess.sql(sql)
+    de = sess._dist_executor
+    new = list(de._frag_ir_memo.values())
+    assert len(new) == 1, "expected exactly one new fragment IR"
+    ir, _scans = new[0]
+    return ir
+
+
+def _kinds(ir):
+    return [(ev.kind, ev.payload) for ev in ir.events]
+
+
+# --- golden plan shapes -------------------------------------------------------
+
+
+def test_scan_only_fragments(sess):
+    """Sharded filter-scan: one interior fragment + the coordinator-gather
+    sink — the minimal two-fragment plan."""
+    ir = _ir_of(sess, "select l_orderkey, l_quantity from lineitem "
+                      "where l_quantity < 3")
+    assert len(ir.fragments) == 2
+    assert _kinds(ir) == [("gather", "rows")]
+    interior, sink = ir.fragments
+    assert not interior.sink and interior.deps == ()
+    assert sink.sink and sink.deps == (interior.fid,)
+    assert sink.out_mode == REPLICATED
+    assert ir.events[0].out_mode == REPLICATED
+
+
+def test_hash_join_fragments(sess):
+    """Q18: the semi-join's build side hash-repartitions ROWS onto the
+    probe's placement — the shuffle-join exchange — then the TopN gathers."""
+    ir = _ir_of(sess, QUERIES[18])
+    assert len(ir.fragments) == 3
+    assert _kinds(ir) == [("hash", "rows"), ("gather", "topn")]
+    shuffle = ir.events[0]
+    assert shuffle.out_mode == ("hash", "orders.o_orderkey")
+    assert shuffle.keys, "hash exchange must declare its partition keys"
+    assert ir.fragments[-1].sink
+
+
+def test_broadcast_join_fragments(sess):
+    """Q10: the smaller sharded build side broadcasts (all-gather) to
+    every shard instead of repartitioning both sides."""
+    ir = _ir_of(sess, QUERIES[10])
+    assert len(ir.fragments) == 3
+    assert _kinds(ir) == [("broadcast", "rows"), ("gather", "partial")]
+    assert ir.events[0].out_mode == REPLICATED
+
+
+def test_shuffle_agg_fragments(sess):
+    """Multi-key high-cardinality group-by: partial agg states hash-
+    partition by the group keys (shuffle-final aggregation)."""
+    old = D.SHUFFLE_AGG_MIN_GROUPS
+    D.SHUFFLE_AGG_MIN_GROUPS = 100
+    try:
+        ir = _ir_of(
+            sess,
+            "select l_suppkey, l_linestatus, sum(l_quantity) q "
+            "from lineitem group by l_suppkey, l_linestatus "
+            "order by q desc, l_suppkey limit 5")
+    finally:
+        D.SHUFFLE_AGG_MIN_GROUPS = old
+    assert _kinds(ir) == [("hash", "partial"), ("gather", "topn")]
+    assert ir.events[0].out_mode == SHARDED  # multi-key: no single token
+    assert len(ir.events[0].keys) == 2
+
+
+def test_annotated_plan_passes_declared_check(sess):
+    """The annotated plan (explicit LExchange edges) must verify in
+    DECLARED mode: plan_check checks the declarations instead of
+    re-simulating the compiler's exchange decisions."""
+    for sql in (QUERIES[10], QUERIES[18]):
+        ir = _ir_of(sess, sql)
+        n_ex = len({id(n) for n in walk_plan(ir.annotated)
+                    if isinstance(n, LExchange)})
+        assert n_ex == len(ir.events)
+        findings = plan_check.check_distribution(
+            ir.annotated, sess.catalog, managed_exchanges=False)
+        errs = [f for f in findings if f.severity == "error"]
+        assert errs == [], [str(f) for f in errs]
+
+
+# --- byte identity with the monolithic pre-IR path ----------------------------
+
+
+@pytest.mark.parametrize("qid", [1, 3, 10, 18])
+def test_fragment_rows_byte_identical_to_monolithic(sess, qid):
+    config.set("dist_fragments", True)
+    rf = sess.sql(QUERIES[qid]).rows()
+    try:
+        config.set("dist_fragments", False)
+        rm = sess.sql(QUERIES[qid]).rows()
+    finally:
+        config.set("dist_fragments", True)
+    assert len(rf) == len(rm)
+    for a, b in zip(rf, rm):
+        va = list(a.values()) if isinstance(a, dict) else list(a)
+        vb = list(b.values()) if isinstance(b, dict) else list(b)
+        assert va == vb  # exact, not approx: same ops in the same order
+
+
+def test_fragment_stats_on_profile(sess):
+    config.set("dist_fragments", True)
+    sess.sql(QUERIES[10])
+    prof = sess.last_profile
+    assert prof.infos.get("fragments", 0) >= 3
+    assert prof.infos.get("exchanges", 0) >= 2
+    assert prof.counters.get("exchange_rows", (0,))[0] > 0
+    assert prof.counters.get("exchange_bytes", (0,))[0] > 0
+
+
+# --- two REAL processes: hash exchange over the global mesh -------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_fragment_sql():
+    """Spawns two processes that join one global mesh (jax.distributed
+    over gloo — the CPU stand-in for DCN) and run the SAME SQL through
+    the fragment executor: per-process table slices placed with
+    make_array_from_callback, a hash-partition exchange and the counter
+    psums crossing the process boundary in-program."""
+    coord = f"127.0.0.1:{_free_port()}"
+    worker = os.path.join(os.path.dirname(__file__), "dist_sql_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        for pid in (0, 1)
+    ]
+    outs, rcs = [], []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+        rcs.append(p.returncode)
+    joined = "\n".join(outs)
+    if any(rc != 0 for rc in rcs) and (
+        "Multiprocess computations aren't implemented" in joined
+        or "multiprocess computations" in joined.lower()
+    ):
+        # this jaxlib build ships without the gloo CPU collective backend
+        # (an environment property, not a code regression)
+        pytest.skip("jaxlib lacks CPU multiprocess (gloo) collectives")
+    for out, rc in zip(outs, rcs):
+        assert rc == 0, out[-2000:]
+    assert "sql ok=True" in joined
+    assert "spans_processes=True" in joined

@@ -162,6 +162,41 @@ def test_psum_counter_clean(eight_devices):
         findings
 
 
+# --- golden fixture 3b: counter merged only within axis subgroups ------------
+
+
+def _grouped_counter_program():
+    """A counter psum'd with axis_index_groups: merged WITHIN each 4-device
+    subgroup only. On a 2-process mesh the subgroups are the per-process
+    slices, so the host merge across processes keeps one group's partial —
+    the cross-process merge invariant violation."""
+    from starrocks_tpu.parallel.mesh import make_mesh, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(8)
+    groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def step(x):
+        local = jnp.sum(x)
+        ctr = jax.lax.psum(local, "d", axis_index_groups=groups)
+        return {"~ctr_rows_pruned@0": ctr[None]}
+
+    return shard_map(step, mesh=mesh, in_specs=(P("d"),), out_specs=P("d")), \
+        jnp.ones((64,), jnp.int64)
+
+
+def test_subgroup_psum_counter_rejected(eight_devices):
+    raw, x = _grouped_counter_program()
+    findings = trace_check.audit_program(raw, x)
+    errs = _errors(findings)
+    assert any(f.invariant == "subgroup-psum-counter" for f in errs), findings
+    # the grouped merge must NOT also satisfy the plain psum check
+    assert not [f for f in findings if f.invariant == "non-psum-counter"], \
+        findings
+    with pytest.raises(VerifyError):
+        report(findings, level="strict")
+
+
 def test_distributed_corpus_counters_clean(eight_devices, catalog):
     """The REAL distributed compiler's counters must audit clean (they
     psum on sharded stages by construction)."""

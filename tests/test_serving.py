@@ -448,3 +448,72 @@ def test_rss_probe_caches_between_intervals_and_accounted_still_wins():
 
 def test_real_statm_reader_reports_positive_rss():
     assert lifecycle._read_statm_rss() > 0
+
+
+# --- KILL of a POOL-queued statement (NEXT 7f) --------------------------------
+
+
+def test_pool_queued_statement_is_registered_and_killable():
+    """A statement waiting for an executor-pool slot (every worker busy)
+    must already be visible at stage serve::queued and die on KILL
+    without ever reaching a worker."""
+    s = _mk_session()
+    s.sql("""create function pool_nap(a bigint) returns bigint as '
+import time
+def pool_nap(a):
+    time.sleep(0.1)
+    return a
+'""")
+    reg_before = len(REGISTRY.snapshot())
+    tier = ServingTier(s, pool_size=1)
+    try:
+        results: dict = {}
+
+        def run(tag, sql):
+            sess = tier.new_session()
+            try:
+                results[tag] = tier.execute(sess, sql)
+            except BaseException as e:  # noqa: BLE001 — recorded for asserts
+                results[tag + "_err"] = e
+
+        ta = threading.Thread(
+            target=run, args=("a", "select max(pool_nap(a)) from t"))
+        ta.start()
+        # wait until A occupies the single worker (state running, past
+        # the queued stage)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = [r for r in REGISTRY.snapshot() if "pool_nap" in r[-1]]
+            if snap and snap[0][-2] != "serve::queued":
+                break
+            time.sleep(0.005)
+        tb = threading.Thread(
+            target=run, args=("b", "select min(pool_nap(b)) from t"))
+        tb.start()
+        # B must appear in PROCESSLIST at stage serve::queued while it
+        # waits for the (saturated) pool — the round-13 gap: it was
+        # invisible and unkillable until a worker picked it up
+        qid_b = None
+        while qid_b is None and time.monotonic() < deadline:
+            queued = [r for r in REGISTRY.snapshot()
+                      if "min(pool_nap" in r[-1]
+                      and r[-2] == "serve::queued"]
+            if queued:
+                qid_b = queued[0][0]
+            time.sleep(0.005)
+        assert qid_b is not None, "pool-queued statement never registered"
+        assert REGISTRY.cancel(qid_b) is True
+        assert REGISTRY.kill_result() == "delivered"
+        tb.join(timeout=10)
+        assert not tb.is_alive()
+        err = results.get("b_err")
+        assert isinstance(err, lifecycle.QueryCancelledError), err
+        ta.join(timeout=10)
+        assert not ta.is_alive()
+        assert "a" in results  # the running statement finishes untouched
+        # unwind is complete: no registry entries, no queue leftovers
+        assert len(REGISTRY.snapshot()) == reg_before
+        assert tier.pool.pending() == 0
+    finally:
+        tier.shutdown()
+        s.sql("drop function pool_nap")

@@ -118,6 +118,94 @@ def lint_corpus(which: str = "all", verbose: bool = False,
     return 1 if errors else 0
 
 
+def _rows_equal(a, b):
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        va = list(ra.values()) if isinstance(ra, dict) else list(ra)
+        vb = list(rb.values()) if isinstance(rb, dict) else list(rb)
+        if va != vb:
+            return False
+    return True
+
+
+def lint_fragments(which: str = "all", verbose: bool = False) -> int:
+    """Fragment-IR corpus pass: every query runs on the 8-shard mesh in
+    fragment mode under strict verification (declared-placement check of
+    the annotated plan + trace audit of every fragment program), then
+    again through the monolithic pre-IR program — rows must be
+    byte-identical (same ops in the same order, not approximately equal).
+    """
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                               + os.environ.get("XLA_FLAGS", ""))
+    import logging
+
+    from starrocks_tpu import analysis
+    from starrocks_tpu.analysis import VerifyError
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.session import Session
+    import starrocks_tpu.sql.distributed as D
+
+    handler = logging.StreamHandler(sys.stderr)
+    analysis.logger.addHandler(handler)
+    analysis.logger.setLevel(logging.WARNING)
+
+    # corpus scale factors are tiny; force the distributed path anyway
+    D.SHARD_THRESHOLD_ROWS = 10_000
+    D.SHUFFLE_AGG_MIN_GROUPS = 4_000
+    config.set("plan_verify_level", "strict")
+    if not config.get("compilation_cache_dir"):
+        config.set("compilation_cache_dir", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".xla_cache"), force=True)
+
+    t0 = time.time()
+    n_queries = errors = mismatches = 0
+    tot_frags = tot_exchanges = 0
+    for suite, catalog, queries in _suites(which):
+        sess = Session(catalog, dist_shards=8)
+        for name, text in queries.items():
+            n_queries += 1
+            status = "ok"
+            try:
+                config.set("dist_fragments", True)
+                rf = sess.sql(text).rows()
+                config.set("dist_fragments", False)
+                rm = sess.sql(text).rows()
+                if not _rows_equal(rf, rm):
+                    mismatches += 1
+                    status = "ROW-MISMATCH vs monolithic"
+                    print(f"{suite}/{name}: {status}", file=sys.stderr)
+            except VerifyError as e:
+                errors += 1
+                status = "VERIFY-FAIL"
+                print(f"{suite}/{name}: {e}", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — lint shouldn't die mid-run
+                errors += 1
+                status = f"ERROR {type(e).__name__}: {str(e)[:200]}"
+                print(f"{suite}/{name}: {status}", file=sys.stderr)
+            finally:
+                config.set("dist_fragments", True)
+            if verbose or status != "ok":
+                print(f"  {suite}/{name}: {status}", file=sys.stderr)
+        de = sess.__dict__.get("_dist_executor")
+        if de is not None:
+            for ir, _scans in de._frag_ir_memo.values():
+                tot_frags += len(ir.fragments)
+                tot_exchanges += len(ir.events)
+    summary = {
+        "metric": "plan_lint_fragments",
+        "queries": n_queries,
+        "strict_failures": errors,
+        "row_mismatches": mismatches,
+        "fragments": tot_frags,
+        "exchanges": tot_exchanges,
+        "seconds": round(time.time() - t0, 1),
+    }
+    print(json.dumps(summary))
+    return 1 if errors or mismatches else 0
+
+
 def lint_sql(text: str) -> int:
     from starrocks_tpu.analysis import VerifyError
     from starrocks_tpu.runtime.config import config
@@ -146,10 +234,17 @@ def main():
                     help="enable the query cache and run each corpus query "
                          "twice: strict-audits the result cache key (store "
                          "path) and the validated-hit path")
+    ap.add_argument("--fragments", action="store_true",
+                    help="fragment-IR corpus pass on the 8-shard mesh: "
+                         "strict declared-placement verification plus "
+                         "byte-identity against the monolithic pre-IR "
+                         "program")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     if args.sql:
         return lint_sql(args.sql)
+    if args.fragments:
+        return lint_fragments(args.suite, args.verbose)
     if args.corpus:
         return lint_corpus(args.suite, args.verbose, qcache=args.qcache)
     ap.print_help()
