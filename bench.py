@@ -649,7 +649,8 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
             from serve_bench import run_serve_bench
 
             sres = run_serve_bench(threads=8, seconds=2.0, sf=0.01, pool=4,
-                                   single_thread_ab=False, warm=True)
+                                   single_thread_ab=False, warm=True,
+                                   feedback=_remaining_s() > 240)
             detail["serve"] = sres
             flush_detail()
             serve = {
@@ -661,6 +662,19 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
                 "serve_fast_path_rate": sres.get(
                     "warm", {}).get("fast_path_rate", 0),
             }
+            fb = sres.get("feedback", {})
+            if fb:
+                on = fb.get("on", {})
+                serve.update({
+                    "feedback_hits": on.get("feedback_hits", 0),
+                    "feedback_retries_avoided": on.get(
+                        "retries_avoided", 0),
+                    "feedback_repeat_recompiles": on.get(
+                        "repeat", {}).get("recompiles", 0),
+                    "feedback_retries_saved_vs_off": fb.get(
+                        "repeat_retries_saved_vs_off", 0),
+                    "feedback_est_rel_err": on.get("est_rel_err", 0),
+                })
     except Exception as e:  # noqa: BLE001 — the bench line must print
         serve = {"serve_error": f"{type(e).__name__}: {e}"}
 

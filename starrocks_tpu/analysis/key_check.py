@@ -49,13 +49,19 @@ HOST_LOOP_KNOBS = {
         "lifecycle soft memory threshold; host-side degradation only",
     "process_mem_limit_bytes":
         "process-level accountant cap; host-side only",
+    "join_recursive_repartition":
+        "host-side hybrid-join partitioning decision; the sub-partition "
+        "capacities it produces key the compiled partition programs",
 }
 
 # Knobs that shape the OPTIMIZED PLAN (read during optimize(), not during
 # tracing). The optimized plan is itself part of the program cache key, and
 # the optimized-plan cache must key on exactly this set
 # (runtime/executor.py opt_key) — keep the two in sync via opt_key_knobs().
-OPT_KEY_KNOBS = ("enable_window_topn", "enable_mv_rewrite")
+# plan_feedback is here because a consulted feedback entry changes the DP
+# join order: the knob plus the entry's consult token (appended to opt_key
+# by the executor) together key the learned plan.
+OPT_KEY_KNOBS = ("enable_window_topn", "enable_mv_rewrite", "plan_feedback")
 
 
 def check_trace_reads(reads, config=None) -> list:
@@ -122,6 +128,35 @@ def check_cache_reads(reads, config=None) -> list:
             f"result enters the query cache, but covered by no key channel "
             f"(trace=True / OPT_KEY_KNOBS / cache_key=True / documented "
             f"host-loop knob): a SET {name} could serve a stale result"))
+    return findings
+
+
+def check_feedback_reads(reads, config=None) -> list:
+    """Findings for knobs read during a plan-feedback CONSULT
+    (runtime/feedback.py → optimizer card/skew overrides) but absent from
+    every declared cache-key channel. A consult happens before the
+    optimized plan is cached, so an unkeyed knob read here is the round-7
+    stale-trace class reborn through the feedback side door: two configs
+    could share one learned plan. Covered channels are exactly
+    check_cache_reads' set — trace=True, OPT_KEY_KNOBS, cache_key=True,
+    or a documented HOST_LOOP_KNOBS entry."""
+    if config is None:
+        from ..runtime.config import config as _c
+
+        config = _c
+    keyed = config.trace_knobs()
+    own = config.cache_key_knobs()
+    findings = []
+    for name in sorted(reads):
+        if (name in keyed or name in own or name in OPT_KEY_KNOBS
+                or name in HOST_LOOP_KNOBS):
+            continue
+        findings.append(Finding(
+            "key_check", "knob-outside-feedback-key", name,
+            f"config knob {name!r} read on the plan-feedback consult path "
+            f"but covered by no cache-key channel (trace=True / "
+            f"OPT_KEY_KNOBS / cache_key=True / documented host-loop knob): "
+            f"a SET {name} could serve a stale learned plan"))
     return findings
 
 

@@ -557,12 +557,25 @@ class HybridParts:
     # program sized for the heavy-hitter build (the whole point of the
     # skew lane is that one hot key stops inflating every partition pass)
     batch_rows: int
+    # recursive salted repartitioning (arXiv 2112.02480 destaging) +
+    # plan-feedback observations (runtime/feedback.py):
+    sub_parts: int = 0        # salted sub-partitions the recursion created
+    oversized_passes: int = 0  # cold passes whose build STILL exceeds budget
+    max_pass_build: int = 0   # largest cold build pass in rows (pre-pad)
+    probe_hot: tuple = ()     # ((key, count), ...) probe-side heavy hitters
+    build_hot: tuple = ()     # ((key, count), ...) unsplittable build keys
 
 
-def hybrid_partitions(gp: GraceJoinPlan, catalog, batch_rows: int
-                      ) -> HybridParts:
+def hybrid_partitions(gp: GraceJoinPlan, catalog, batch_rows: int,
+                      known_hot=None) -> HybridParts:
     """Partition-time half of the hybrid join: heavy-hitter detection plus
-    build-side hash partitioning with a greedy residency budget."""
+    build-side hash partitioning with a greedy residency budget.
+
+    `known_hot` is plan-feedback's learned build-side heavy-hitter key list
+    (keys a previous run proved unsplittable at recursion depth): they join
+    the broadcast-lane candidates after re-verification against TODAY's
+    build rows, covering the case where the stats gate (unique_build)
+    suppressed the detection scan that would have found them."""
     import numpy as np
 
     from .config import config
@@ -596,6 +609,16 @@ def hybrid_partitions(gp: GraceJoinPlan, catalog, batch_rows: int
             top = np.argsort(ccnt, kind="stable")[::-1]
             top = top[:max(config.get("join_skew_keys_max"), 0)]
             skew_keys = np.sort(cand[top])
+    if known_hot is not None and len(known_hot) and len(rk):
+        # re-verify learned keys against the live build before routing
+        # them to the broadcast lane (the thresh gate stays authoritative)
+        kh = np.asarray(sorted({int(k) for k in known_hot}), np.int64)
+        km = np.isin(rk, kh)
+        if km.any():
+            ku, kc = np.unique(rk[km], return_counts=True)
+            keep = ku[kc > thresh]
+            if keep.size:
+                skew_keys = np.union1d(skew_keys, keep)
 
     if len(skew_keys):
         r_hot = np.isin(rk, skew_keys)
@@ -645,16 +668,94 @@ def hybrid_partitions(gp: GraceJoinPlan, catalog, batch_rows: int
             continue  # INNER/SEMI against an empty build matches nothing
         spilled.append((pi, bi))
 
+    # recursive salted repartitioning (NEXT 11a): an overflow partition
+    # whose BUILD alone exceeds the batch budget re-hashes with a salt into
+    # sub-partitions instead of running one oversized build pass — the
+    # dynamic-destaging recursion of arXiv 2112.02480. Routing stays a pure
+    # function of the key value (same salt both sides), so LEFT/ANTI rows
+    # still land in exactly one lane.
+    stats = {"sub": 0, "oversized": 0, "hot": []}
+    if config.get("join_recursive_repartition"):
+        split: list = []
+        for pi, bi in spilled:
+            _salted_split(lk, rk, pi, bi, batch_rows, kind, thresh,
+                          np.uint64(1), 0, split, stats)
+        spilled = split
+    else:
+        stats["oversized"] = sum(
+            1 for _, bi in spilled if bi.size > batch_rows)
+
+    # probe-side heavy hitters: the exact counting scan the build side
+    # already runs, recorded into plan feedback for the DP join-order cost
+    # (NEXT 11d — a hot probe key floors the join's output cardinality)
+    probe_hot: list = []
+    if config.get("plan_feedback") and len(lk):
+        pu, pc = np.unique(lk, return_counts=True)
+        pm = pc > thresh
+        if pm.any():
+            cu, cc = pu[pm], pc[pm]
+            top = np.argsort(cc, kind="stable")[::-1]
+            top = top[:max(config.get("join_skew_keys_max"), 0)]
+            probe_hot = [(int(cu[i]), int(cc[i])) for i in top]
+
     rcap_hot = pad_capacity(int(hot[1].size)) if hot is not None else 0
     cold_builds = [res_b.size if resident is not None else 0]
     cold_builds.extend(bi.size for _, bi in spilled)
-    rcap_cold = pad_capacity(max(max(cold_builds, default=0), 1))
+    max_pass_build = int(max(cold_builds, default=0))
+    rcap_cold = pad_capacity(max(max_pass_build, 1))
     lcap = pad_capacity(max(min(batch_rows, max(len(lk), 1)), 1))
     return HybridParts(
         skew_keys=skew_keys, hot=hot, resident=resident, spilled=spilled,
         n_parts=n_parts, resident_parts=int(resident_mask.sum()),
         lcap=lcap, rcap_hot=rcap_hot, rcap_cold=rcap_cold,
-        batch_rows=batch_rows)
+        batch_rows=batch_rows, sub_parts=stats["sub"],
+        oversized_passes=stats["oversized"],
+        max_pass_build=max_pass_build, probe_hot=tuple(probe_hot),
+        build_hot=tuple(stats["hot"]))
+
+
+MAX_SALT_DEPTH = 4
+
+
+def _salted_split(lk, rk, pi, bi, batch_rows, kind, thresh, salt, depth,
+                  out, stats):
+    """Split one oversized spilled partition by a salted re-hash of the
+    join key, recursing while a sub-partition's build still exceeds the
+    budget. Two exits keep it bounded: a single-key partition cannot be
+    split by ANY hash of the key (its key is recorded as a learned heavy
+    hitter so the next run broadcasts it — plan feedback's build_hot), and
+    MAX_SALT_DEPTH stops pathological collision chains."""
+    import numpy as np
+
+    batch_rows = max(1, int(batch_rows))
+    if bi.size <= batch_rows:
+        out.append((pi, bi))
+        return
+    uniq = np.unique(rk[bi])
+    if uniq.size <= 1 or depth >= MAX_SALT_DEPTH:
+        stats["oversized"] += 1
+        cnt = np.unique(rk[bi], return_counts=True)
+        for k, c in zip(cnt[0][cnt[1] > thresh], cnt[1][cnt[1] > thresh]):
+            stats["hot"].append((int(k), int(c)))
+        out.append((pi, bi))
+        return
+    n_sub = max(2, -(-int(bi.size) // batch_rows))
+    hb = (_np_mix64(rk[bi].astype(np.uint64) ^ salt)
+          % np.uint64(n_sub)).astype(np.int64)
+    hp = (_np_mix64(lk[pi].astype(np.uint64) ^ salt)
+          % np.uint64(n_sub)).astype(np.int64)
+    for s in range(n_sub):
+        sub_pi = pi[hp == s]
+        if sub_pi.size == 0:
+            continue  # no probe rows -> no output rows, any join kind
+        sub_bi = bi[hb == s]
+        if sub_bi.size == 0 and kind not in ("left", "anti"):
+            continue  # INNER/SEMI against an empty build matches nothing
+        stats["sub"] += 1
+        next_salt = np.uint64(
+            (int(salt) + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF)
+        _salted_split(lk, rk, sub_pi, sub_bi, batch_rows, kind, thresh,
+                      next_salt, depth + 1, out, stats)
 
 
 def execute_hybrid_join(
@@ -675,6 +776,8 @@ def execute_hybrid_join(
     profile_node.set_info("hybrid_resident", parts.resident_parts)
     profile_node.set_info("hybrid_spilled", len(parts.spilled))
     profile_node.set_info("hybrid_skew_keys", len(parts.skew_keys))
+    profile_node.set_info("hybrid_subpartitions", parts.sub_parts)
+    profile_node.set_info("hybrid_max_pass_build", parts.max_pass_build)
 
     part_plan = _grace_part_plan(gp)
     pgkey = GRACE_GROUP_KEY + "_partial"
@@ -778,6 +881,9 @@ def execute_hybrid_join(
     checks.append(("~ctr_join_skew_keys", len(parts.skew_keys)))
     checks.append(("~ctr_join_spilled_partitions", len(parts.spilled)))
     checks.append(("~ctr_join_resident_partitions", parts.resident_parts))
+    checks.append(("~ctr_join_subpartitions", parts.sub_parts))
+    checks.append(("~ctr_join_oversized_passes", parts.oversized_passes))
+    checks.append(("~ctr_join_max_pass_build", parts.max_pass_build))
     if parts.hot is not None:
         checks.append(("~ctr_join_skew_probe_rows", len(parts.hot[0])))
     return out, checks

@@ -300,6 +300,25 @@ config.define("join_skew_keys_max", 64, True,
               "replicated-broadcast lane (top-k by build row count; "
               "the rest stay in hash partitions)",
               trace=True)
+config.define("plan_feedback", True, True,
+              "plan-feedback loop (runtime/feedback.py): record observed "
+              "join cardinalities, final adaptive capacities, and "
+              "heavy-hitter keys per plan fingerprint after each "
+              "execution, and consume them on repeats — observed "
+              "cardinalities into the DP join-order cost, pre-tightened "
+              "capacities seeding the program bucket, learned hot keys "
+              "into hybrid-join lane routing. off = byte-identity A/B "
+              "anchor (estimates only, cold capacities). Declared in "
+              "OPT_KEY_KNOBS: both the optimized-plan cache and the "
+              "full-result cache key on it")
+config.define("join_recursive_repartition", True, True,
+              "hybrid join: re-hash an overflow partition whose build "
+              "side alone exceeds the spill batch budget into salted "
+              "sub-partitions (recursive destaging per arXiv 2112.02480) "
+              "instead of streaming one oversized pass. Host-side "
+              "partitioning decision only — compiled partition programs "
+              "key on the resulting capacities, so this needs no trace "
+              "channel (HOST_LOOP_KNOBS)")
 config.define("compilation_cache_dir", "", False,
               "persistent XLA compilation cache directory (survives process "
               "restarts; big win for TPU first-compiles). Set via "

@@ -32,7 +32,8 @@ from ..sql.physical import Caps, compile_plan
 from . import lifecycle
 from .config import config
 from .failpoint import fail_point
-from .metrics import QUERIES_TOTAL, QUERY_ERRORS, RECOMPILES, ROWS_RETURNED
+from .metrics import (PROGRAM_COMPILES, QUERIES_TOTAL, QUERY_ERRORS,
+                      RECOMPILES, ROWS_RETURNED)
 from .profile import RuntimeProfile
 
 
@@ -87,6 +88,13 @@ class DeviceCache:
         from ..cache.plan_cache import PlanCache
 
         self.plan_cache = PlanCache()
+        # plan-feedback store (runtime/feedback.py): per-fingerprint
+        # execution observations consumed by the optimizer/executor/hybrid
+        # join on repeats. In-memory until Session attaches a sidecar path;
+        # invalidate(table) below covers it like every other tier.
+        from .feedback import FeedbackStore
+
+        self.feedback = FeedbackStore()
 
     # --- locked map helpers ---------------------------------------------------
     def _cget(self, key):
@@ -144,6 +152,18 @@ class DeviceCache:
     def bucket_last_set(self, bucket, vals):
         with self._lock:
             bucket["last"] = dict(vals)
+
+    def bucket_seed_last(self, bucket, vals) -> bool:
+        """Pre-tighten a COLD bucket from plan-feedback capacities: set
+        "last" only when no execution has published one yet (a live
+        bucket's own observations always outrank the journal's), so the
+        first run of a repeat shape adopts learned caps and compiles once.
+        Returns whether the seed took."""
+        with self._lock:
+            if bucket["last"] is None and vals:
+                bucket["last"] = dict(vals)
+                return True
+            return False
 
     def bucket_prog_get(self, bucket, key):
         with self._lock:
@@ -208,6 +228,8 @@ class DeviceCache:
         # query cache has its own, and nesting the two here would impose
         # a lock order the serving paths never need.
         self.qcache.invalidate_table(table)
+        # learned observations about the mutated table are stale history
+        self.feedback.invalidate_table(table)
 
     def build_order_for(self, handle, alias: str, key_cols, bit_widths):
         """Cached argsort permutation of a scan's packed join keys (single
@@ -412,6 +434,9 @@ class _BucketProgs:
         return val
 
     def __setitem__(self, key, val):
+        # the batched/grace/hybrid runners put ONLY on a miss, so every
+        # insert here is one fresh program trace
+        PROGRAM_COMPILES.inc()
         self._cache.bucket_prog_put(self._bucket, key, val)
 
 
@@ -436,6 +461,10 @@ class Executor:
     def __init__(self, catalog, device_cache: DeviceCache | None = None):
         self.catalog = catalog
         self.cache = device_cache or DeviceCache()
+        # plan-feedback context of the query being executed ({fp, entry,
+        # tables, seeded} or None) — set by _execute_plain_uncached after
+        # subquery resolution, consumed by the _fb_* glue below
+        self._fb_ctx = None
 
     # --- public --------------------------------------------------------------
     def execute_logical(
@@ -524,18 +553,47 @@ class Executor:
                 # key-completeness checker so the two can't drift
                 from ..analysis.key_check import OPT_KEY_KNOBS
 
+                fb_fp = fb_entry = None
+                if config.get("plan_feedback"):
+                    from .feedback import plan_fingerprint
+
+                    with config.record_reads() as fb_reads:
+                        fb_fp = plan_fingerprint(plan)
+                        fb_entry = self.cache.feedback.consult(
+                            fb_fp, self.catalog)
+                    self._verify_feedback_reads(fb_reads, profile)
                 opt_key = (plan,) + tuple(
                     config.get(k) for k in OPT_KEY_KNOBS)
+                if fb_entry is not None:
+                    # fresh observations must never serve the PREVIOUSLY
+                    # learned plan: the entry's consult token extends the
+                    # key (it reaches a fixpoint once observations stop
+                    # changing, so steady-state repeats still hit)
+                    opt_key += (fb_entry["token"],)
+                    profile.add_counter("feedback_hits", 1)
                 opt = self.cache.opt_plan_lookup(opt_key)
                 if opt is None:
                     with config.record_reads() as opt_reads:
-                        opt = optimize(plan, self.catalog)
+                        opt = optimize(plan, self.catalog, fb_entry)
                     self._verify_opt_reads(opt_reads, profile)
                     self.cache.opt_plan_store(opt_key, opt)
                 # subquery resolution executes data-dependent sub-plans —
                 # never cached
+                analyzed = plan
                 plan = self._resolve_scalar_subqueries(opt)
             self._verify_plan(plan, profile)
+            # record-side feedback context — set AFTER subquery resolution
+            # (nested sub-plan executions run through this same executor
+            # and must not leave their context on the outer query)
+            if fb_fp is not None:
+                from ..sql.optimizer import plan_tables
+
+                self._fb_ctx = {
+                    "fp": fb_fp, "entry": fb_entry, "seeded": set(),
+                    "tables": plan_tables(analyzed) | plan_tables(plan),
+                }
+            else:
+                self._fb_ctx = None
             out_chunk = self._run(plan, profile)
             fail_point("executor::fetch_results")
             lifecycle.checkpoint("executor::fetch_results")
@@ -574,6 +632,17 @@ class Executor:
         if verify_level() == "off":
             return
         report(check_opt_reads(reads), profile, where="optimize")
+
+    def _verify_feedback_reads(self, reads, profile):
+        """Feedback-consult cache-key completeness: a knob read while
+        consulting (fingerprint + entry validation) must sit on a declared
+        key channel, or two configs could share one learned plan."""
+        from ..analysis import report, verify_level
+        from ..analysis.key_check import check_feedback_reads
+
+        if verify_level() == "off":
+            return
+        report(check_feedback_reads(reads), profile, where="feedback")
 
     def _verify_compile(self, raw_fn, inputs, reads, profile,
                         extra_args=()):
@@ -831,15 +900,115 @@ class Executor:
 
         return rec(plan)
 
+    # --- plan-feedback glue (runtime/feedback.py) -----------------------------
+    def _fb_seed(self, tag: str, plan):
+        """Pre-tighten a cold program bucket from learned capacities: the
+        first execution of a repeat shape after a restart adopts the
+        previous process's tightened caps, compiles once, and burns zero
+        adaptive retries. A bucket that already published its own "last"
+        always outranks the journal."""
+        ctx = self._fb_ctx
+        if ctx is None or ctx["entry"] is None:
+            return
+        vals = ctx["entry"].get("caps", {}).get(tag)
+        if vals and self.cache.bucket_seed_last(
+                self.cache.program_bucket((tag, plan)), vals):
+            ctx["seeded"].add(tag)
+
+    def _fb_recorder(self, tag: str, profile, node_ord_box=None,
+                     extra_fn=None):
+        """on_success callback for _adaptive: records this execution's
+        observations (tightened caps, retries burned, observed join
+        cardinalities when a fresh trace exposed node ordinals, and
+        whatever `extra_fn` contributes — hybrid heavy hitters/partition
+        outcomes) under the query's plan fingerprint."""
+        ctx = self._fb_ctx
+        if ctx is None:
+            return None
+
+        def record(caps_vals, keyed_checks, attempts):
+            from .feedback import (
+                FEEDBACK_RECOMPILES_AVOIDED, FEEDBACK_RETRIES_AVOIDED,
+            )
+
+            entry = ctx["entry"]
+            if attempts == 0 and tag in ctx["seeded"] and entry is not None:
+                saved = int(entry.get("attempts", {}).get(tag, 0))
+                if saved:
+                    # the learning run burned `saved` retries (each retry =
+                    # one fresh compile at grown caps); this seeded run
+                    # converged on attempt 0
+                    FEEDBACK_RETRIES_AVOIDED.inc(saved)
+                    FEEDBACK_RECOMPILES_AVOIDED.inc(saved)
+                    profile.add_counter("feedback_retries_avoided", saved)
+            cards = self._fb_cards(
+                (node_ord_box or {}).get("node_ord"), dict(keyed_checks))
+            kwargs = extra_fn() if extra_fn is not None else {}
+            self.cache.feedback.record(
+                ctx["fp"], self.catalog, ctx["tables"], tag, caps_vals,
+                attempts, cards=cards, **kwargs)
+
+        return record
+
+    def _fb_known_hot(self, gp):
+        """Learned build-side heavy-hitter keys for a hybrid join's build
+        column (fed back into hybrid_partitions, which re-verifies their
+        counts against the live build before broadcasting)."""
+        ctx = self._fb_ctx
+        if ctx is None or ctx["entry"] is None:
+            return None
+        col = f"{gp.right_scan.table}.{gp.build_key}"
+        pairs = ctx["entry"].get("build_hot", {}).get(col)
+        if not pairs:
+            return None
+        return [int(k) for k, _ in pairs]
+
+    def _fb_cards(self, node_ord, checks) -> dict | None:
+        """Observed join cardinalities keyed by the subtree's scanset
+        (sql/optimizer.join_scanset_key): the `join_{ordinal}` overflow
+        totals of the surviving attempt, mapped back through the trace's
+        node-ordinal table. Absent on program-cache hits (no fresh trace =
+        no ordinals; the entry already holds them from the learning run)."""
+        if not node_ord:
+            return None
+        from ..sql.logical import LJoin
+        from ..sql.optimizer import estimate_rows, join_scanset_key
+        from .feedback import FEEDBACK_EST_ERRSUM, FEEDBACK_EST_JOINS
+
+        cards: dict = {}
+        for node, o in node_ord.items():
+            if not (isinstance(node, LJoin)
+                    and node.kind in ("inner", "cross", "left")):
+                continue  # semi/anti totals count the inner EXPANSION
+            total = checks.get(f"join_{o}")
+            if total is None:
+                continue
+            key = join_scanset_key(node)
+            if not key:
+                continue
+            cards[key] = float(int(total))
+            try:
+                est = float(estimate_rows(node, self.catalog))
+            except Exception:  # lint: swallow-ok — stats must never fail a query
+                continue
+            FEEDBACK_EST_ERRSUM.inc(
+                abs(est - float(total)) / max(float(total), 1.0))
+            FEEDBACK_EST_JOINS.inc()
+        return cards or None
+
     # --- execution with adaptive recompile ------------------------------------
     def _adaptive(self, profile: RuntimeProfile, attempt_fn,
-                  publish=None) -> Chunk:
+                  publish=None, on_success=None) -> Chunk:
         """Shared overflow-recompile loop (used by single-chip + distributed).
 
         attempt_fn(caps, attempt_profile) -> (chunk, [(cap_key, true_count)]).
         `publish(caps_values)` runs after the post-success tightening pass
         so the bucket's "last" capacities (now a locked SNAPSHOT, no longer
         an aliased live dict) pick the tightened values up for the next run.
+        `on_success(caps_values, keyed_checks, attempts)` fires once after
+        publish with the tightened capacities, the surviving attempt's
+        observed true counts, and the retries burned — the plan-feedback
+        recording hook.
         """
         caps = Caps({})
         max_recompiles = config.get("max_recompiles")
@@ -921,6 +1090,9 @@ class Executor:
                         caps.values[key] = tight
                 if publish is not None:
                     publish(caps.values)
+                if on_success is not None:
+                    on_success(dict(caps.values), list(keyed_checks),
+                               attempt)
                 return out
             RECOMPILES.inc()
             fail_point("executor::before_recompile")
@@ -967,10 +1139,15 @@ class Executor:
                 return out
 
         scan_rf = self._scan_runtime_filters(plan, profile)
+        self._fb_seed("local", plan)
+        # node_ord fills lazily while the fresh program traces; the box
+        # hands it to the feedback recorder after the run succeeds
+        trace_box: dict = {}
 
         def attempt(caps, p):
             def compile_cb():
                 compiled = compile_plan(plan, self.catalog, caps)
+                trace_box["node_ord"] = compiled.node_ord
                 return (jax.jit(compiled.fn),
                         (compiled.scans, compiled.aux), compiled.fn)
 
@@ -1000,7 +1177,9 @@ class Executor:
             self.cache.bucket_last_set(
                 self.cache.program_bucket(("local", plan)), vals)
 
-        return self._adaptive(profile, attempt, publish)
+        return self._adaptive(profile, attempt, publish,
+                              self._fb_recorder("local", profile,
+                                                trace_box))
 
     def _try_partial_cache(self, plan, profile):
         """Per-segment partial-aggregation tier (cache/partial.py): for a
@@ -1078,13 +1257,43 @@ class Executor:
             )
 
             if config.get("join_hybrid_strategy") == "grace":
-                bucket = self.cache.program_bucket(("grace", plan))
+                tag = "grace"
+                self._fb_seed(tag, plan)
+                bucket = self.cache.program_bucket((tag, plan))
                 parts = grace_partitions(gp, self.catalog, batch_rows)
                 runner = execute_grace_join
+                extra_fn = None
             else:
-                bucket = self.cache.program_bucket(("hybrid", plan))
-                parts = hybrid_partitions(gp, self.catalog, batch_rows)
+                tag = "hybrid"
+                self._fb_seed(tag, plan)
+                bucket = self.cache.program_bucket((tag, plan))
+                parts = hybrid_partitions(
+                    gp, self.catalog, batch_rows,
+                    known_hot=self._fb_known_hot(gp))
                 runner = execute_hybrid_join
+
+                def extra_fn():
+                    # heavy hitters + partition outcomes learned at
+                    # partition time, keyed by base table.column so the DP
+                    # cost model can resolve them through col_origin
+                    probe_col = f"{gp.left_scan.table}.{gp.probe_key}"
+                    build_col = f"{gp.right_scan.table}.{gp.build_key}"
+                    out = {"parts": {
+                        "n_parts": parts.n_parts,
+                        "resident": parts.resident_parts,
+                        "spilled": len(parts.spilled),
+                        "sub_parts": parts.sub_parts,
+                        "oversized": parts.oversized_passes,
+                    }}
+                    if parts.probe_hot:
+                        out["probe_hot"] = {
+                            probe_col: [[int(k), int(c)]
+                                        for k, c in parts.probe_hot]}
+                    if parts.build_hot:
+                        out["build_hot"] = {
+                            build_col: [[int(k), int(c)]
+                                        for k, c in parts.build_hot]}
+                    return out
 
             def attempt(caps, p):
                 # adopt-last protocol (mirrors _cached_attempt): cached
@@ -1101,19 +1310,30 @@ class Executor:
             def publish(vals):
                 self.cache.bucket_last_set(bucket, vals)
 
-            return self._adaptive(profile, attempt, publish)
+            return self._adaptive(profile, attempt, publish,
+                                  self._fb_recorder(tag, profile,
+                                                    extra_fn=extra_fn))
         handle = self.catalog.get_table(bp.scan.table)
         if handle is None or handle.row_count <= batch_threshold:
             return None
-        prog_cache = _BucketProgs(
-            self.cache, self.cache.program_bucket(("batched", plan)))
+        self._fb_seed("batched", plan)
+        b_bucket = self.cache.program_bucket(("batched", plan))
+        prog_cache = _BucketProgs(self.cache, b_bucket)
 
         def attempt(caps, p):
+            # adopt-last protocol (mirrors _cached_attempt): repeated — or
+            # feedback-seeded — spilled aggregations start at the tightened
+            # group capacity instead of re-burning the discovery retry
+            self.cache.bucket_adopt_last(b_bucket, caps)
             return execute_batched(
                 bp, self.catalog, caps, p, batch_rows, prog_cache
             )
 
-        return self._adaptive(profile, attempt)
+        def publish(vals):
+            self.cache.bucket_last_set(b_bucket, vals)
+
+        return self._adaptive(profile, attempt, publish,
+                              self._fb_recorder("batched", profile))
 
     def _cached_attempt(self, cache_key, caps, p, compile_cb, place_cb):
         """Shared program-cache protocol for local + distributed attempts.
@@ -1134,6 +1354,8 @@ class Executor:
             bucket, tuple(sorted(caps.values.items())))
         raw = reads = None
         if hit is None:
+            PROGRAM_COMPILES.inc()
+            p.add_counter("compiles", 1)
             fail_point("executor::before_compile")
             lifecycle.checkpoint("executor::before_compile")
             # record every knob read from compile through the first call

@@ -85,4 +85,8 @@ ROWS_RETURNED = metrics.counter("sr_tpu_rows_returned_total", "result rows")
 RECOMPILES = metrics.counter(
     "sr_tpu_capacity_recompiles_total", "adaptive capacity recompiles"
 )
+PROGRAM_COMPILES = metrics.counter(
+    "sr_tpu_program_compiles_total",
+    "fresh program traces (cache misses across local/batched/hybrid paths)"
+)
 ROWS_LOADED = metrics.counter("sr_tpu_rows_loaded_total", "rows ingested")

@@ -113,6 +113,18 @@ class Session:
         # listener covers epochs advanced by OTHER sessions on a shared
         # catalog and by storage-level listeners above)
         self.catalog.add_invalidation_listener(self.cache.invalidate)
+        # plan-feedback sidecar (round-9 external-defs pattern): a
+        # persistent store gives the feedback journal a home next to the
+        # manifests, so learned capacities/cardinalities survive restarts
+        # and a fresh process pre-tightens its first repeat execution.
+        # attach() replays the existing journal; idempotent for the shared
+        # serving-tier cache (every connection session passes the same
+        # store root).
+        if self.store is not None:
+            import os as _os
+
+            self.cache.feedback.attach(
+                _os.path.join(self.store.root, "plan_feedback.json"))
 
     # journal ops before an image snapshot triggers (the FE
     # CheckpointController's checkpoint-interval analog)

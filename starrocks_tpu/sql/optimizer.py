@@ -31,7 +31,12 @@ from .logical import (
 )
 
 
-def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
+def optimize(plan: LogicalPlan, catalog, feedback=None) -> LogicalPlan:
+    """`feedback` is a validated plan-feedback entry (runtime/feedback.py
+    FeedbackStore.consult) or None; only the DP join ordering consumes it.
+    Callers that pass one must key the result by the entry's consult token
+    (the executor's opt_key does) — the same logical plan legally optimizes
+    differently as observations accumulate."""
     from .mv_rewrite import try_rewrite as _mv_try_rewrite
 
     plan = _mv_try_rewrite(plan, catalog)  # before any rule reshapes it
@@ -42,7 +47,7 @@ def optimize(plan: LogicalPlan, catalog) -> LogicalPlan:
     plan = pushdown_filters(plan)
     plan = pushdown_semi_joins(plan, catalog)
     plan = pushdown_aggregation(plan, catalog)
-    plan = reorder_joins(plan, catalog)
+    plan = reorder_joins(plan, catalog, feedback)
     plan = pushdown_filters(plan)
     plan = rewrite_window_topn(plan)
     plan = prune_columns(plan)
@@ -1294,16 +1299,17 @@ def pushdown_semi_joins(plan: LogicalPlan, catalog) -> LogicalPlan:
     return plan
 
 
-def reorder_joins(plan: LogicalPlan, catalog) -> LogicalPlan:
+def reorder_joins(plan: LogicalPlan, catalog, feedback=None) -> LogicalPlan:
     if isinstance(plan, LJoin) and plan.kind in ("inner", "cross"):
         rels, conjuncts = [], []
         _flatten_join_region(plan, rels, conjuncts)
-        rels = [reorder_joins(r, catalog) for r in rels]
+        rels = [reorder_joins(r, catalog, feedback) for r in rels]
         if len(rels) > 1:
             if len(rels) <= DP_JOIN_MAX_RELS:
-                return _dp_order(rels, conjuncts, catalog)
+                return _dp_order(rels, conjuncts, catalog, feedback)
             return _greedy_order(rels, conjuncts, catalog)
-    new_children = tuple(reorder_joins(c, catalog) for c in plan.children)
+    new_children = tuple(
+        reorder_joins(c, catalog, feedback) for c in plan.children)
     return _replace_children(plan, new_children)
 
 
@@ -1338,6 +1344,25 @@ def col_origin(plan, name: str):
             return col_origin(plan.right, name)
         return None
     return None
+
+
+def join_scanset_key(plan) -> str:
+    """Order-independent identity of a join subtree's input set: the sorted
+    table:alias leaves under it. An inner region's TRUE cardinality depends
+    only on which inputs joined, not the order — so an observed total
+    recorded under this key by one execution funds every DP split of the
+    same subset on the next (runtime/feedback.py cards; LEO-style
+    history-based correction)."""
+    return "|".join(sorted({f"{p.table}:{p.alias}" for p in walk_plan(plan)
+                            if isinstance(p, LScan)}))
+
+
+# Observed-vs-estimate guard band for feedback overrides: inside the band
+# the estimate stands, keeping well-estimated plans BYTE-IDENTICAL to the
+# feedback-off path (the A/B anchor plan_lint verifies across the corpus);
+# outside it the observation wins — misestimates that flip DP orders are
+# multiplicative (7.5x composite-NDV class), not ±40%.
+FEEDBACK_CARD_BAND = 4.0
 
 
 def join_fan_rows(l_rows: float, r_rows: float, prod_l: float, prod_r: float,
@@ -1393,17 +1418,63 @@ def _key_ndv(rel, name: str, est_rows: float, catalog) -> float:
     return max(est_rows, 1.0)
 
 
-def _dp_order(rels, conjuncts, catalog) -> LogicalPlan:
+def _dp_order(rels, conjuncts, catalog, feedback=None) -> LogicalPlan:
     """Selinger-style exhaustive DP over subsets (reference:
     fe sql/optimizer/Memo.java + cost/CostModel.java re-designed as direct
     DP — the plan space here is join order only, physical ops are chosen
     later). Cost = total estimated intermediate rows (System-R cardinality:
     |L JOIN R| = |L||R| / prod max(ndv)); avoids the greedy trap of joining
     on a low-NDV key first (e.g. TPC-H Q5's
-    customer.c_nationkey = supplier.s_nationkey fanout blowup)."""
+    customer.c_nationkey = supplier.s_nationkey fanout blowup).
+
+    With a plan-feedback entry, two corrections join the cost model, both
+    gated by FEEDBACK_CARD_BAND so well-estimated plans never move:
+    observed cardinalities (cards, keyed by join_scanset_key) replace
+    estimates per subset, and probe-side heavy-hitter counts (NEXT 11d)
+    floor a split's output at hot_rows x avg build matches — an order that
+    probes through a hot key pays for the skew the NDV average hides."""
     n = len(rels)
     colsets = [frozenset(r.output_names()) for r in rels]
     base_rows = [estimate_rows(r, catalog) for r in rels]
+
+    fb_cards = (feedback or {}).get("cards") or {}
+    fb_hot = (feedback or {}).get("probe_hot") or {}
+    leaf_keys = [
+        frozenset(f"{p.table}:{p.alias}" for p in walk_plan(r)
+                  if isinstance(p, LScan))
+        for r in rels] if fb_cards else None
+    card_cache: dict = {}
+
+    def observed_rows(mask: int):
+        if not fb_cards:
+            return None
+        if mask not in card_cache:
+            names: set = set()
+            for i in range(n):
+                if mask & (1 << i):
+                    names |= leaf_keys[i]
+            card_cache[mask] = fb_cards.get("|".join(sorted(names)))
+        return card_cache[mask]
+
+    def banded(est: float, obs) -> float:
+        """The observation wins only OUTSIDE the guard band."""
+        if obs is None or (est * FEEDBACK_CARD_BAND >= obs
+                           and obs * FEEDBACK_CARD_BAND >= est):
+            return est
+        return max(float(obs), 1.0)
+
+    hot_cache: dict = {}
+
+    def hot_count(i: int, col: str) -> float:
+        key = (i, col)
+        if key not in hot_cache:
+            h = 0.0
+            origin = col_origin(rels[i], col)
+            if origin is not None:
+                for _, cnt in fb_hot.get(f"{origin[0]}.{origin[1]}", ()):
+                    h = max(h, float(cnt))
+            hot_cache[key] = h
+        return hot_cache[key]
 
     def rel_of(cols: frozenset) -> int:
         m = 0
@@ -1438,7 +1509,10 @@ def _dp_order(rels, conjuncts, catalog) -> LogicalPlan:
     # best[mask] = (cost, rows, plan); eq-rootedness rides entry_has_eq below
     best: dict = {}
     for i in range(n):
-        best[1 << i] = (0.0, base_rows[i], rels[i])
+        # a leaf rel that is itself a join subtree (e.g. an outer join
+        # below this inner region) may have an observed total of its own
+        best[1 << i] = (0.0, banded(base_rows[i], observed_rows(1 << i)),
+                        rels[i])
 
     full = (1 << n) - 1
     for mask in range(3, full + 1):
@@ -1492,8 +1566,23 @@ def _dp_order(rels, conjuncts, catalog) -> LogicalPlan:
                             if tr:
                                 pk_cands.append(
                                     other_r * this_r / tr * (0.25 ** n_res))
+
                     if pk_cands:
                         rows = max(min(pk_cands), 1.0)
+                    obs = observed_rows(mask)
+                    if obs is not None:
+                        rows = banded(rows, obs)
+                    elif fb_hot:
+                        # no observation for this subset: floor the output
+                        # at the hot key's expected matches (probe-side
+                        # heavy hitter x average build fan), band-gated
+                        hot = 0.0
+                        for hi, hcol in a_ends:
+                            h = hot_count(hi, hcol)
+                            if h:
+                                hot = max(hot, h * rb / max(prod_b, 1.0))
+                        if hot > rows * FEEDBACK_CARD_BAND:
+                            rows = hot
                     # build side (right) materializes a device-sorted table:
                     # a full-capacity argsort, single-threaded in XLA CPU and
                     # O(n log n) everywhere — bias hard toward small builds.

@@ -483,7 +483,8 @@ class Caps:
 
 
 class Compiled:
-    def __init__(self, fn, scans, checks_meta, out_names, aux=()):
+    def __init__(self, fn, scans, checks_meta, out_names, aux=(),
+                 node_ord=None):
         self.fn = fn  # (inputs tuple) -> (chunk, checks tuple)
         self.scans = scans  # list[(table, alias, columns)]
         self.checks_meta = checks_meta  # list[(cap_key,)] parallel to checks
@@ -491,6 +492,12 @@ class Compiled:
         # aux inputs appended after the scan chunks: precomputed build-side
         # sort permutations, (table, alias, key_cols, bit_widths) each
         self.aux = aux
+        # plan node (by value) -> check-key ordinal; the dict is filled
+        # LAZILY while fn traces, so it is only meaningful after the first
+        # attempt returns. The plan-feedback recorder inverts it to map
+        # observed `join_{o}` overflow totals back to the plan subtree that
+        # produced them.
+        self.node_ord = {} if node_ord is None else node_ord
 
 
 def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
@@ -1079,7 +1086,8 @@ def compile_plan(plan: LogicalPlan, catalog, caps: Caps,
         chunk = emit(plan)
         return chunk, checks
 
-    return Compiled(run, scans, None, plan.output_names(), tuple(aux))
+    return Compiled(run, scans, None, plan.output_names(), tuple(aux),
+                    node_ord=node_ord)
 
 
 def _equi_pair(conj: Expr, lcols: frozenset, rcols: frozenset):

@@ -1,0 +1,249 @@
+"""Plan-feedback loop (starrocks_tpu/runtime/feedback.py) — ISSUE 11.
+
+Reference behavior: the FE's SQL plan manager + history-based optimizer
+(statistic/HistogramStatisticsCollectJob, sql/plan management) — observed
+execution statistics persisted per plan fingerprint and consulted by
+later optimizations. The invariants under test:
+
+- a learning run that burns adaptive overflow retries teaches the store;
+  the SAME query in a FRESH process (restart) pre-tightens from the
+  sidecar and executes with ZERO recompiles, counting the retries it
+  did not burn;
+- per-table staleness: DML and DDL through any path invalidate entries
+  (the catalog-listener fan-in), and version tokens re-validate on every
+  consult so out-of-band store mutations can never serve observations
+  about vanished data;
+- the consult token reaches a fixpoint on steady-state repeats (the
+  token-extended opt-plan key keeps hitting instead of re-optimizing);
+- `SET plan_feedback = off` is the byte-identity A/B anchor;
+- recursive salted repartitioning (runtime/batched._salted_split) bounds
+  every pass's build rows by the batch budget, conserves rows exactly
+  once across lanes, and downgrades unsplittable single-key partitions
+  to recorded heavy-hitters instead of recursing forever;
+- the static gate (tools/src_lint.py R6) rejects a consult-path knob
+  read that is on no cache-key channel, and the dynamic audit
+  (analysis/key_check.check_feedback_reads) passes the real read-set.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from starrocks_tpu.runtime.batched import MAX_SALT_DEPTH, _salted_split
+from starrocks_tpu.runtime.config import config
+from starrocks_tpu.runtime.feedback import FeedbackStore, plan_fingerprint
+from starrocks_tpu.runtime.session import Session
+
+
+def _ctr(profile, name):
+    tot = profile.counters.get(name, (0, ""))[0]
+    for c in profile.children:
+        tot += _ctr(c, name)
+    return tot
+
+
+def _expansion_session(tmp_path):
+    """Store-backed many-to-many join whose output (200k rows over 20 keys)
+    overflows any estimate-derived capacity — the learning run MUST burn at
+    least one adaptive recompile."""
+    s = Session(data_dir=str(tmp_path / "db"))
+    s.sql("create table a (k bigint, v bigint)")
+    s.sql("create table b (k bigint, w bigint)")
+    ra = ",".join(f"({i % 20},{i})" for i in range(2000))
+    rb = ",".join(f"({i % 20},{i})" for i in range(2000))
+    s.sql(f"insert into a values {ra}")
+    s.sql(f"insert into b values {rb}")
+    return s
+
+
+EXPAND_Q = "select count(*) c, sum(a.v + b.w) s from a join b on a.k = b.k"
+
+
+# --- restart persistence + pre-tightening ------------------------------------
+
+def test_restart_pretightens_zero_recompiles(tmp_path):
+    s1 = _expansion_session(tmp_path)
+    r1 = s1.sql(EXPAND_Q)
+    learn = _ctr(s1.last_profile, "recompiles")
+    assert learn >= 1, "learning run must burn an adaptive retry"
+    assert os.path.exists(tmp_path / "db" / "plan_feedback.json")
+
+    s2 = Session(data_dir=str(tmp_path / "db"))  # fresh process analog
+    r2 = s2.sql(EXPAND_Q)
+    assert r2.to_pandas().equals(r1.to_pandas())
+    assert _ctr(s2.last_profile, "feedback_hits") == 1
+    assert _ctr(s2.last_profile, "recompiles") == 0
+    assert _ctr(s2.last_profile, "feedback_retries_avoided") >= learn
+
+
+def test_consult_token_fixpoint(tmp_path):
+    s = _expansion_session(tmp_path)
+    s.sql(EXPAND_Q)
+    t1 = s.cache.feedback.stats()["tokens"]
+    s.sql(EXPAND_Q)
+    s.sql(EXPAND_Q)
+    assert s.cache.feedback.stats()["tokens"] == t1, (
+        "steady-state repeats must not bump the consult token")
+
+
+# --- staleness ---------------------------------------------------------------
+
+def test_dml_invalidates(tmp_path):
+    s = _expansion_session(tmp_path)
+    s.sql(EXPAND_Q)
+    assert s.cache.feedback.stats()["entries"] == 1
+    s.sql("insert into b values (999, 999)")
+    assert s.cache.feedback.stats()["entries"] == 0
+
+
+def test_ddl_invalidates(tmp_path):
+    s = _expansion_session(tmp_path)
+    s.sql(EXPAND_Q)
+    assert s.cache.feedback.stats()["entries"] == 1
+    s.sql("drop table b")
+    assert s.cache.feedback.stats()["entries"] == 0
+
+
+def test_version_token_rejects_stale_sidecar(tmp_path):
+    """A consult in a fresh process re-validates stored version tokens:
+    mutating the store between processes drops the entry (miss, never
+    stale observations)."""
+    s1 = _expansion_session(tmp_path)
+    s1.sql(EXPAND_Q)
+
+    s2 = Session(data_dir=str(tmp_path / "db"))
+    s2.sql("insert into a values (7, 7)")  # move the data, then consult
+    s2.sql(EXPAND_Q)
+    assert _ctr(s2.last_profile, "feedback_hits") == 0
+
+
+# --- byte-identity anchor ----------------------------------------------------
+
+def test_feedback_off_byte_identity(tmp_path):
+    s = _expansion_session(tmp_path)
+    r_on1 = s.sql(EXPAND_Q)
+    r_on2 = s.sql(EXPAND_Q)  # consult-hit run
+    s.sql("set plan_feedback = off")
+    try:
+        r_off = s.sql(EXPAND_Q)
+        assert _ctr(s.last_profile, "feedback_hits") == 0
+    finally:
+        s.sql("set plan_feedback = on")
+    assert r_off.to_pandas().equals(r_on1.to_pandas())
+    assert r_off.to_pandas().equals(r_on2.to_pandas())
+
+
+# --- fingerprint -------------------------------------------------------------
+
+def test_fingerprint_tracks_knobs(tmp_path):
+    from starrocks_tpu.sql.analyzer import Analyzer
+    from starrocks_tpu.sql.parser import parse
+
+    s = _expansion_session(tmp_path)
+    plan = Analyzer(s.catalog).analyze(parse(EXPAND_Q))
+    f1 = plan_fingerprint(plan)
+    config.set("enable_mv_rewrite", not config.get("enable_mv_rewrite"))
+    try:
+        assert plan_fingerprint(plan) != f1, (
+            "OPT_KEY knob flip must change the fingerprint")
+    finally:
+        config.set("enable_mv_rewrite", not config.get("enable_mv_rewrite"))
+    assert plan_fingerprint(plan) == f1
+
+
+def test_store_lru_bound():
+    fs = FeedbackStore()
+
+    class _Cat:
+        def data_version(self, name):
+            return (0, "mem", 1)
+
+    for i in range(FeedbackStore.MAX_ENTRIES + 16):
+        fs.record(f"fp{i}", _Cat(), ["t"], "local", {"x": 1}, 0)
+    assert fs.stats()["entries"] == FeedbackStore.MAX_ENTRIES
+
+
+# --- recursive salted repartitioning -----------------------------------------
+
+def test_salted_split_bounds_and_conserves():
+    rng = np.random.default_rng(1)
+    rk = rng.integers(0, 40, 20000).astype(np.int64)
+    lk = rng.integers(0, 40, 8000).astype(np.int64)
+    out, stats = [], {"sub": 0, "oversized": 0, "hot": []}
+    _salted_split(lk, rk, np.arange(lk.size), np.arange(rk.size),
+                  4096, "inner", 1000, np.uint64(1), 0, out, stats)
+    assert stats["oversized"] == 0
+    assert max(b.size for _, b in out) <= 4096
+    # every build row lands in exactly one lane (and probe rows follow keys)
+    allb = np.concatenate([b for _, b in out])
+    assert np.array_equal(np.sort(allb), np.arange(rk.size))
+    allp = np.concatenate([p for p, _ in out])
+    assert np.array_equal(np.sort(allp), np.arange(lk.size))
+
+
+def test_salted_split_single_key_records_hot():
+    rk = np.full(9000, 7, dtype=np.int64)
+    lk = np.full(100, 7, dtype=np.int64)
+    out, stats = [], {"sub": 0, "oversized": 0, "hot": []}
+    _salted_split(lk, rk, np.arange(100), np.arange(9000),
+                  4096, "inner", 1000, np.uint64(1), 0, out, stats)
+    assert len(out) == 1 and stats["oversized"] == 1
+    assert stats["hot"] == [(7, 9000)]
+
+
+def test_salted_split_depth_bound():
+    # entering AT the cap must emit the partition as one oversized pass
+    # instead of recursing, even though its keys are splittable
+    rk = np.repeat(np.arange(8, dtype=np.int64), 1000)
+    lk = np.arange(8, dtype=np.int64)
+    out, stats = [], {"sub": 0, "oversized": 0, "hot": []}
+    _salted_split(lk, rk, np.arange(8), np.arange(8000), 4096, "inner",
+                  10 ** 9, np.uint64(1), MAX_SALT_DEPTH, out, stats)
+    assert len(out) == 1 and stats["oversized"] == 1 and stats["sub"] == 0
+    assert MAX_SALT_DEPTH >= 2
+
+
+# --- static + dynamic key-channel gates --------------------------------------
+
+def _src_lint():
+    spec = importlib.util.spec_from_file_location(
+        "sr_src_lint", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "src_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+BAD_CONSULT = '''
+def consult(plan, catalog):
+    if config.get("serve_pool_size"):  # NOT on any key channel
+        return None
+'''
+
+GOOD_CONSULT = '''
+def consult(plan, catalog):
+    if not config.get("plan_feedback"):
+        return None
+'''
+
+
+def test_src_lint_r6_golden_fixtures():
+    sl = _src_lint()
+    bad = sl.lint_feedback_keys(src=BAD_CONSULT)
+    assert len(bad) == 1 and "feedback-key-knob" in bad[0]
+    assert "serve_pool_size" in bad[0]
+    assert sl.lint_feedback_keys(src=GOOD_CONSULT) == []
+    # and the REAL module is clean under the same rule
+    assert sl.lint_feedback_keys() == []
+
+
+def test_check_feedback_reads_audit():
+    from starrocks_tpu.analysis.key_check import check_feedback_reads
+    assert check_feedback_reads({"plan_feedback"}) == []
+    assert check_feedback_reads({"join_recursive_repartition"}) == []
+    bad = check_feedback_reads({"serve_pool_size"})
+    assert len(bad) == 1
+    assert bad[0].invariant == "knob-outside-feedback-key"

@@ -13,6 +13,11 @@ upgrades, merged into BENCH_DETAIL.json under "join_bench".
    key previously forced the ENTIRE build through the partition loop —
    the acceptance scenario: hybrid spills zero partitions and must not
    lose to grace.
+3. oversized cold partition: many sub-threshold keys crafted to hash
+   into one partition whose build is 4x the batch budget. Legacy runs
+   it as one oversized pass; recursive salted repartitioning
+   (join_recursive_repartition, ISSUE 11) must bound every pass's build
+   by the budget while returning identical rows.
 
 Usage: python tools/join_bench.py [--rows N] [--build N] [--repeats N]
        [--no-detail]
@@ -160,12 +165,97 @@ def bench_skewed_hybrid_vs_grace(n_probe: int, n_build: int, repeats: int,
     return out
 
 
+def bench_oversized_cold_recursion(repeats: int,
+                                   batch_rows: int = 8192) -> dict:
+    """A/B for recursive salted repartitioning (NEXT 11a): MANY distinct
+    keys — every per-key count under the skew threshold, so nothing
+    qualifies for the broadcast lane — crafted to hash into ONE cold
+    partition. Legacy (`join_recursive_repartition=off`) must run that
+    partition as a single pass whose build is 4x the batch budget; the
+    recursion re-salts it into sub-passes, each within budget."""
+    import numpy as np
+
+    from starrocks_tpu.column import HostTable
+    from starrocks_tpu.native import hash_partition_i64
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.session import Session
+    from starrocks_tpu.storage.catalog import Catalog
+
+    n_build = batch_rows * 4
+    n_parts = 4  # == ceil(n_build / batch_rows) once every key is cold
+    thresh = max(batch_rows // max(config.get("join_skew_factor"), 1), 1)
+    per_key = max(thresh // 2, 1)
+    need = -(-n_build // per_key)
+    keys: list = []
+    k = 0
+    while len(keys) < need:  # mine keys that land in partition 0
+        cand = np.arange(k, k + 100_000, dtype=np.int64)
+        keys.extend(int(x) for x in cand[
+            hash_partition_i64(cand, n_parts) == 0])
+        k += 100_000
+    keys = np.asarray(keys[:need], dtype=np.int64)
+    rng = np.random.default_rng(23)
+    bk = np.repeat(keys, per_key)[:n_build].copy()
+    rng.shuffle(bk)
+    pk = rng.choice(keys, n_build * 2)  # probe 2x build so dim stays the
+    # build side under the DP join order
+
+    cat = Catalog()
+    cat.register("fact", HostTable.from_pydict({
+        "k": list(int(x) for x in pk),
+        "v": list(int(x) for x in rng.integers(0, 100, pk.size))}))
+    cat.register("dim", HostTable.from_pydict({
+        "k": list(int(x) for x in bk),
+        "w": list(int(x) for x in rng.integers(0, 100, n_build))}))
+    s = Session(cat)
+    q = "SELECT count(*) c, sum(v + w) sv FROM fact, dim WHERE fact.k = dim.k"
+    old_t = config.get("batch_rows_threshold")
+    old_b = config.get("spill_batch_rows")
+    config.set("batch_rows_threshold", batch_rows)
+    config.set("spill_batch_rows", batch_rows)
+    out = {"rows_probe": int(pk.size), "rows_build": n_build,
+           "batch_rows": batch_rows, "distinct_keys": int(keys.size)}
+    try:
+        results = {}
+        for mode in (True, False):
+            config.set("join_recursive_repartition", mode)
+            results[mode] = s.sql(q).rows()
+            ctr = {}
+
+            def walk(p):
+                ctr.update({k: v for k, (v, _) in p.counters.items()})
+                for c in p.children:
+                    walk(c)
+
+            walk(s.last_profile)
+            key = "recursive" if mode else "legacy"
+            for c in ("join_max_pass_build", "join_subpartitions",
+                      "join_oversized_passes", "join_spilled_partitions"):
+                if c in ctr:
+                    out[f"{key}_{c[5:]}"] = int(ctr[c])
+            best = _best(lambda: s.sql(q), repeats)
+            out[f"{key}_ms"] = round(best * 1000, 2)
+        assert results[True] == results[False], "recursive != legacy rows"
+        assert out["legacy_max_pass_build"] > batch_rows, (
+            "scenario failed to build an oversized cold partition")
+        assert out["recursive_max_pass_build"] <= batch_rows, (
+            "recursion left a pass above the batch budget")
+        out["recursion_speedup"] = round(
+            out["legacy_ms"] / out["recursive_ms"], 3)
+    finally:
+        config.set("batch_rows_threshold", old_t)
+        config.set("spill_batch_rows", old_b)
+        config.set("join_recursive_repartition", True)
+    return out
+
+
 def run_join_bench(rows: int = 1 << 20, build: int = 1 << 16,
                    repeats: int = 3, skew_batch: int = 65_536) -> dict:
     return {
         "probe_strategies": bench_probe_strategies(rows, build, repeats),
         "skewed_hybrid_vs_grace": bench_skewed_hybrid_vs_grace(
             rows, max(build * 2, 1 << 17), repeats, skew_batch),
+        "oversized_cold_recursion": bench_oversized_cold_recursion(repeats),
     }
 
 

@@ -25,6 +25,10 @@ Phases:
   4. optional **--chaos** — arms a handful of failpoints (times-bounded)
      mid-run; the run must finish with zero leaked slots/bytes/registry
      entries and an acyclic lock-witness graph.
+  5. **feedback** — in-process A/B of the plan-feedback loop (ISSUE 11):
+     learn/repeat/steady passes with `plan_feedback` off vs on; the on
+     arm must pre-tighten the restart-analog repeat pass to zero
+     adaptive recompiles and hold steady-state fresh compiles at zero.
 
 Summary JSON prints on the last line (the driver's bench contract);
 --detail merges a "serve" section into BENCH_DETAIL.json.
@@ -243,11 +247,81 @@ def run_phase(mysql_port: int, http_port: int, statements, weights,
     }
 
 
+def run_feedback_phase(cat, statements) -> dict:
+    """A/B of the plan-feedback loop (ISSUE 11) over the serve mix plus a
+    guaranteed-overflow expansion join. Three passes per arm, in process:
+
+      learn  — fresh session, cold everything: pays compiles AND the
+               adaptive overflow retries that teach the store;
+      repeat — NEW session (cold program/opt caches, the restart analog)
+               with the feedback store carried over: feedback-on must
+               pre-tighten to ZERO adaptive recompiles;
+      steady — same session again: second executions must ride the
+               program cache end to end (zero fresh compiles — the
+               consult-token fixpoint keeping the opt-plan key warm).
+    """
+    import numpy as np
+
+    from starrocks_tpu.column import HostTable
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.feedback import (
+        FEEDBACK_EST_ERRSUM, FEEDBACK_EST_JOINS, FEEDBACK_HITS,
+        FEEDBACK_RETRIES_AVOIDED)
+    from starrocks_tpu.runtime.metrics import PROGRAM_COMPILES, RECOMPILES
+    from starrocks_tpu.runtime.session import Session
+
+    rng = np.random.default_rng(29)
+    cat.register("fb_fact", HostTable.from_pydict({
+        "k": [int(x) for x in rng.integers(0, 20, 2000)],
+        "v": list(range(2000))}))
+    cat.register("fb_dim", HostTable.from_pydict({
+        "k": [int(x) for x in rng.integers(0, 20, 2000)],
+        "w": list(range(2000))}))
+    mix = [sql for _, sql in statements] + [
+        "select count(*) c, sum(f.v + d.w) s from fb_fact f "
+        "join fb_dim d on f.k = d.k"]
+
+    def run_pass(sess) -> dict:
+        c0, r0 = PROGRAM_COMPILES.value, RECOMPILES.value
+        for sql in mix:
+            sess.sql(sql)
+        return {"compiles": PROGRAM_COMPILES.value - c0,
+                "recompiles": RECOMPILES.value - r0}
+
+    out: dict = {"mix_statements": len(mix)}
+    try:
+        for mode in ("off", "on"):
+            config.set("plan_feedback", mode == "on")
+            h0, a0 = FEEDBACK_HITS.value, FEEDBACK_RETRIES_AVOIDED.value
+            e0, j0 = FEEDBACK_EST_ERRSUM.value, FEEDBACK_EST_JOINS.value
+            s1 = Session(cat)
+            res = {"learn": run_pass(s1)}
+            s2 = Session(cat)  # restart analog: cold caches, same catalog
+            s2.cache.feedback = s1.cache.feedback
+            res["repeat"] = run_pass(s2)
+            res["steady"] = run_pass(s2)
+            res["feedback_hits"] = FEEDBACK_HITS.value - h0
+            res["retries_avoided"] = FEEDBACK_RETRIES_AVOIDED.value - a0
+            joins = FEEDBACK_EST_JOINS.value - j0
+            if joins:
+                res["est_rel_err"] = round(
+                    (FEEDBACK_EST_ERRSUM.value - e0) / joins, 3)
+            out[mode] = res
+    finally:
+        config.set("plan_feedback", True)
+        cat.drop("fb_fact", if_exists=True)
+        cat.drop("fb_dim", if_exists=True)
+    out["repeat_retries_saved_vs_off"] = (
+        out["off"]["repeat"]["recompiles"]
+        - out["on"]["repeat"]["recompiles"])
+    return out
+
+
 def run_serve_bench(threads: int = 32, seconds: float = 8.0,
                     sf: float = 0.01, pool: int = 4,
                     include_ssb: bool = False, http_frac: float = 0.25,
                     chaos: bool = False, single_thread_ab: bool = True,
-                    warm: bool = True) -> dict:
+                    warm: bool = True, feedback: bool = True) -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -358,6 +432,9 @@ def run_serve_bench(threads: int = 32, seconds: float = 8.0,
             ht2.stop()
             config.set("enable_query_cache", False)
 
+    if feedback:
+        out["feedback"] = run_feedback_phase(cat, statements)
+
     # leak + witness audit (the chaos-suite contract, applied to serving)
     wm = getattr(cat, "workgroups", None)
     out["leaks"] = {
@@ -386,6 +463,8 @@ def main():
                     help="skip the forced single-thread A/B run")
     ap.add_argument("--no-warm", action="store_true",
                     help="skip the warm (query-cache on) phase")
+    ap.add_argument("--no-feedback", action="store_true",
+                    help="skip the plan-feedback effectiveness A/B phase")
     ap.add_argument("--detail", action="store_true",
                     help="merge a 'serve' section into BENCH_DETAIL.json")
     args = ap.parse_args()
@@ -394,7 +473,7 @@ def main():
         threads=args.threads, seconds=args.seconds, sf=args.sf,
         pool=args.pool, include_ssb=args.ssb, http_frac=args.http_frac,
         chaos=args.chaos, single_thread_ab=not args.no_ab,
-        warm=not args.no_warm)
+        warm=not args.no_warm, feedback=not args.no_feedback)
     if args.detail:
         path = os.path.join(REPO, "BENCH_DETAIL.json")
         detail = {}
@@ -402,6 +481,8 @@ def main():
             with open(path) as f:
                 detail = json.load(f)
         detail["serve"] = res
+        if "feedback" in res:
+            detail["feedback"] = res["feedback"]
         with open(path, "w") as f:
             json.dump(detail, f, indent=1)
     print(json.dumps(res))

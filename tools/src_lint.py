@@ -46,6 +46,17 @@ R5 serve-query-scope: the serving tier's executor-pool worker body
    query_scope is invisible to SHOW PROCESSLIST, unkillable, deadline-
    free, and unaccounted — the exact bug class thread fan-out invites.
 
+R6 feedback-key-knob: in the plan-feedback consult path
+   (starrocks_tpu/runtime/feedback.py), every LITERAL `config.get("name")`
+   must name a knob on SOME cache-key channel: declared trace=True or
+   cache_key=True at its config.define site, or listed in OPT_KEY_KNOBS /
+   HOST_LOOP_KNOBS (analysis/key_check.py). Feedback entries are keyed by
+   a fingerprint over exactly those channels — a consult that also reads
+   an un-channeled knob could hand two different observation sets to two
+   executions with identical fingerprints, silently splitting the learned
+   state (analysis/key_check.check_feedback_reads audits the DYNAMIC
+   read-set; this rule pins the STATIC one).
+
 The lint also counts `fail_point()` call sites across the package and
 fails below the chaos-suite floor (MIN_FAILPOINT_SITES): fault-injection
 coverage is an invariant here, not a nice-to-have.
@@ -332,6 +343,78 @@ def lint_cache_keys() -> list:
     return findings
 
 
+FEEDBACK_MODULE = os.path.join("starrocks_tpu", "runtime", "feedback.py")
+KEY_CHECK_MODULE = os.path.join(PKG, "analysis", "key_check.py")
+
+
+def _keyed_knob_channels() -> set:
+    """Every knob name on SOME cache-key channel: declared trace=True or
+    cache_key=True in runtime/config.py, plus the members of OPT_KEY_KNOBS
+    and HOST_LOOP_KNOBS in analysis/key_check.py — all statically parsed,
+    same no-import discipline as R3."""
+    names = {k for k, (t, c) in _declared_key_knobs().items() if t or c}
+    with open(KEY_CHECK_MODULE) as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if not (isinstance(tgt, ast.Name)
+                    and tgt.id in ("OPT_KEY_KNOBS", "HOST_LOOP_KNOBS")):
+                continue
+            v = node.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                names |= {e.value for e in v.elts
+                          if isinstance(e, ast.Constant)
+                          and isinstance(e.value, str)}
+            elif isinstance(v, ast.Dict):
+                names |= {k.value for k in v.keys
+                          if isinstance(k, ast.Constant)
+                          and isinstance(k.value, str)}
+    return names
+
+
+def lint_feedback_keys(src: str | None = None,
+                       rel: str = FEEDBACK_MODULE) -> list:
+    """R6: see module docstring. `src` is injectable so the golden
+    bad-fixture test (tests/test_plan_feedback.py) can prove the rule
+    rejects what it exists to reject."""
+    if src is None:
+        path = os.path.join(REPO, rel)
+        if not os.path.exists(path):
+            return [f"{rel}:1: [feedback-key-knob] plan-feedback module "
+                    f"missing (the consult path is a keyed surface)"]
+        with open(path) as f:
+            src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: [parse] {e.msg}"]
+    channels = _keyed_knob_channels()
+    lines = src.splitlines()
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "config"
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "lint: host-ok" in line:
+            continue
+        name = node.args[0].value
+        if name not in channels:
+            findings.append(
+                f"{rel}:{node.lineno}: [feedback-key-knob] "
+                f"config.get({name!r}) in the feedback consult path is on "
+                f"no cache-key channel (trace/cache_key declaration, "
+                f"OPT_KEY_KNOBS, or HOST_LOOP_KNOBS): identical plan "
+                f"fingerprints could consult different observations")
+    return findings
+
+
 SERVING_MODULE = os.path.join("starrocks_tpu", "runtime", "serving.py")
 _SESSION_INTERNALS = {"_sql_inner", "_query_planned", "_query_admitted",
                       "execute_logical"}
@@ -401,6 +484,7 @@ def main():
     for ms in sources:
         findings += lint_module(ms)
     findings += lint_cache_keys()
+    findings += lint_feedback_keys()
     findings += lint_serving_scope(sources)
     n_fp = count_failpoints(sources)
     if n_fp < MIN_FAILPOINT_SITES:
