@@ -698,10 +698,30 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         "concur_findings": _concur_findings(),
         "qcancelled": chaos["qcancelled"],
         "qtimeout": chaos["qtimeout"],
+        **_latency_percentiles(),
         **({"qcache_repeat": qrepeat, **qcache_totals} if qrepeat > 1
            else {}),
         **serve,
     }))
+
+
+def _latency_percentiles() -> dict:
+    """p50/p95/p99 of read-statement latency from the process-wide
+    histogram every query in this bench run observed into (runtime/
+    lifecycle.py LATENCY_READ_MS) — the same series /metrics exports, so
+    the bench summary and a Prometheus quantile query agree on the data."""
+    try:
+        from starrocks_tpu.runtime.lifecycle import LATENCY_READ_MS
+
+        if not LATENCY_READ_MS.value:
+            return {}
+        return {
+            "latency_p50_ms": round(LATENCY_READ_MS.percentile(0.50), 2),
+            "latency_p95_ms": round(LATENCY_READ_MS.percentile(0.95), 2),
+            "latency_p99_ms": round(LATENCY_READ_MS.percentile(0.99), 2),
+        }
+    except Exception:  # noqa: BLE001 — the bench line must print
+        return {}
 
 
 def main():

@@ -107,44 +107,50 @@ def try_partial_cached(executor, plan, profile):
         # admitting some segments of an aborted attempt makes leak
         # accounting and before/after snapshots unauditable)
         pending_puts = []
-        for fmeta in seg_metas:
-            fail_point("qcache::partial_segment")
-            lifecycle.checkpoint("qcache::partial_segment")
-            ver = cache_keys.segment_version(store, handle.name, fmeta)
-            live = fmeta["rows"] - len(fmeta.get("delvec") or ())
-            ent = qc.get_partial(fkey, ver) if ver is not None else None
-            if ent is not None:
-                states.append(ent.table)
-                hits += 1
-                saved += ent.rows
-                continue
-            ht = store.load_table(
-                handle.name, columns=list(bp.scan.columns),
-                files={fmeta["file"]})
-            chunk = slice_scan_chunk(
-                ht, bp.scan.alias, bp.scan.columns, slice(None),
-                pad_capacity(max(ht.num_rows, 1)))
-            out, ng = jpartial(chunk)
-            ng = int(ng)
-            max_ng = max(max_ng, ng)
-            fresh_rows += live
-            if ng > group_cap:
-                # truncated state: report the overflow so _adaptive grows
-                # the capacity; segments already cached stay (they fit)
-                executor.cache.bucket_last_set(bucket, caps.values)
-                return None, [(CAP_KEY, max_ng)]
-            st = HostTable.from_chunk(out)
-            lifecycle.account(st, "qcache::partial_segment")
-            states.append(st)
-            if ver is not None:
-                pending_puts.append((ver, st, live))
+        # segment-loop and merge spans surface in the trace export, so a
+        # Perfetto view shows where a partial-tier query spent its time
+        with p.timer("segments"):
+            for fmeta in seg_metas:
+                fail_point("qcache::partial_segment")
+                lifecycle.checkpoint("qcache::partial_segment")
+                ver = cache_keys.segment_version(store, handle.name, fmeta)
+                live = fmeta["rows"] - len(fmeta.get("delvec") or ())
+                ent = qc.get_partial(fkey, ver) if ver is not None else None
+                if ent is not None:
+                    states.append(ent.table)
+                    hits += 1
+                    saved += ent.rows
+                    continue
+                ht = store.load_table(
+                    handle.name, columns=list(bp.scan.columns),
+                    files={fmeta["file"]})
+                chunk = slice_scan_chunk(
+                    ht, bp.scan.alias, bp.scan.columns, slice(None),
+                    pad_capacity(max(ht.num_rows, 1)))
+                out, ng = jpartial(chunk)
+                ng = int(ng)
+                max_ng = max(max_ng, ng)
+                fresh_rows += live
+                if ng > group_cap:
+                    # truncated state: report the overflow so _adaptive
+                    # grows the capacity; segments already cached stay
+                    # (they fit)
+                    executor.cache.bucket_last_set(bucket, caps.values)
+                    return None, [(CAP_KEY, max_ng)]
+                st = HostTable.from_chunk(out)
+                lifecycle.account(st, "qcache::partial_segment")
+                states.append(st)
+                if ver is not None:
+                    pending_puts.append((ver, st, live))
 
         lifecycle.checkpoint("qcache::partial_merge")
-        merged = states[0]
-        for st in states[1:]:
-            merged = concat_tables(merged, st, target_schema=merged.schema)
-        out, ng = jfinal(merged.to_chunk())
-        ng = int(ng)
+        with p.timer("merge_final"):
+            merged = states[0]
+            for st in states[1:]:
+                merged = concat_tables(merged, st,
+                                       target_schema=merged.schema)
+            out, ng = jfinal(merged.to_chunk())
+            ng = int(ng)
         executor.cache.bucket_last_set(bucket, caps.values)
         if lifecycle.degraded():
             p.set_info("qcache_declined", "mem-soft-degraded")

@@ -812,6 +812,10 @@ def execute_hybrid_join(
     checks_max: dict = {}
 
     def run_lane(probe_idx, build_idx, rcap: int, site: str):
+        with profile_node.timer(site.partition("::")[2] or site):
+            _run_lane(probe_idx, build_idx, rcap, site)
+
+    def _run_lane(probe_idx, build_idx, rcap: int, site: str):
         jpart, scans = get_prog(rcap)
         bchunk = slice_scan_chunk(rht, gp.right_scan.alias,
                                   gp.right_scan.columns, build_idx, rcap)

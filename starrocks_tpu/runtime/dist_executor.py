@@ -127,7 +127,23 @@ class DistExecutor(Executor):
             self.cache.bucket_last_set(
                 self.cache.program_bucket(("dist", self.n, plan)), vals)
 
-        return self._adaptive(profile, attempt, publish)
+        out = self._adaptive(profile, attempt, publish)
+        self._bind_operators(profile, self._dist_node_ord(plan))
+        return out
+
+    @staticmethod
+    def _dist_node_ord(plan) -> dict:
+        """The distributed compiler's node-ordinal table, reconstructed
+        host-side: compile_distributed assigns deterministic PRE-ORDER
+        ordinals over walk_plan before lowering (sql/distributed.py), so
+        the table needs no trace — attribution works identically on
+        program-cache hits and across the monolithic/fragment A/B pair."""
+        from ..sql.logical import walk_plan
+
+        node_ord: dict = {}
+        for nd in walk_plan(plan):
+            node_ord.setdefault(nd, len(node_ord))
+        return node_ord
 
     @staticmethod
     def _host_max(v) -> int:
@@ -249,6 +265,7 @@ class DistExecutor(Executor):
         profile.set_info("exchanges", st["exchanges"])
         profile.add_counter("exchange_rows", st["exchange_rows"])
         profile.add_counter("exchange_bytes", st["exchange_bytes"])
+        profile.set_info("fragment_topology", st["per_fragment"])
 
         def attempt(caps, p):
             with p.timer("scan_to_device"):
@@ -278,7 +295,9 @@ class DistExecutor(Executor):
                     fragment_program_key(self.n, plan, ir.fragments[0])),
                 vals)
 
-        return self._adaptive(profile, attempt, publish)
+        out = self._adaptive(profile, attempt, publish)
+        self._bind_operators(profile, self._dist_node_ord(plan))
+        return out
 
     def _fragment_attempt(self, plan, frag, caps, p, inputs, bnd,
                           scans_meta):
@@ -300,7 +319,11 @@ class DistExecutor(Executor):
         if hit is None:
             fail_point("executor::before_compile")
             lifecycle.checkpoint("executor::before_compile")
-            with config.record_reads() as reads:
+            # per-fragment compile vs execute split: the trace happens
+            # lazily inside the first call, so the compile timer covers
+            # lowering + trace and the execute timer the dispatched call
+            with p.timer(f"fragment_{frag.fid}_compile"), \
+                    config.record_reads() as reads:
                 fn, raw = self._compile_fragment(
                     plan, frag, caps, inputs, bnd, scans_meta)
                 fail_point("executor::before_dispatch")
@@ -311,10 +334,15 @@ class DistExecutor(Executor):
             fn, _ = hit
             fail_point("executor::before_dispatch")
             lifecycle.checkpoint("executor::before_dispatch")
-            out, checks = fn(inputs, bnd)
-            jax.block_until_ready(out.data)
+            with p.timer(f"fragment_{frag.fid}_execute"):
+                out, checks = fn(inputs, bnd)
+                jax.block_until_ready(out.data)
         if raw is not None:
             self._verify_compile(raw, inputs, reads, p, extra_args=(bnd,))
+            if config.get("enable_device_profile"):
+                from .executor import _attach_device_profile
+
+                _attach_device_profile(fn, (inputs, bnd), p)
         self.cache.bucket_prog_put(
             bucket, tuple(sorted(caps.values.items())), (fn, scans_meta))
         self.cache.bucket_last_set(bucket, caps.values)

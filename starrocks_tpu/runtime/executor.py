@@ -13,6 +13,7 @@ host loop around it implements
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import jax
 import numpy as np
@@ -33,12 +34,47 @@ from . import lifecycle
 from .config import config
 from .failpoint import fail_point
 from .metrics import (PROGRAM_COMPILES, QUERIES_TOTAL, QUERY_ERRORS,
-                      RECOMPILES, ROWS_RETURNED)
+                      RECOMPILES, ROWS_RETURNED, metrics)
 from .profile import RuntimeProfile
+
+COMPILE_MS = metrics.histogram(
+    "sr_tpu_compile_ms",
+    "fresh-program milliseconds from trace start through the first device "
+    "call (jit traces lazily inside that call)")
 
 
 class ExecError(RuntimeError):
     pass
+
+
+def _attach_device_profile(fn, args, p: RuntimeProfile):
+    """Optional XLA introspection (`SET enable_device_profile`): AOT-lower
+    the freshly cached program and attach `cost_analysis()` /
+    `memory_analysis()` facts to the attempt profile. Costs an extra
+    lowering per fresh program and must never fail the query."""
+    try:
+        comp = fn.lower(*args).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        facts = {}
+        for k in ("flops", "transcendentals", "bytes accessed"):
+            v = (ca or {}).get(k)
+            if isinstance(v, (int, float)):
+                facts[k] = float(v)
+        mem = comp.memory_analysis()
+        memd = {}
+        for a in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(mem, a, None)
+            if isinstance(v, int):
+                memd[a] = v
+        if facts:
+            p.set_info("device_cost", facts)
+        if memd:
+            p.set_info("device_memory", memd)
+    except Exception:  # noqa: BLE001  # lint: swallow-ok — introspection must never fail a query
+        pass
 
 
 class DeviceCache:
@@ -174,6 +210,17 @@ class DeviceCache:
         two threads racing a cold key both compile, one result is kept."""
         with self._lock:
             return bucket["progs"].setdefault(key, val)
+
+    def bucket_meta_set(self, bucket, key, val):
+        """Attach side metadata to a program bucket (the trace's node-
+        ordinal table: EXPLAIN ANALYZE attribution must survive program-
+        cache hits, which never re-trace)."""
+        with self._lock:
+            bucket.setdefault("meta", {})[key] = val
+
+    def bucket_meta_get(self, bucket, key):
+        with self._lock:
+            return bucket.get("meta", {}).get(key)
 
     def opt_plan_lookup(self, key):
         with self._lock:
@@ -1067,8 +1114,21 @@ class Executor:
             if not overflow:
                 profile.add_counter("recompiles", attempt)
                 for k, v in ctrs:  # only the surviving attempt's counters
-                    profile.add_counter(k[len("~ctr_"):].split("@")[0],
-                                        int(v))
+                    base, _, o = k[len("~ctr_"):].partition("@")
+                    profile.add_counter(base, int(v))
+                    if o.isdigit():
+                        # ordinal-suffixed device counters feed the per-
+                        # operator counter groups EXPLAIN ANALYZE renders
+                        profile.op_counter(int(o), base, int(v))
+                # the surviving attempt's capacity-check totals ARE the
+                # per-operator observed rows (join_/agg_/wtop_/unnest_
+                # keys carry the plan ordinal) — the same channel the
+                # plan-feedback recorder rides
+                for key, v in keyed_checks:
+                    fam, _, o = key.rpartition("_")
+                    if fam and o.isdigit():
+                        profile.op_rows(int(o), fam, int(v),
+                                        caps.values.get(key))
                 sort_s = drain_sort_stamps()
                 if sort_s:
                     profile.add_counter("sort_ms", sort_s * 1000.0, "ms")
@@ -1148,6 +1208,12 @@ class Executor:
             def compile_cb():
                 compiled = compile_plan(plan, self.catalog, caps)
                 trace_box["node_ord"] = compiled.node_ord
+                # stash the (lazily-filling) ordinal table on the bucket:
+                # attribution must survive program-cache hits, which
+                # never re-trace
+                self.cache.bucket_meta_set(
+                    self.cache.program_bucket(("local", plan)),
+                    "node_ord", compiled.node_ord)
                 return (jax.jit(compiled.fn),
                         (compiled.scans, compiled.aux), compiled.fn)
 
@@ -1177,9 +1243,21 @@ class Executor:
             self.cache.bucket_last_set(
                 self.cache.program_bucket(("local", plan)), vals)
 
-        return self._adaptive(profile, attempt, publish,
-                              self._fb_recorder("local", profile,
-                                                trace_box))
+        out = self._adaptive(profile, attempt, publish,
+                             self._fb_recorder("local", profile,
+                                               trace_box))
+        node_ord = trace_box.get("node_ord") or self.cache.bucket_meta_get(
+            self.cache.program_bucket(("local", plan)), "node_ord")
+        self._bind_operators(profile, node_ord)
+        return out
+
+    @staticmethod
+    def _bind_operators(profile, node_ord):
+        """Publish the executed program's node-ordinal table on the
+        profile: EXPLAIN ANALYZE joins it against the per-ordinal operator
+        records _adaptive collected (observed rows, counter groups)."""
+        if node_ord:
+            profile.node_ord = dict(node_ord)
 
     def _try_partial_cache(self, plan, profile):
         """Per-segment partial-aggregation tier (cache/partial.py): for a
@@ -1295,24 +1373,43 @@ class Executor:
                                         for k, c in parts.build_hot]}
                     return out
 
+            # host-side pre-order ordinals over the ORIGINAL plan: the
+            # hybrid/grace runners emit bare host counters (skew keys,
+            # spilled partitions, ...) which all belong to the one join
+            # node this path matched — suffix them so EXPLAIN ANALYZE
+            # groups them under that operator
+            from ..sql.logical import walk_plan
+
+            plan_ord: dict = {}
+            for _n in walk_plan(plan):
+                plan_ord.setdefault(_n, len(plan_ord))
+            join_ord = plan_ord.get(gp.join)
+
             def attempt(caps, p):
                 # adopt-last protocol (mirrors _cached_attempt): cached
                 # partition programs return checks for capacity keys that
                 # only exist in the caps they were compiled with
                 self.cache.bucket_adopt_last(bucket, caps)
-                out = runner(
+                out, checks = runner(
                     gp, self.catalog, caps, p, parts,
                     _BucketProgs(self.cache, bucket), self
                 )
                 self.cache.bucket_last_set(bucket, caps.values)
-                return out
+                if join_ord is not None:
+                    checks = [
+                        (f"{k}@{join_ord}"
+                         if k.startswith("~ctr_") and "@" not in k else k, v)
+                        for k, v in checks]
+                return out, checks
 
             def publish(vals):
                 self.cache.bucket_last_set(bucket, vals)
 
-            return self._adaptive(profile, attempt, publish,
-                                  self._fb_recorder(tag, profile,
-                                                    extra_fn=extra_fn))
+            out = self._adaptive(profile, attempt, publish,
+                                 self._fb_recorder(tag, profile,
+                                                   extra_fn=extra_fn))
+            self._bind_operators(profile, plan_ord)
+            return out
         handle = self.catalog.get_table(bp.scan.table)
         if handle is None or handle.row_count <= batch_threshold:
             return None
@@ -1361,6 +1458,7 @@ class Executor:
             # record every knob read from compile through the first call
             # (jit traces lazily INSIDE that call) — the key-completeness
             # checker's probe window
+            w0, t0 = time.time(), time.perf_counter()
             with config.record_reads() as reads:
                 fn, scans, raw = compile_cb()
                 with p.timer("scan_to_device"):
@@ -1369,6 +1467,10 @@ class Executor:
                 lifecycle.checkpoint("executor::before_dispatch")
                 out, checks = fn(inputs)
                 jax.block_until_ready(out.data)
+            dur = time.perf_counter() - t0
+            p.add_counter("compile_first_run", dur, "s")
+            p.spans.append(("compile_first_run", w0, dur))
+            COMPILE_MS.observe(dur * 1000.0)
         else:
             fn, scans = hit
             with p.timer("scan_to_device"):
@@ -1379,6 +1481,8 @@ class Executor:
             jax.block_until_ready(out.data)
         if raw is not None:
             self._verify_compile(raw, inputs, reads, p)
+            if config.get("enable_device_profile"):
+                _attach_device_profile(fn, (inputs,), p)
         # caps defaults fill during the first trace; record entries after it
         self.cache.bucket_prog_put(
             bucket, tuple(sorted(caps.values.items())), (fn, scans))

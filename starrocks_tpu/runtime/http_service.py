@@ -42,6 +42,28 @@ def make_handler(session: Session, tier: ServingTier):
             self.wfile.write(data)
 
         def do_GET(self):
+            import re
+
+            m = re.fullmatch(r"/api/query/(\d+)/(profile|trace)", self.path)
+            if m is not None:
+                from .profile import PROFILE_MANAGER, trace_json
+
+                e = PROFILE_MANAGER.get(int(m.group(1)))
+                if e is None:
+                    self._send(404, json.dumps(
+                        {"error": f"no profile retained for query "
+                                  f"{m.group(1)}"}))
+                elif m.group(2) == "trace":
+                    # Chrome trace_event format — loads directly in
+                    # Perfetto / chrome://tracing
+                    self._send(200, json.dumps(trace_json(e)))
+                else:
+                    body = {k: e.get(k) for k in (
+                        "query_id", "user", "sql", "state", "ms", "rows",
+                        "queue_wait_ms", "slow", "stage", "profile")}
+                    body["text"] = e.get("text", "")
+                    self._send(200, json.dumps(body, default=str))
+                return
             if self.path == "/metrics":
                 from . import failpoint
 

@@ -55,6 +55,47 @@ MEM_DEGRADED = metrics.counter(
     "sr_tpu_mem_soft_degraded_total",
     "queries that crossed the soft memory limit and degraded")
 
+# Per-statement-class latency distributions (the audit-log latency view,
+# scrape-side): classes are keyed off the leading keyword, matching the
+# serving tier's read/exclusive split plus a DDL bucket.
+LATENCY_READ_MS = metrics.histogram(
+    "sr_tpu_query_latency_ms_read",
+    "wall milliseconds of read statements (SELECT/SHOW/EXPLAIN/...)")
+LATENCY_DML_MS = metrics.histogram(
+    "sr_tpu_query_latency_ms_dml",
+    "wall milliseconds of DML statements (INSERT/UPDATE/DELETE/LOAD)")
+LATENCY_DDL_MS = metrics.histogram(
+    "sr_tpu_query_latency_ms_ddl",
+    "wall milliseconds of DDL statements (CREATE/DROP/ALTER/TRUNCATE)")
+LATENCY_OTHER_MS = metrics.histogram(
+    "sr_tpu_query_latency_ms_other",
+    "wall milliseconds of statements outside the read/dml/ddl classes")
+
+_DML_HEADS = frozenset(("insert", "update", "delete", "load"))
+_DDL_HEADS = frozenset(("create", "drop", "alter", "truncate", "refresh"))
+_READ_HEADS = frozenset(("select", "with", "values", "show", "explain",
+                         "describe", "desc"))
+
+
+def statement_class(sql: str) -> str:
+    head = sql.lstrip().split(None, 1)
+    kw = head[0].lower().rstrip("(") if head else ""
+    if kw in _READ_HEADS:
+        return "read"
+    if kw in _DML_HEADS:
+        return "dml"
+    if kw in _DDL_HEADS:
+        return "ddl"
+    return "other"
+
+
+def observe_query_latency(sql: str, ms: float):
+    """Record one finished top-level statement into its class histogram
+    (Session.sql's unwind calls this on every exit path)."""
+    {"read": LATENCY_READ_MS, "dml": LATENCY_DML_MS,
+     "ddl": LATENCY_DDL_MS, "other": LATENCY_OTHER_MS}[
+        statement_class(sql)].observe(float(ms))
+
 
 class QueryAbortError(RuntimeError):
     """Base of the lifecycle's typed query errors."""
@@ -103,6 +144,11 @@ class QueryContext:
         self.degrade_reason = None
         self.last_stage = "start"
         self.queue_wait_ms = 0.0    # admission-lane wait (workgroup.py)
+        # the query's RuntimeProfile, stashed by Session._query so the
+        # ProfileManager can retain it on EVERY exit path — a killed or
+        # failed query's profile reports the stage it died at
+        self.profile = None
+        self.rows = 0               # result rows (set by the session)
         self._cancel_reason = None
         self._cleanups: list = []   # run LIFO on scope exit, every path
 
@@ -399,6 +445,24 @@ def account(obj, stage: str):
         ACCOUNTANT.charge(ctx, n, stage)
 
 
+def _finalize_observability(ctx: QueryContext):
+    """Terminal-state observability, run exactly once by the owning scope
+    on every exit path: retain the profile (ProfileManager — killed and
+    failed queries keep their last stage) and feed the per-class latency
+    histogram. Must never mask the query's own outcome."""
+    try:
+        from .profile import PROFILE_MANAGER
+
+        PROFILE_MANAGER.register(
+            qid=ctx.qid, user=ctx.user, sql=ctx.sql, state=ctx.state,
+            ms=ctx.elapsed_ms(), rows=ctx.rows,
+            queue_wait_ms=ctx.queue_wait_ms, stage=ctx.last_stage,
+            profile=ctx.profile)
+        observe_query_latency(ctx.sql, ctx.elapsed_ms())
+    except Exception:  # noqa: BLE001  # lint: swallow-ok — observability must never fail the unwind
+        pass
+
+
 def finalize_queued(ctx: QueryContext):
     """Unwind a pre-registered context whose statement was removed from
     the pool queue by a KILL before any worker adopted it: same terminal
@@ -409,6 +473,7 @@ def finalize_queued(ctx: QueryContext):
     ctx.run_cleanups()
     ACCOUNTANT.release_query(ctx)
     REGISTRY.deregister(ctx)
+    _finalize_observability(ctx)
 
 
 def degraded() -> bool:
@@ -465,3 +530,4 @@ def query_scope(sql: str, user: str = "root", group: str | None = None,
         ctx.run_cleanups()
         ACCOUNTANT.release_query(ctx)
         REGISTRY.deregister(ctx)
+        _finalize_observability(ctx)

@@ -16,6 +16,8 @@ sees a torn read ordering against `inc`.
 
 from __future__ import annotations
 
+import bisect
+
 from .. import lockdep
 
 
@@ -42,6 +44,82 @@ class Gauge(Counter):
             self._v = v
 
 
+# Latency-style default buckets (milliseconds): sub-ms fast-path hits up
+# through multi-second compile storms. Finite upper bounds only; +Inf is
+# implicit (the _count series).
+DEFAULT_BUCKETS_MS = (
+    0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000)
+
+
+class Histogram:
+    """Fixed-bucket histogram with Prometheus exposition semantics:
+    cumulative `_bucket{le=...}` series plus `_sum`/`_count`. Buckets are
+    immutable after construction, so `observe` is one bisect + two adds
+    under the metric's own lock."""
+
+    def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS_MS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._lock = lockdep.lock("Histogram._lock")
+        self._counts = [0] * (len(self.buckets) + 1)  # guarded_by: _lock
+        self._sum = 0.0  # guarded_by: _lock
+        self._n = 0      # guarded_by: _lock
+
+    def observe(self, v):
+        v = float(v)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._n += 1
+
+    def snapshot(self):
+        """(per-bucket counts incl. +Inf, sum, count) — one consistent read."""
+        with self._lock:
+            return list(self._counts), self._sum, self._n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._n
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (0..1) by linear interpolation inside the
+        owning bucket (the Prometheus histogram_quantile estimator). The
+        open +Inf bucket clamps to the largest finite bound."""
+        counts, _, n = self.snapshot()
+        if n == 0:
+            return 0.0
+        rank = q * n
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            lo = self.buckets[i - 1] if i > 0 else 0.0
+            hi = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+            if cum + c >= rank:
+                return lo + (hi - lo) * max(rank - cum, 0.0) / c
+            cum += c
+        return self.buckets[-1]
+
+    def render(self) -> list:
+        counts, s, n = self.snapshot()
+        out = []
+        if self.help:
+            out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} histogram")
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            le = f"{b:g}"
+            out.append(f'{self.name}_bucket{{le="{le}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {n}')
+        out.append(f"{self.name}_sum {s:g}")
+        out.append(f"{self.name}_count {n}")
+        return out
+
+
 class MetricRegistry:
     def __init__(self):
         self._lock = lockdep.lock("MetricRegistry._lock")
@@ -64,11 +142,22 @@ class MetricRegistry:
     def gauge(self, name: str, help_: str = "") -> Gauge:
         return self._get_or_create(name, Gauge, help_)
 
+    def histogram(self, name: str, help_: str = "",
+                  buckets=DEFAULT_BUCKETS_MS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Histogram(name, help_, buckets)
+            return m
+
     def render_prometheus(self) -> str:
         with self._lock:
             items = sorted(self._metrics.items())
         out = []
         for name, m in items:  # m.value takes the metric's own lock
+            if isinstance(m, Histogram):
+                out.extend(m.render())
+                continue
             kind = "gauge" if isinstance(m, Gauge) else "counter"
             if m.help:
                 out.append(f"# HELP {name} {m.help}")

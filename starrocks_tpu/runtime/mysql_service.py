@@ -429,10 +429,15 @@ class MySQLServer:
                 # status text rides in the info field
                 conn.send_ok(info=str(res).encode("utf-8", "replace"))
                 return
-            # EXPLAIN/SHOW text -> one-column resultset
-            rows = [(str(res),)] if not isinstance(res, list) else [
-                (str(r),) for r in res
-            ]
+            # EXPLAIN/SHOW text -> one-column resultset; multi-line text
+            # (EXPLAIN ANALYZE / SHOW PROFILE trees) renders one row per
+            # line so wire clients show the tree, not one folded cell
+            if isinstance(res, list):
+                rows = [(str(r),) for r in res]
+            elif isinstance(res, str) and "\n" in res:
+                rows = [(line,) for line in res.split("\n")]
+            else:
+                rows = [(str(res),)]
             conn.send_packet(lenenc_int(1))
             conn.send_column_def("result", T.VARCHAR)
             conn.send_eof()

@@ -75,6 +75,12 @@ SERVE_QUEUE_WAIT_MS = metrics.counter(
 SERVE_EXCLUSIVE = metrics.counter(
     "sr_tpu_serve_exclusive_total",
     "statements that took the exclusive (mutation) side of the gate")
+SERVE_QUEUE_WAIT_HIST = metrics.histogram(
+    "sr_tpu_serve_queue_wait_hist_ms",
+    "executor-pool queue wait distribution (milliseconds)")
+SERVE_FAST_PATH_HIST = metrics.histogram(
+    "sr_tpu_serve_fast_path_hist_ms",
+    "warm fast-path hit latency distribution (milliseconds)")
 
 # leading keyword -> shared (read) side of the statement gate; anything
 # else (DML/DDL/SET/ADMIN/...) is exclusive. KILL never reaches the tier.
@@ -272,7 +278,12 @@ class ExecutorPool:
         before any engine code — src_lint R5 enforces this shape."""
         from . import lifecycle
 
-        SERVE_QUEUE_WAIT_MS.inc(int((time.monotonic() - w.t0) * 1000))
+        wait_ms = (time.monotonic() - w.t0) * 1000.0
+        SERVE_QUEUE_WAIT_MS.inc(int(wait_ms))
+        SERVE_QUEUE_WAIT_HIST.observe(wait_ms)
+        # the context carries its pool wait so the profile's trace export
+        # and the query_log audit row both see the admission delay
+        w.ctx.queue_wait_ms += wait_ms
         SERVE_STATEMENTS.inc()
         sess = w.session
         group_limit = 0
@@ -373,12 +384,15 @@ class ServingTier:
             return _FAST_MISS
         if not self.gate.try_shared():
             return _FAST_MISS  # a mutation is active/queued: pool path
+        t0 = time.perf_counter()
         try:
             SERVE_FAST_PATH.inc()
             SERVE_STATEMENTS.inc()
             return session.sql(sql)
         finally:
             self.gate.release_shared()
+            SERVE_FAST_PATH_HIST.observe(
+                (time.perf_counter() - t0) * 1000.0)
 
     def stats(self) -> dict:
         return {

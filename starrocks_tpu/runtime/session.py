@@ -388,11 +388,13 @@ class Session:
         self._in_sql = True
         t0 = _time.time()
         entry = {"user": self.current_user, "sql": text.strip(),
-                 "state": "OK", "rows": 0, "ms": 0}
+                 "state": "OK", "rows": 0, "ms": 0,
+                 "query_id": 0, "queue_wait_ms": 0, "slow": 0}
+        qctx = None
         try:
             with query_scope(text.strip(), user=self.current_user,
                              group=self.resource_group,
-                             group_limit=group_limit):
+                             group_limit=group_limit) as qctx:
                 res = self._sql_inner(text)
             if isinstance(res, QueryResult):
                 entry["rows"] = res.table.num_rows
@@ -405,6 +407,15 @@ class Session:
         finally:
             self._in_sql = False
             entry["ms"] = int((_time.time() - t0) * 1000)
+            if qctx is not None:
+                # joinable against information_schema.query_profiles: the
+                # audit row carries the lifecycle qid + admission wait
+                entry["query_id"] = qctx.qid
+                entry["queue_wait_ms"] = int(qctx.queue_wait_ms)
+            from .config import config as _cfg
+
+            slow_ms = int(_cfg.get("slow_query_ms") or 0)
+            entry["slow"] = int(bool(slow_ms and entry["ms"] >= slow_ms))
             log = self.catalog.query_log
             with _QLOG_LOCK:
                 log.append(entry)
@@ -433,7 +444,13 @@ class Session:
             hit = self.cache.plan_cache.lookup(text_key, self.catalog)
             if hit is not None:
                 return self._query_planned(hit, from_plan_cache=True)
+        import time as _time
+
+        _pw0, _pt0 = _time.time(), _time.perf_counter()
         stmt = parse(text)
+        # parse happens before any profile exists; _query attaches this
+        # measurement so the trace export covers parse->...->fetch
+        self._last_parse = (_pw0, _time.perf_counter() - _pt0)
         self._enforce_privileges(stmt)
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt)
@@ -591,8 +608,18 @@ class Session:
             failpoint.set_from_sql(stmt.name, stmt.value)
             return None
         if isinstance(stmt, ast.ShowProfile):
-            # the reference's SHOW PROFILE: render the last query's
-            # RuntimeProfile tree (qe/StmtExecutor profile surface)
+            # the reference's SHOW PROFILE [FOR QUERY <id>]: the last
+            # query's RuntimeProfile tree, or a retained profile from the
+            # ProfileManager (qe/StmtExecutor + FE ProfileManager surface)
+            if stmt.query_id is not None:
+                from .profile import PROFILE_MANAGER
+
+                e = PROFILE_MANAGER.get(stmt.query_id)
+                if e is None:
+                    return (f"no profile retained for query "
+                            f"{stmt.query_id}")
+                return (f"query {e['query_id']} [{e['state']}] "
+                        f"{e['ms']}ms stage={e['stage']}\n{e['text']}")
             return (self.last_profile.render()
                     if self.last_profile is not None else "no queries yet")
         if isinstance(stmt, ast.ShowCreate):
@@ -885,6 +912,11 @@ class Session:
         from .profile import RuntimeProfile
 
         profile = RuntimeProfile("query")
+        lp = getattr(self, "_last_parse", None)
+        if lp is not None:
+            self._last_parse = None
+            profile.add_counter("parse", lp[1], "s")
+            profile.spans.append(("parse", lp[0], lp[1]))
         with profile.timer("analyze"):
             plan = Analyzer(self.catalog).analyze(sel)
         if cache_text is not None and config.get("enable_plan_cache"):
@@ -904,6 +936,11 @@ class Session:
             profile = RuntimeProfile("query")
         if from_plan_cache:
             profile.add_counter("plan_cache_hits", 1)
+        ctx = lifecycle.current()
+        if ctx is not None:
+            # retained on every exit path by the scope's unwind — a killed
+            # query's profile reports the stage it died at
+            ctx.profile = profile
         self._check_select_privs(plan)
         lifecycle.checkpoint("session::analyzed")
         # admission() releases the slot on ANY exit path — including a KILL
@@ -940,6 +977,8 @@ class Session:
                                            est_bytes)
 
     def _query_admitted(self, plan, profile) -> QueryResult:
+        from . import lifecycle
+
         if self.dist_shards:
             from .dist_executor import DistExecutor
 
@@ -952,14 +991,22 @@ class Session:
         else:
             res = Executor(self.catalog, self.cache).execute_logical(plan, profile)
         self.last_profile = res.profile
+        ctx = lifecycle.current()
+        if ctx is not None:
+            ctx.rows = res.table.num_rows
         return res
 
     def _explain(self, stmt: ast.Explain) -> str:
         assert isinstance(stmt.stmt, (ast.Select, ast.SetOp)), "EXPLAIN supports SELECT"
         if stmt.analyze:
+            from .profile import render_explain_analyze
+
             res = self._query(stmt.stmt)
-            # res.plan is the actually-executed optimized plan
-            return plan_tree_str(res.plan) + "\n" + res.profile.render()
+            # res.plan is the actually-executed optimized plan; each node
+            # annotates with est-vs-observed rows + its counter group via
+            # the profile's node-ordinal table (both executor paths)
+            return render_explain_analyze(res.plan, res.profile,
+                                          self.catalog)
         plan = Analyzer(self.catalog).analyze(stmt.stmt)
         self._check_select_privs(plan)  # EXPLAIN leaks schema/stats otherwise
         # mirror the executor's group_concat two-plan orchestration: EXPLAIN

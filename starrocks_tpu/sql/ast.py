@@ -253,7 +253,7 @@ class ShowPartitions:
 
 @dataclasses.dataclass(frozen=True)
 class ShowProfile:
-    pass
+    query_id: int | None = None  # SHOW PROFILE FOR QUERY <id>
 
 
 @dataclasses.dataclass(frozen=True)

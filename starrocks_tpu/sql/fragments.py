@@ -163,6 +163,14 @@ class FragmentIR:
             "exchanges": len(self.events),
             "exchange_rows": sum(ev.rows for ev in self.events),
             "exchange_bytes": sum(ev.nbytes for ev in self.events),
+            # per-fragment breakdown keyed by fid: the profile's
+            # fragment_{fid}_compile/execute timers join against this to
+            # tell WHICH fragment a hot timer belongs to
+            "per_fragment": [
+                {"fid": f.fid, "sink": f.sink, "deps": list(f.deps),
+                 "exchange": (f.exchange.kind
+                              if f.exchange is not None else None)}
+                for f in self.fragments],
         }
 
 

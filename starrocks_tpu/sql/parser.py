@@ -286,8 +286,21 @@ class Parser:
             if (self.peek().kind == "ident"
                     and self.peek().value.lower() == "profile"):
                 self.next()
+                qid = None
+                if (self.peek().kind in ("ident", "kw")
+                        and self.peek().value.lower() == "for"):
+                    self.next()
+                    if (self.peek().kind in ("ident", "kw")
+                            and self.peek().value.lower() == "query"):
+                        self.next()
+                    t = self.next()
+                    if t.kind != "number":
+                        raise ParseError(
+                            "expected a query id after "
+                            f"SHOW PROFILE FOR QUERY, got {t.value!r}")
+                    qid = int(t.value)
                 self.accept_op(";")
-                return ast.ShowProfile()
+                return ast.ShowProfile(qid)
             if (self.peek().kind == "ident"
                     and self.peek().value.lower() == "resource"):
                 self.next()

@@ -623,11 +623,32 @@ class Catalog:
         if view == "query_log":
             log = self.query_log[-1000:]
             return vtable([
+                ("query_id", T.BIGINT,
+                 [e.get("query_id", 0) for e in log]),
                 ("user", T.VARCHAR, [e["user"] for e in log]),
                 ("statement", T.VARCHAR, [e["sql"][:512] for e in log]),
                 ("state", T.VARCHAR, [e["state"] for e in log]),
                 ("rows", T.BIGINT, [e["rows"] for e in log]),
                 ("ms", T.BIGINT, [e["ms"] for e in log]),
+                ("queue_wait_ms", T.BIGINT,
+                 [e.get("queue_wait_ms", 0) for e in log]),
+                ("slow", T.INT, [e.get("slow", 0) for e in log]),
+            ])
+        if view == "query_profiles":
+            from ..runtime.profile import PROFILE_MANAGER
+
+            rows = PROFILE_MANAGER.snapshot()
+            return vtable([
+                ("query_id", T.BIGINT, [e["query_id"] for e in rows]),
+                ("user", T.VARCHAR, [e["user"] for e in rows]),
+                ("statement", T.VARCHAR, [e["sql"][:512] for e in rows]),
+                ("state", T.VARCHAR, [e["state"] for e in rows]),
+                ("rows", T.BIGINT, [e["rows"] for e in rows]),
+                ("ms", T.BIGINT, [e["ms"] for e in rows]),
+                ("queue_wait_ms", T.BIGINT,
+                 [e["queue_wait_ms"] for e in rows]),
+                ("slow", T.INT, [1 if e["slow"] else 0 for e in rows]),
+                ("stage", T.VARCHAR, [e["stage"] for e in rows]),
             ])
         if view == "be_configs":
             from ..runtime.config import config as cfg

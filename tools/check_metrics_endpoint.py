@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Tier-1 live-scrape gate for the /metrics endpoint.
+
+Boots the real HTTP service (ephemeral port), drives a handful of
+statements through POST /query so the latency/compile/queue histograms
+actually observe samples, then scrapes /metrics and validates the
+Prometheus exposition the way a collector would:
+
+  * every `# TYPE <name> histogram` family exposes `<name>_bucket{le=...}`
+    series ending in le="+Inf", plus `<name>_sum` and `<name>_count`;
+  * bucket counts are cumulative (non-decreasing as le grows) and the
+    +Inf bucket equals `_count`;
+  * every exported family name carries the `sr_tpu_` prefix — the wire
+    half of src_lint's R7 metric-name-prefix rule (declaration half).
+
+Exit 1 with a finding list on any violation, 0 otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PREFIX = "sr_tpu_"
+
+STATEMENTS = [
+    "create table m_probe (a int, b int)",
+    "insert into m_probe values (1, 2), (1, 3), (2, 4), (3, 5)",
+    "select a, sum(b) sb from m_probe group by a",
+    "select a, sum(b) sb from m_probe group by a",  # warm repeat
+    "select count(*) from m_probe",
+]
+
+
+def scrape(port: int) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        return r.read().decode()
+
+
+def validate(text: str) -> list[str]:
+    findings: list[str] = []
+    types: dict[str, str] = {}
+    for line in text.splitlines():
+        m = re.match(r"# TYPE (\S+) (\S+)", line)
+        if m:
+            types[m.group(1)] = m.group(2)
+
+    series = re.findall(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ",
+                        text, re.M)
+    for name, _labels in series:
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        if not (name.startswith(PREFIX) or base.startswith(PREFIX)):
+            findings.append(f"series {name!r} lacks the {PREFIX!r} prefix")
+
+    for name, typ in types.items():
+        if not name.startswith(PREFIX):
+            findings.append(f"family {name!r} lacks the {PREFIX!r} prefix")
+        if typ != "histogram":
+            continue
+        buckets = re.findall(
+            rf'^{re.escape(name)}_bucket{{le="([^"]+)"}} (\d+)$', text, re.M)
+        if not buckets:
+            findings.append(f"histogram {name} exposes no _bucket series")
+            continue
+        if buckets[-1][0] != "+Inf":
+            findings.append(f"histogram {name} missing le=\"+Inf\" bucket")
+        counts = [int(c) for _le, c in buckets]
+        if counts != sorted(counts):
+            findings.append(f"histogram {name} buckets not cumulative: "
+                            f"{counts}")
+        m_sum = re.search(rf"^{re.escape(name)}_sum ([-0-9.e+]+)$",
+                          text, re.M)
+        m_cnt = re.search(rf"^{re.escape(name)}_count (\d+)$", text, re.M)
+        if m_sum is None:
+            findings.append(f"histogram {name} missing _sum")
+        if m_cnt is None:
+            findings.append(f"histogram {name} missing _count")
+        elif counts and counts[-1] != int(m_cnt.group(1)):
+            findings.append(
+                f"histogram {name}: +Inf bucket {counts[-1]} != _count "
+                f"{m_cnt.group(1)}")
+    return findings
+
+
+def main() -> int:
+    from starrocks_tpu.runtime.http_service import SqlHttpServer
+    from starrocks_tpu.runtime.session import Session
+
+    srv = SqlHttpServer(Session()).start()
+    try:
+        for sql in STATEMENTS:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/query",
+                data=json.dumps({"sql": sql}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                json.loads(r.read())
+        text = scrape(srv.port)
+    finally:
+        srv.stop()
+
+    findings = validate(text)
+    # the queries above must have landed samples in the read-latency and
+    # compile histograms — an exposition that validates but never observes
+    # would pass the shape checks while the instrumentation is dead
+    for required in ("sr_tpu_query_latency_ms_read", "sr_tpu_compile_ms"):
+        m = re.search(rf"^{required}_count (\d+)$", text, re.M)
+        if m is None or int(m.group(1)) == 0:
+            findings.append(f"histogram {required} observed no samples "
+                            f"after live queries")
+    n_hist = sum(1 for t in types_of(text).values() if t == "histogram")
+    for f in findings:
+        print(f"check_metrics_endpoint: {f}")
+    print(f"check_metrics_endpoint: {len(findings)} finding(s); "
+          f"histograms={n_hist}")
+    return 1 if findings else 0
+
+
+def types_of(text: str) -> dict:
+    return dict(re.findall(r"# TYPE (\S+) (\S+)", text))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,9 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/plan_lint.py --corpus || ex
 echo "== plan_lint --fragments (fragment IR vs monolithic byte identity) =="
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python tools/plan_lint.py --fragments || exit 1
 
+echo "== /metrics live scrape (Prometheus exposition + sr_tpu_ prefix) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/check_metrics_endpoint.py || exit 1
+
 echo "== chaos suite (failpoint/KILL/timeout/mem-limit scenarios) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
   -q -m chaos -p no:cacheprovider || exit 1

@@ -57,6 +57,14 @@ R6 feedback-key-knob: in the plan-feedback consult path
    state (analysis/key_check.check_feedback_reads audits the DYNAMIC
    read-set; this rule pins the STATIC one).
 
+R7 metric-name-prefix: every LITERAL metric name handed to
+   `metrics.counter/gauge/histogram(...)` must start with `sr_tpu_`. The
+   /metrics scrape is consumed by Prometheus relabel rules and dashboards
+   keyed on that prefix; one unprefixed series silently drops out of every
+   alert. Enforced at the declaration site so the tier-1 live-scrape check
+   (tools/check_metrics_endpoint.py) can assert the same invariant on the
+   wire and the two meet at the registry.
+
 The lint also counts `fail_point()` call sites across the package and
 fails below the chaos-suite floor (MIN_FAILPOINT_SITES): fault-injection
 coverage is an invariant here, not a nice-to-have.
@@ -420,6 +428,34 @@ _SESSION_INTERNALS = {"_sql_inner", "_query_planned", "_query_admitted",
                       "execute_logical"}
 
 
+METRIC_PREFIX = "sr_tpu_"
+_METRIC_FACTORIES = ("counter", "gauge", "histogram")
+
+
+def lint_metric_names(sources) -> list:
+    """R7: literal metric names at `metrics.counter/gauge/histogram(...)`
+    declaration sites must carry the sr_tpu_ exporter prefix."""
+    findings = []
+    for ms in sources:
+        for node in ast.walk(ms.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_FACTORIES
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "metrics"):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue  # computed names are registry-internal helpers
+            name = node.args[0].value
+            if not name.startswith(METRIC_PREFIX):
+                findings.append(
+                    f"{ms.rel}:{node.lineno}: [metric-name-prefix] "
+                    f"metrics.{node.func.attr}({name!r}) — exported series "
+                    f"must start with {METRIC_PREFIX!r}")
+    return findings
+
+
 def lint_serving_scope(sources) -> list:
     """R5: see module docstring."""
     ms = next((m for m in sources if m.rel == SERVING_MODULE), None)
@@ -486,6 +522,7 @@ def main():
     findings += lint_cache_keys()
     findings += lint_feedback_keys()
     findings += lint_serving_scope(sources)
+    findings += lint_metric_names(sources)
     n_fp = count_failpoints(sources)
     if n_fp < MIN_FAILPOINT_SITES:
         findings.append(
