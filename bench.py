@@ -662,6 +662,19 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
                 "serve_fast_path_rate": sres.get(
                     "warm", {}).get("fast_path_rate", 0),
             }
+            pts = sres.get("points", {})
+            if pts:
+                serve.update({
+                    "point_qps": pts.get("point_qps", 0),
+                    "point_p50_ms": pts.get("point_p50_ms", 0),
+                    "point_p99_ms": pts.get("point_p99_ms", 0),
+                    "point_vs_analytic_cold": pts.get(
+                        "point_vs_analytic_cold", 0),
+                    "mixed_analytic_p99_ms": pts.get(
+                        "mixed", {}).get("analytic_p99_ms", 0),
+                    "mixed_point_p99_ms": pts.get(
+                        "mixed", {}).get("point_p99_ms", 0),
+                })
             fb = sres.get("feedback", {})
             if fb:
                 on = fb.get("on", {})

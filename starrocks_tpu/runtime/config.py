@@ -211,12 +211,14 @@ config.define("dense_agg_domain_max", 0, True,
               "aggregation capacity (0 = auto by backend)",
               trace=True)
 config.define("segment_strategy", "auto", True,
-              "auto | mxu | scatter | pallas: auto picks the MXU-friendly "
-              "scatter-free strategies on TPU and plain scatters on CPU "
-              "(where they are orders of magnitude faster); mxu/scatter "
-              "force one side; pallas routes float segment sums through the "
-              "explicit Pallas kernel (interpret-mode on CPU) — flip this "
-              "on hardware to benchmark it",
+              "auto | mxu | scatter | pallas | native: auto picks the "
+              "MXU-friendly scatter-free strategies on TPU and plain "
+              "scatters on CPU (where they are orders of magnitude faster); "
+              "mxu/scatter force one side; pallas routes float segment sums "
+              "through the explicit Pallas kernel (interpret-mode on CPU) — "
+              "flip this on hardware to benchmark it; native additionally "
+              "serves ungrouped filter+sum scans through the fused C++ "
+              "kernel on the CPU fallback",
               trace=True)
 config.define("matmul_segsum_groups_max", 1024, True,
               "max group count for the one-hot-matmul segment-sum strategy",
@@ -341,6 +343,16 @@ config.define("enable_query_cache", False, True,
               "multi-version delta reuse). off = bit-identical to the "
               "uncached engine",
               cache_key=True)
+config.define("enable_short_circuit", True, True,
+              "planner/compiler-free point-query lane: SELECT/UPDATE/"
+              "DELETE statements whose WHERE pins every PRIMARY KEY column "
+              "to literals (= / small IN lists) on stored PK tables run as "
+              "a host-side pk-index probe -> delvec check -> direct row "
+              "gather (runtime/point.py) — no optimizer, no XLA program, "
+              "no device round-trip. Admission-exempt but registered/"
+              "killable/accounted via lifecycle.query_scope; records under "
+              "its own 'point' statement class. off = every statement "
+              "takes the full analytic path, byte-identical results")
 config.define("query_cache_capacity_mb", 256, True,
               "host memory budget for the query cache's LRU (full results "
               "+ per-segment partial-aggregation states share it; least-"

@@ -202,6 +202,10 @@ class FeedbackStore:
             before = json.dumps(
                 (e["caps"], e["cards"], e["probe_hot"], e["build_hot"],
                  e["parts"]), sort_keys=True)
+            # observation count drives guard-band annealing (NEXT 11f);
+            # it resets with the entry when the data versions move
+            obs_before = int(e.get("obs", 0))
+            e["obs"] = obs_before + 1
             e["caps"][tag] = {k: int(v) for k, v in (caps or {}).items()}
             # attempts = the adaptive retries burned LEARNING this shape;
             # keep the max so a later seeded 0-retry run doesn't erase what
@@ -220,13 +224,20 @@ class FeedbackStore:
             after = json.dumps(
                 (e["caps"], e["cards"], e["probe_hot"], e["build_hot"],
                  e["parts"]), sort_keys=True)
-            if before != after:
+            from ..sql.optimizer import feedback_band
+
+            # a band-tier move can flip a banded() outcome with identical
+            # observations, so it must invalidate token-extended opt-plan
+            # keys exactly like a changed observation
+            changed = (before != after or feedback_band(max(obs_before, 1))
+                       != feedback_band(e["obs"]))
+            if changed:
                 e["token"] = e.get("token", 0) + 1
             self._entries.pop(fp, None)  # re-insert = LRU touch
             self._entries[fp] = e
             while len(self._entries) > self.MAX_ENTRIES:
                 del self._entries[next(iter(self._entries))]
-            if before != after:
+            if changed:
                 self._save_locked()
         FEEDBACK_RECORDS.inc()
 

@@ -70,6 +70,10 @@ LATENCY_DDL_MS = metrics.histogram(
 LATENCY_OTHER_MS = metrics.histogram(
     "sr_tpu_query_latency_ms_other",
     "wall milliseconds of statements outside the read/dml/ddl classes")
+LATENCY_POINT_MS = metrics.histogram(
+    "sr_tpu_point_latency_ms",
+    "wall milliseconds of short-circuit point statements (the planner/"
+    "compiler-free PK-lookup lane; its context sets stmt_class='point')")
 
 _DML_HEADS = frozenset(("insert", "update", "delete", "load"))
 _DDL_HEADS = frozenset(("create", "drop", "alter", "truncate", "refresh"))
@@ -89,12 +93,15 @@ def statement_class(sql: str) -> str:
     return "other"
 
 
-def observe_query_latency(sql: str, ms: float):
+def observe_query_latency(sql: str, ms: float, cls: str | None = None):
     """Record one finished top-level statement into its class histogram
-    (Session.sql's unwind calls this on every exit path)."""
+    (Session.sql's unwind calls this on every exit path). `cls` overrides
+    the text-keyword class — the point lane records under 'point' even
+    though its text says SELECT/UPDATE/DELETE."""
     {"read": LATENCY_READ_MS, "dml": LATENCY_DML_MS,
-     "ddl": LATENCY_DDL_MS, "other": LATENCY_OTHER_MS}[
-        statement_class(sql)].observe(float(ms))
+     "ddl": LATENCY_DDL_MS, "other": LATENCY_OTHER_MS,
+     "point": LATENCY_POINT_MS}[
+        cls or statement_class(sql)].observe(float(ms))
 
 
 class QueryAbortError(RuntimeError):
@@ -149,6 +156,9 @@ class QueryContext:
         # failed query's profile reports the stage it died at
         self.profile = None
         self.rows = 0               # result rows (set by the session)
+        # latency-histogram class override: the short-circuit point lane
+        # sets "point" so its latencies never skew the read/dml classes
+        self.stmt_class = None
         self._cancel_reason = None
         self._cleanups: list = []   # run LIFO on scope exit, every path
 
@@ -458,7 +468,8 @@ def _finalize_observability(ctx: QueryContext):
             ms=ctx.elapsed_ms(), rows=ctx.rows,
             queue_wait_ms=ctx.queue_wait_ms, stage=ctx.last_stage,
             profile=ctx.profile)
-        observe_query_latency(ctx.sql, ctx.elapsed_ms())
+        observe_query_latency(ctx.sql, ctx.elapsed_ms(),
+                              getattr(ctx, "stmt_class", None))
     except Exception:  # noqa: BLE001  # lint: swallow-ok — observability must never fail the unwind
         pass
 

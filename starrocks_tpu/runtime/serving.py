@@ -28,10 +28,30 @@ session lock — added cores bought zero QPS. Now:
 
 - **StatementGate**: queries take the SHARED side and overlap freely
   (planning, host orchestration, XLA dispatch); catalog-mutating
-  statements (DDL/DML/SET) take the EXCLUSIVE side — writer-preferring,
-  so a queued mutation is not starved by a read stream. This is the
-  catalog's concurrency contract: its schema maps are mutated only under
-  the exclusive side, read freely under the shared side.
+  statements take the EXCLUSIVE side — writer-preferring, so a queued
+  mutation is not starved by a read stream. This is the catalog's
+  concurrency contract: its schema maps are mutated only under the
+  exclusive side, read freely under the shared side. The gate is
+  PER-TABLE-granular for the common shapes (NEXT 7g first cut):
+  single-target DML excludes only readers of ITS table (it holds the
+  global side shared, like a reader), so point reads of table Y never
+  queue behind a stream of upserts into table X. Reads whose base-table
+  set is statically known claim those tables shared; anything whose
+  footprint is not provable from the text (view/MV references, SHOW/
+  EXPLAIN, DDL, SET, multi-statement shapes) falls back to the original
+  whole-engine semantics: strong readers exclude every table writer,
+  and DDL/SET take the global exclusive side against everyone.
+
+- **Point lane**: a statement the short-circuit detector (runtime/
+  point.py) recognizes as a PK point SELECT on a stored PK table runs
+  INLINE on the connection thread under a per-table shared claim — no
+  pool hop, no planner, no compiler (the wire-speed lookup path). The
+  probe is text+catalog-shape only; execution goes through session.sql,
+  which re-validates and falls back to the full analytic path on any
+  semantic mismatch — safe either way, because a matched text can only
+  read the one claimed table. Point DML rides the pool exclusive on its
+  target table. `SET enable_short_circuit = off` disables the probe
+  outright.
 
 - **Warm fast path**: when the statement text's analyzed plan AND its
   full result are both cached-valid, the statement runs INLINE on the
@@ -50,6 +70,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import itertools
+import re
 import threading
 import time
 
@@ -81,6 +102,10 @@ SERVE_QUEUE_WAIT_HIST = metrics.histogram(
 SERVE_FAST_PATH_HIST = metrics.histogram(
     "sr_tpu_serve_fast_path_hist_ms",
     "warm fast-path hit latency distribution (milliseconds)")
+SERVE_POINT_INLINE = metrics.counter(
+    "sr_tpu_point_inline_total",
+    "point statements served inline on the connection thread (no pool "
+    "hop) by the short-circuit lane")
 
 # leading keyword -> shared (read) side of the statement gate; anything
 # else (DML/DDL/SET/ADMIN/...) is exclusive. KILL never reaches the tier.
@@ -93,50 +118,147 @@ def _is_read_statement(sql: str) -> bool:
     return bool(head) and head[0].lower().rstrip("(") in _READ_KEYWORDS
 
 
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+_DML_TARGET_RE = re.compile(
+    r"\s*(?:insert\s+into|update|delete\s+from)\s+"
+    r"([A-Za-z_][A-Za-z0-9_]*)", re.IGNORECASE)
+
+
+def _read_footprint(sql: str, catalog):
+    """Base tables of a read statement, or None when the footprint is not
+    provable from the text (view/MV references pull in unlisted bases;
+    SHOW/EXPLAIN/DESCRIBE read stats and catalog state). The token scan
+    OVER-approximates: a spurious table claim only costs concurrency,
+    while a missed claim would race DML — so anything uncertain degrades
+    to the strong (every-table-writer-excluding) reader."""
+    head = sql.lstrip().split(None, 1)
+    kw = head[0].lower().rstrip("(") if head else ""
+    if kw not in ("select", "with", "values"):
+        return None
+    toks = {t.lower() for t in _IDENT_RE.findall(sql)}
+    if toks & (set(catalog.views) | set(catalog.mv_defs)):
+        return None
+    tabs = toks & set(catalog.tables)
+    return frozenset(tabs) if tabs else None
+
+
+def _dml_footprint(sql: str, catalog):
+    """(target, read tables) of a single-target DML, or (None, ()) when
+    the statement must take the global exclusive side (DDL/SET/unknown
+    target/view-involved). Same over-approximation rule as reads: the
+    read set is every OTHER catalog table named anywhere in the text."""
+    m = _DML_TARGET_RE.match(sql)
+    if m is None:
+        return None, frozenset()
+    target = m.group(1).lower()
+    toks = {t.lower() for t in _IDENT_RE.findall(sql)}
+    if target not in catalog.tables or toks & (
+            set(catalog.views) | set(catalog.mv_defs)):
+        return None, frozenset()
+    return target, frozenset((toks & set(catalog.tables)) - {target})
+
+
 class StatementGate:
     """Writer-preferring readers/writer gate over one witnessed condition.
-    Readers = queries (overlap freely); writers = catalog mutations."""
+    Readers = queries (overlap freely); writers = catalog mutations.
+
+    Two granularities share the ONE condition (NEXT 7g):
+
+    - the GLOBAL side: `shared(None)` strong readers and `exclusive()`
+      (DDL/SET/multi-table shapes) — the original whole-engine contract;
+    - the PER-TABLE side: `shared(tables)` readers claim their base
+      tables, `exclusive(target, reads)` single-target DML claims its
+      target exclusively + its source tables shared while holding the
+      GLOBAL side shared, so only same-table traffic conflicts.
+
+    Every acquisition is all-or-nothing under the single lock (no claim
+    is held while waiting except the pure writer-preference counters, and
+    those never gate another writer), so multi-claim entries cannot
+    deadlock — concur_lint's single-condition witness stays trivially
+    acyclic."""
 
     def __init__(self):
         self._lock = lockdep.condition("StatementGate._lock")
-        self._readers = 0           # guarded_by: _lock
+        self._readers = 0           # guarded_by: _lock — ALL global-shared
+        #                             holders incl. table writers
         self._writer = False        # guarded_by: _lock
         self._writers_waiting = 0   # guarded_by: _lock
+        self._strong_readers = 0    # guarded_by: _lock — footprint unknown
+        self._table_readers: dict = {}          # guarded_by: _lock
+        self._table_writers: set = set()        # guarded_by: _lock
+        self._table_writers_waiting: dict = {}  # guarded_by: _lock
 
-    def try_shared(self) -> bool:
-        """Non-blocking reader entry (the fast path must never queue
-        behind a writer — it falls back to the pool instead)."""
+    # -- predicate helpers (call with _lock held) ---------------------------
+    def _shared_blocked(self, tables) -> bool:  # lint: holds _lock
+        if self._writer or self._writers_waiting:
+            return True
+        if tables is None:  # strong reader: any table writer conflicts
+            return bool(self._table_writers
+                        or any(self._table_writers_waiting.values()))
+        # writer preference per table: a WAITING table writer bars new
+        # readers of that table, exactly like the global counters
+        return any(t in self._table_writers
+                   or self._table_writers_waiting.get(t)
+                   for t in tables)
+
+    def _enter_shared(self, tables):  # lint: holds _lock
+        self._readers += 1
+        if tables is None:
+            self._strong_readers += 1
+        else:
+            for t in tables:
+                self._table_readers[t] = self._table_readers.get(t, 0) + 1
+
+    def try_shared(self, tables=None) -> bool:
+        """Non-blocking reader entry (the fast/point paths must never
+        queue behind a writer — they fall back to the pool instead).
+        `tables` is the read's base-table claim; None = strong reader.
+        Pass the SAME value to release_shared."""
         with self._lock:
-            if self._writer or self._writers_waiting:
+            if self._shared_blocked(tables):
                 return False
-            self._readers += 1
+            self._enter_shared(tables)
             return True
 
-    def release_shared(self):
+    def release_shared(self, tables=None):
         with self._lock:
             self._readers = max(self._readers - 1, 0)
-            if self._readers == 0:
-                self._lock.notify_all()
+            if tables is None:
+                self._strong_readers = max(self._strong_readers - 1, 0)
+            else:
+                for t in tables:
+                    n = self._table_readers.get(t, 0) - 1
+                    if n > 0:
+                        self._table_readers[t] = n
+                    else:
+                        self._table_readers.pop(t, None)
+            self._lock.notify_all()
 
     @contextlib.contextmanager
-    def shared(self):
+    def shared(self, tables=None):
         from . import lifecycle
 
         with self._lock:
             # writer preference: queued mutations bar NEW readers
-            while self._writer or self._writers_waiting:
+            while self._shared_blocked(tables):
                 self._lock.wait(timeout=0.1)
                 lifecycle.checkpoint("serve::gate_shared")
-            self._readers += 1
+            self._enter_shared(tables)
         try:
             yield
         finally:
-            self.release_shared()
+            self.release_shared(tables)
 
     @contextlib.contextmanager
-    def exclusive(self):
+    def exclusive(self, table=None, reads=frozenset()):
+        """Global exclusive when `table` is None; otherwise single-target
+        DML: global SHARED + `table` exclusive + `reads` shared — reads
+        of other tables flow freely past it."""
         from . import lifecycle
 
+        if table is not None:
+            yield from self._table_exclusive(table, reads)
+            return
         with self._lock:
             self._writers_waiting += 1
             try:
@@ -151,6 +273,49 @@ class StatementGate:
         finally:
             with self._lock:
                 self._writer = False
+                self._lock.notify_all()
+
+    def _table_exclusive(self, table, reads):
+        from . import lifecycle
+
+        reads = frozenset(reads) - {table}
+        with self._lock:
+            self._table_writers_waiting[table] = \
+                self._table_writers_waiting.get(table, 0) + 1
+            try:
+                # read-set claims check ACTIVE writers only (not waiting
+                # ones): two waiting writers reading each other's targets
+                # must not mutually block — all-or-nothing keeps it safe
+                while (self._writer or self._writers_waiting
+                       or table in self._table_writers
+                       or self._table_readers.get(table)
+                       or self._strong_readers
+                       or any(r in self._table_writers for r in reads)):
+                    self._lock.wait(timeout=0.1)
+                    lifecycle.checkpoint("serve::gate_exclusive")
+                self._table_writers.add(table)
+                self._readers += 1  # holds the global side SHARED
+                for r in reads:
+                    self._table_readers[r] = \
+                        self._table_readers.get(r, 0) + 1
+            finally:
+                n = self._table_writers_waiting.get(table, 0) - 1
+                if n > 0:
+                    self._table_writers_waiting[table] = n
+                else:
+                    self._table_writers_waiting.pop(table, None)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._table_writers.discard(table)
+                self._readers = max(self._readers - 1, 0)
+                for r in reads:
+                    n = self._table_readers.get(r, 0) - 1
+                    if n > 0:
+                        self._table_readers[r] = n
+                    else:
+                        self._table_readers.pop(r, None)
                 self._lock.notify_all()
 
 
@@ -291,10 +456,13 @@ class ExecutorPool:
             g = sess.workgroups().get(sess.resource_group)
             if g is not None:
                 group_limit = g.mem_limit_bytes
-        gate_side = (self.gate.exclusive() if w.exclusive
-                     else self.gate.shared())
         if w.exclusive:
             SERVE_EXCLUSIVE.inc()
+            target, reads = _dml_footprint(w.sql, sess.catalog)
+            gate_side = self.gate.exclusive(target, reads)
+        else:
+            gate_side = self.gate.shared(
+                _read_footprint(w.sql, sess.catalog))
         with lifecycle.query_scope(w.sql, user=sess.current_user,
                                    group=sess.resource_group,
                                    group_limit=group_limit, ctx=w.ctx):
@@ -330,6 +498,9 @@ class ServingTier:
         (connection) thread until the statement finishes — wire protocols
         are synchronous per connection."""
         sqln = sql.strip().rstrip(";")
+        res = self._try_point_inline(session, sqln)
+        if res is not _FAST_MISS:
+            return res
         res = self._try_fast_path(session, sqln)
         if res is not _FAST_MISS:
             return res
@@ -361,6 +532,40 @@ class ServingTier:
         if w.error is not None:
             raise w.error
         return w.result
+
+    def _try_point_inline(self, session: Session, sql: str):
+        """Short-circuit point lane, served INLINE on the connection
+        thread under a per-table shared claim: no pool hop, no planner,
+        no compiler — the wire-speed PK lookup path. The probe checks
+        only text shape + that the target is a stored PK base table;
+        execution goes through session.sql, which re-detects and falls
+        back to the full analytic path on any semantic mismatch — safe
+        either way, because a matched text can only read the one claimed
+        table. Contention on that table degrades to the pool path."""
+        if not config.get("enable_short_circuit"):
+            return _FAST_MISS
+        from . import point
+
+        shape = point.peek_select(sql)
+        if shape is None:
+            return _FAST_MISS
+        h = self.catalog.tables.get(shape.table)
+        if (h is None or not getattr(h, "unique_keys", ())
+                or shape.table in self.catalog.views
+                or shape.table in self.catalog.mv_defs):
+            return _FAST_MISS
+        tabs = frozenset((shape.table,))
+        if not self.gate.try_shared(tabs):
+            return _FAST_MISS  # DML active/queued on this table: pool path
+        t0 = time.perf_counter()
+        try:
+            SERVE_POINT_INLINE.inc()
+            SERVE_STATEMENTS.inc()
+            return session.sql(sql)
+        finally:
+            self.gate.release_shared(tabs)
+            SERVE_FAST_PATH_HIST.observe(
+                (time.perf_counter() - t0) * 1000.0)
 
     def _try_fast_path(self, session: Session, sql: str):
         """Inline execution when text -> plan -> result are ALL cached and
@@ -397,6 +602,7 @@ class ServingTier:
     def stats(self) -> dict:
         return {
             "fast_path": SERVE_FAST_PATH.value,
+            "point_inline": SERVE_POINT_INLINE.value,
             "statements": SERVE_STATEMENTS.value,
             "pool_pending": self.pool.pending(),
             "plan_cache": self.cache.plan_cache.stats(),

@@ -440,6 +440,16 @@ class Session:
         # stored, so non-query texts always miss. Privileges re-check per
         # execution on the plan (_check_select_privs).
         text_key = text.strip().rstrip(";")
+        # short-circuit point lane: `WHERE pk = ?` shapes on stored PK
+        # tables answer from the primary index — no parse cache, no
+        # optimizer, no device (runtime/point.py). Detection is
+        # conservative: MISS falls through to the identical full path.
+        if config.get("enable_short_circuit"):
+            from . import point
+
+            res = point.try_execute(self, text_key)
+            if res is not point.MISS:
+                return res
         if config.get("enable_plan_cache"):
             hit = self.cache.plan_cache.lookup(text_key, self.catalog)
             if hit is not None:

@@ -1364,6 +1364,23 @@ def join_scanset_key(plan) -> str:
 # multiplicative (7.5x composite-NDV class), not ±40%.
 FEEDBACK_CARD_BAND = 4.0
 
+# Guard-band annealing (NEXT 11f): 4x exists to keep ONE noisy observation
+# from moving a well-estimated plan, but a fingerprint that has been
+# re-observed across executions has earned trust — the band shrinks with
+# the entry's observation count toward this floor (never below: zonemap
+# pruning and delvec churn make small run-to-run wobble normal, and a band
+# of 1.0 would thrash plans on it).
+FEEDBACK_BAND_FLOOR = 1.5
+
+
+def feedback_band(observations: int) -> float:
+    """Annealed guard band for a feedback entry observed `observations`
+    times: 4.0 on the first observation, shrinking hyperbolically to the
+    FEEDBACK_BAND_FLOOR by the fifth. Single-observation behavior is
+    BYTE-IDENTICAL to the fixed-band engine (the corpus anchor)."""
+    extra = max(int(observations) - 1, 0)
+    return max(FEEDBACK_CARD_BAND / (1.0 + 0.5 * extra), FEEDBACK_BAND_FLOOR)
+
 
 def join_fan_rows(l_rows: float, r_rows: float, prod_l: float, prod_r: float,
                   n_res: int) -> float:
@@ -1439,6 +1456,9 @@ def _dp_order(rels, conjuncts, catalog, feedback=None) -> LogicalPlan:
 
     fb_cards = (feedback or {}).get("cards") or {}
     fb_hot = (feedback or {}).get("probe_hot") or {}
+    # annealed per-entry band: entries without an observation count (old
+    # sidecars) behave exactly like the fixed-band engine
+    fb_band = feedback_band(int((feedback or {}).get("obs") or 1))
     leaf_keys = [
         frozenset(f"{p.table}:{p.alias}" for p in walk_plan(r)
                   if isinstance(p, LScan))
@@ -1457,9 +1477,8 @@ def _dp_order(rels, conjuncts, catalog, feedback=None) -> LogicalPlan:
         return card_cache[mask]
 
     def banded(est: float, obs) -> float:
-        """The observation wins only OUTSIDE the guard band."""
-        if obs is None or (est * FEEDBACK_CARD_BAND >= obs
-                           and obs * FEEDBACK_CARD_BAND >= est):
+        """The observation wins only OUTSIDE the (annealed) guard band."""
+        if obs is None or (est * fb_band >= obs and obs * fb_band >= est):
             return est
         return max(float(obs), 1.0)
 
@@ -1581,7 +1600,7 @@ def _dp_order(rels, conjuncts, catalog, feedback=None) -> LogicalPlan:
                             h = hot_count(hi, hcol)
                             if h:
                                 hot = max(hot, h * rb / max(prod_b, 1.0))
-                        if hot > rows * FEEDBACK_CARD_BAND:
+                        if hot > rows * fb_band:
                             rows = hot
                     # build side (right) materializes a device-sorted table:
                     # a full-capacity argsort, single-threaded in XLA CPU and

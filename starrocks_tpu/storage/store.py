@@ -16,6 +16,7 @@ Reference behavior re-designed (SURVEY §2.1 storage rows):
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import os
@@ -79,6 +80,23 @@ class TabletStore:
         self._state_lock = lockdep.lock("TabletStore._state_lock")
         # table -> {pk tuple: (rowset, file, pos)}
         self._pk_index: dict = {}   # guarded_by: _state_lock
+        # point-read manifest snapshots: table -> (manifest, Schema), valid
+        # until the next _write_manifest. ONLY the point-probe path consumes
+        # these (mutators keep reading fresh copies via read_manifest and
+        # mutating their own dict), so caching here never aliases a
+        # mutator's in-flight edits.
+        self._manifest_cache: dict = {}  # guarded_by: _state_lock
+        # (table, file, columns) -> arrow columns of one IMMUTABLE rowset
+        # file; delvecs only mask rows at read, so raw-file positions and
+        # bytes stay valid across PK DML — entries drop only when the
+        # table's file set is rewritten (_drop_pk_index callers)
+        self._col_cache = collections.OrderedDict()  # guarded_by: _state_lock
+        # (table, file, columns) -> CONFORMED per-file HostTable: the
+        # point-gather fast lane slices rows out of these with numpy fancy
+        # indexing, skipping the arrow->host conversion (dict re-encode,
+        # null fill) that otherwise dominates a sub-ms lookup; same
+        # immutability argument and invalidation points as _col_cache
+        self._ht_cache = collections.OrderedDict()   # guarded_by: _state_lock
         self.last_scan_stats: dict = {}  # guarded_by: _state_lock
         # serializes log() appends against checkpoint()'s snapshot+replace:
         # sessions share one TabletStore and auto-checkpoint fires during
@@ -119,9 +137,18 @@ class TabletStore:
         with self._state_lock:
             return dict(self.last_scan_stats)
 
+    COL_CACHE_FILES = 64  # point-gather file-column LRU capacity
+
     def _drop_pk_index(self, name: str):
         with self._state_lock:
             self._pk_index.pop(name, None)
+            self._manifest_cache.pop(name, None)
+            # the table's file set changed (rewrite/compact/alter/drop):
+            # cached raw-file columns are dead with the positions
+            for k in [k for k in self._col_cache if k[0] == name]:
+                del self._col_cache[k]
+            for k in [k for k in self._ht_cache if k[0] == name]:
+                del self._ht_cache[k]
 
     # --- edit log + image checkpoint -----------------------------------------
     # The journal is the FE EditLog/image pair (fe persist/EditLog.java:133 +
@@ -235,6 +262,8 @@ class TabletStore:
         with open(tmp, "w") as f:
             json.dump(m, f, indent=1)
         os.replace(tmp, self._manifest_path(name))
+        with self._state_lock:
+            self._manifest_cache.pop(name, None)
 
     def create_table(
         self, name: str, schema: Schema, distribution=(), buckets: int = 1,
@@ -674,6 +703,174 @@ class TabletStore:
         self._maybe_compact(name, m)
         return n
 
+    # --- point-query plane ----------------------------------------------------
+    def _manifest_snapshot(self, name: str):
+        """(manifest, Schema) snapshot for point probes, cached until the
+        next manifest write — read_manifest re-parses JSON per call, which
+        alone would dominate a sub-100µs lookup."""
+        with self._state_lock:
+            snap = self._manifest_cache.get(name)
+        if snap is not None:
+            return snap
+        m = self.read_manifest(name)
+        schema = schema_from_json(m["schema"])
+        with self._state_lock:
+            return self._manifest_cache.setdefault(name, (m, schema))
+
+    def _file_columns(self, name: str, fmeta: dict, want, schema: Schema):
+        """Arrow columns of ONE rowset file, NULL-filled to the declared
+        schema and selected to `want` — the per-file slice of load_table's
+        read pipeline, LRU-cached because rowset files are immutable."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        key = (name, fmeta["file"], tuple(want))
+        with self._state_lock:
+            t = self._col_cache.get(key)
+            if t is not None:
+                self._col_cache.move_to_end(key)
+                return t
+        fpath = os.path.join(self._tdir(name), fmeta["file"])
+        have = set(fmeta.get("cols") or pq.read_schema(fpath).names)
+        t = pq.read_table(fpath, columns=[c for c in want if c in have])
+        for c in want:
+            if c not in have:
+                t = t.append_column(
+                    c, pa.nulls(t.num_rows, type=_arrow_type_of(
+                        schema.field(c).type)))
+        t = t.select(want)
+        with self._state_lock:
+            self._col_cache[key] = t
+            self._col_cache.move_to_end(key)
+            while len(self._col_cache) > self.COL_CACHE_FILES:
+                self._col_cache.popitem(last=False)
+        return t
+
+    def _file_hosttable(self, name: str, fmeta: dict, want,
+                        schema: Schema) -> HostTable:
+        """Conformed HostTable of ONE immutable rowset file, LRU-cached —
+        the point-gather lane's row source (gathers are numpy slices of
+        this, never a per-lookup arrow conversion)."""
+        key = (name, fmeta["file"], tuple(want))
+        with self._state_lock:
+            t = self._ht_cache.get(key)
+            if t is not None:
+                self._ht_cache.move_to_end(key)
+                return t
+        t = _conform(HostTable.from_arrow(
+            self._file_columns(name, fmeta, want, schema)), schema, want)
+        with self._state_lock:
+            self._ht_cache[key] = t
+            self._ht_cache.move_to_end(key)
+            while len(self._ht_cache) > self.COL_CACHE_FILES:
+                self._ht_cache.popitem(last=False)
+        return t
+
+    def point_lookup(self, name: str, key_tuples, columns=None) -> HostTable:
+        """Primary-index point probe: pk index -> delvec check -> direct
+        row gather from the owning files, never a whole-segment load (the
+        short-circuit read path; reference analog: be/src/exec/pipeline/
+        short_circuit + primary-index point get in tablet_updates).
+        `key_tuples` are canonical pk tuples (`_canon_key` per component);
+        duplicates collapse, IN-list style. Hit rows come back in storage
+        scan order — the order the full scan path yields them."""
+        fail_point("point::probe")
+        m, schema = self._manifest_snapshot(name)
+        keys = [k for ks in m["unique_keys"] for k in ks]
+        if not keys:
+            raise ValueError(f"table {name!r} has no PRIMARY KEY")
+        index = self._load_pk_index(name, m, keys)
+        hits = []
+        seen = set()
+        dead_by_file: dict = {}
+        for kv in key_tuples:
+            if kv in seen:
+                continue
+            seen.add(kv)
+            loc = index.get(kv)
+            if loc is None:
+                continue
+            ri, fi, pos = loc
+            dead = dead_by_file.get((ri, fi))
+            if dead is None:
+                dead = set(m["rowsets"][ri]["files"][fi].get("delvec") or ())
+                dead_by_file[(ri, fi)] = dead
+            if pos in dead:
+                continue  # superseded after the index entry was built
+            hits.append(loc)
+        want = list(columns) if columns else [f.name for f in schema]
+        if not hits:
+            return _empty_table(Schema(tuple(schema.field(c) for c in want)))
+        import pyarrow as pa
+
+        hits.sort()
+        by_file: dict = {}
+        for ri, fi, pos in hits:
+            by_file.setdefault((ri, fi), []).append(pos)
+        if len(by_file) == 1:
+            # the common case (single key / keys co-located): slice rows
+            # straight out of the cached per-file HostTable — no arrow
+            # take/concat, no dict re-encode, shared StringDict
+            (ri, fi), poss = next(iter(by_file.items()))
+            fmeta = m["rowsets"][ri]["files"][fi]
+            base = self._file_hosttable(name, fmeta, want, schema)
+            idx = np.asarray(poss, dtype=np.int64)
+            return HostTable(
+                base.schema,
+                {c: a[idx] for c, a in base.arrays.items()},
+                {c: v[idx] for c, v in base.valids.items()})
+        tables = []
+        for (ri, fi), poss in sorted(by_file.items()):
+            fmeta = m["rowsets"][ri]["files"][fi]
+            t = self._file_columns(name, fmeta, want, schema)
+            tables.append(t.take(poss))
+        merged = pa.concat_tables(tables, promote_options="default")
+        return _conform(HostTable.from_arrow(merged), schema, want)
+
+    def delete_rows(self, name: str, key_tuples, record: bool = True) -> int:
+        """PRIMARY KEY point delete: mark the victims in their files'
+        delete vectors and drop them from the live index — O(keys) work and
+        O(manifest) bytes, never a table rewrite (the delvec write path
+        upsert already uses, be/src/storage/del_vector.h analog)."""
+        fail_point("store::delete_rows")
+        m = self.read_manifest(name)
+        keys = [k for ks in m["unique_keys"] for k in ks]
+        if not keys:
+            raise ValueError(f"table {name!r} has no PRIMARY KEY")
+        index = self._load_pk_index(name, m, keys)
+        touched: dict = {}
+        removed = []
+        seen = set()
+        for kv in key_tuples:
+            if kv in seen:
+                continue
+            seen.add(kv)
+            loc = index.get(kv)
+            if loc is None:
+                continue
+            ri, fi, pos = loc
+            dv = m["rowsets"][ri]["files"][fi].get("delvec") or ()
+            if pos in dv:
+                continue  # already dead
+            touched.setdefault((ri, fi), set()).add(pos)
+            removed.append(kv)
+        if not removed:
+            return 0
+        for (ri, fi), dead in touched.items():
+            fmeta = m["rowsets"][ri]["files"][fi]
+            dv = set(fmeta.get("delvec") or ())
+            dv |= dead
+            fmeta["delvec"] = sorted(dv)
+        self._write_manifest(name, m)
+        # the index mutation is single-writer (DML gate), like upsert's
+        for kv in removed:
+            index.pop(kv, None)
+        if record:
+            self.log({"op": "delete_rows", "table": name,
+                      "rows": len(removed)})
+        self._notify(name, "delete_rows")
+        return len(removed)
+
     def _bucket_of(self, m: dict, data: HostTable):
         """Per-row bucket under the manifest's hash distribution (the one
         routing recipe for insert AND upsert: single column via the native
@@ -767,17 +964,7 @@ class TabletStore:
             sub = schema if columns is None else Schema(
                 tuple(schema.field(c) for c in columns)
             )
-
-            def empty(f):
-                if f.type.is_array:
-                    return np.zeros((0, 2), dtype=f.type.np_dtype)
-                if f.type.is_decimal128:
-                    return np.zeros((0, 4), dtype=np.int64)
-                if f.type.is_hll or f.type.is_bitmap:
-                    return np.zeros((0, f.type.wide_width), dtype=np.int8)
-                return np.zeros(0, dtype=f.type.np_dtype)
-
-            out = HostTable(sub, {f.name: empty(f) for f in sub}, {})
+            out = _empty_table(sub)
             return (out, stats) if with_stats else out
         import pyarrow as pa
 
@@ -866,6 +1053,20 @@ def _to_arrow(data: HostTable):
             arrays.append(pa.array(a, mask=mask))
         names.append(f.name)
     return pa.table(dict(zip(names, arrays)))
+
+
+def _empty_table(schema: Schema) -> HostTable:
+    """Zero-row HostTable with typed arrays (wide layouts keep rank 2)."""
+    def empty(f):
+        if f.type.is_array:
+            return np.zeros((0, 2), dtype=f.type.np_dtype)
+        if f.type.is_decimal128:
+            return np.zeros((0, 4), dtype=np.int64)
+        if f.type.is_hll or f.type.is_bitmap:
+            return np.zeros((0, f.type.wide_width), dtype=np.int8)
+        return np.zeros(0, dtype=f.type.np_dtype)
+
+    return HostTable(schema, {f.name: empty(f) for f in schema}, {})
 
 
 def _conform(ht: HostTable, schema: Schema, columns) -> HostTable:

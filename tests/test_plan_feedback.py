@@ -80,7 +80,11 @@ def test_restart_pretightens_zero_recompiles(tmp_path):
 
 def test_consult_token_fixpoint(tmp_path):
     s = _expansion_session(tmp_path)
-    s.sql(EXPAND_Q)
+    # guard-band annealing (NEXT 11f) legitimately bumps the token while
+    # the band tier still moves with each observation; warm past the floor
+    # (band(obs>=5) is pinned at FEEDBACK_BAND_FLOOR) before asserting
+    for _ in range(6):
+        s.sql(EXPAND_Q)
     t1 = s.cache.feedback.stats()["tokens"]
     s.sql(EXPAND_Q)
     s.sql(EXPAND_Q)
@@ -247,3 +251,81 @@ def test_check_feedback_reads_audit():
     bad = check_feedback_reads({"serve_pool_size"})
     assert len(bad) == 1
     assert bad[0].invariant == "knob-outside-feedback-key"
+
+
+# --- guard-band annealing (NEXT 11f) -----------------------------------------
+
+def test_feedback_band_anneals_to_floor():
+    from starrocks_tpu.sql.optimizer import (
+        FEEDBACK_BAND_FLOOR, FEEDBACK_CARD_BAND, feedback_band)
+    # a single observation keeps the seed band — byte-identical to the
+    # fixed-band engine (and to sidecars written before `obs` existed)
+    assert feedback_band(0) == FEEDBACK_CARD_BAND
+    assert feedback_band(1) == FEEDBACK_CARD_BAND
+    # monotone non-increasing as confidence grows, never below the floor
+    prev = feedback_band(1)
+    for obs in range(2, 12):
+        cur = feedback_band(obs)
+        assert cur <= prev and cur >= FEEDBACK_BAND_FLOOR
+        prev = cur
+    assert feedback_band(5) == FEEDBACK_BAND_FLOOR
+    assert feedback_band(10 ** 6) == FEEDBACK_BAND_FLOOR
+
+
+def test_record_counts_observations_and_resets_with_versions():
+    fs = FeedbackStore()
+
+    class _Cat:
+        ver = 0
+
+        def data_version(self, name):
+            return (0, "mem", self.ver)
+
+    cat = _Cat()
+    for _ in range(3):
+        fs.record("fp", cat, ["t"], "local", {"x": 1}, 0)
+    assert fs.consult("fp", cat)["obs"] == 3
+    cat.ver = 1  # the data moved: everything learned decays, obs included
+    fs.record("fp", cat, ["t"], "local", {"x": 1}, 0)
+    assert fs.consult("fp", cat)["obs"] == 1
+
+
+def test_band_tier_move_bumps_token_then_fixpoint():
+    from starrocks_tpu.sql.optimizer import feedback_band
+    fs = FeedbackStore()
+
+    class _Cat:
+        def data_version(self, name):
+            return (0, "mem", 1)
+
+    fs.record("fp", _Cat(), ["t"], "local", {"x": 1}, 0)
+    tokens = [fs.consult("fp", _Cat())["token"]]
+    # identical payload re-recorded: the ONLY change is the annealing
+    # band tier, and that alone must invalidate token-extended plan keys
+    for _ in range(6):
+        fs.record("fp", _Cat(), ["t"], "local", {"x": 1}, 0)
+        tokens.append(fs.consult("fp", _Cat())["token"])
+    # tokens[i] is the token after observation i+1; with an identical
+    # payload the ONLY bump driver is the band tier moving between
+    # consecutive observation counts
+    for i in range(1, len(tokens)):
+        moved = feedback_band(i) != feedback_band(i + 1)
+        assert tokens[i] == tokens[i - 1] + (1 if moved else 0), (
+            "token must bump exactly on band-tier moves")
+    # once the band floors out, identical observations reach a fixpoint
+    assert tokens[-1] == tokens[-2] == tokens[-3]
+
+
+def test_annealed_feedback_keeps_results_identical(tmp_path):
+    """Regression for the 11f acceptance: a well-estimated repeated query
+    stays value-identical through the whole annealing schedule and against
+    the feedback-off anchor."""
+    s = _expansion_session(tmp_path)
+    base = s.sql(EXPAND_Q).to_pandas()
+    for _ in range(7):
+        assert s.sql(EXPAND_Q).to_pandas().equals(base)
+    s.sql("set plan_feedback = off")
+    try:
+        assert s.sql(EXPAND_Q).to_pandas().equals(base)
+    finally:
+        s.sql("set plan_feedback = on")

@@ -247,6 +247,153 @@ def run_phase(mysql_port: int, http_port: int, statements, weights,
     }
 
 
+def _pct(sorted_ms: list, p: float) -> float:
+    if not sorted_ms:
+        return 0.0
+    return sorted_ms[min(int(len(sorted_ms) * p), len(sorted_ms) - 1)]
+
+
+def _run_mixed_lane_phase(s, nrows: int, seconds: float) -> dict:
+    """Mixed serving: analytic scans + point lookups + a per-second DML
+    pulse against ONE tier over the SAME store-backed table, reporting
+    per-lane latency. The per-table statement gate is what keeps the
+    point lane inline here; the analytic lane and the DML pulse
+    serialize against each other exactly as the correctness contract
+    demands."""
+    from starrocks_tpu.runtime.serving import ServingTier
+
+    tier = ServingTier(s, pool_size=2)
+    try:
+        warm = tier.new_session()
+        aq = "select count(*) c, sum(n) s from point_kv where n >= 0"
+        tier.execute(warm, aq)  # pay the analytic compile up front
+        buckets: dict = {"point": [], "analytic": [], "dml": []}
+        lock = threading.Lock()
+        stop_at = time.monotonic() + seconds
+
+        def loop(lane: str, mk):
+            sess = tier.new_session()
+            my: list = []
+            while time.monotonic() < stop_at:
+                sql = mk()
+                t0 = time.perf_counter()
+                try:
+                    tier.execute(sess, sql)
+                except Exception:  # noqa: BLE001
+                    continue
+                my.append((time.perf_counter() - t0) * 1000.0)
+                if lane == "dml":
+                    time.sleep(0.5)  # per-second DML pulse, not a flood
+            with lock:
+                buckets[lane].extend(my)
+
+        rp1, rp2, rd = (random.Random(101), random.Random(102),
+                        random.Random(103))
+        ts = [
+            threading.Thread(target=loop, args=("analytic", lambda: aq),
+                             daemon=True),
+            threading.Thread(target=loop, args=(
+                "point", lambda: "select v, n from point_kv where k = "
+                f"{rp1.randrange(nrows)}"), daemon=True),
+            threading.Thread(target=loop, args=(
+                "point", lambda: "select v, n from point_kv where k = "
+                f"{rp2.randrange(nrows)}"), daemon=True),
+            threading.Thread(target=loop, args=(
+                "dml", lambda: f"update point_kv set n = "
+                f"{rd.randrange(10 ** 6)} where k = {rd.randrange(nrows)}"),
+                daemon=True),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=seconds + 120)
+        out: dict = {}
+        for lane, lat in buckets.items():
+            lat.sort()
+            out[f"{lane}_requests"] = len(lat)
+            if lat:
+                out[f"{lane}_p50_ms"] = round(_pct(lat, 0.50), 3)
+                out[f"{lane}_p99_ms"] = round(_pct(lat, 0.99), 3)
+        return out
+    finally:
+        tier.shutdown()
+
+
+def run_point_phase(seconds: float = 4.0, nrows: int = 20000,
+                    mixed: bool = True) -> dict:
+    """Short-circuit point-query lane benchmark (the wire-speed PK-lookup
+    plane). tpch_catalog is in-memory, so this phase builds its own
+    TabletStore-backed PK table — the lane only exists over the stored
+    primary index. Reports sustained in-proc point QPS/percentiles, the
+    cold-analytic anchor for the same statement (lane off, fresh plans),
+    and mixed-workload per-lane latency under a per-second DML pulse."""
+    import shutil
+    import tempfile
+
+    from starrocks_tpu.cache import plan_cache  # noqa: F401 — knob define
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.session import Session
+
+    d = tempfile.mkdtemp(prefix="sr_pointbench_")
+    out: dict = {"rows": nrows}
+    prev_plan = config.get("enable_plan_cache")
+    try:
+        s = Session(data_dir=os.path.join(d, "db"))
+        s.sql("create table point_kv (k bigint, v varchar, n bigint, "
+              "primary key(k))")
+        for base in range(0, nrows, 2000):
+            rows = ",".join(f"({i}, 'v{i}', {i * 7})"
+                            for i in range(base, min(base + 2000, nrows)))
+            s.sql(f"insert into point_kv values {rows}")
+        rng = random.Random(11)
+
+        # cold analytic anchor: the SAME statement with the lane off and
+        # plan caching off — what every lookup would cost through the
+        # full planner/compiler path
+        config.set("enable_short_circuit", False)
+        config.set("enable_plan_cache", False)
+        lat: list = []
+        for _ in range(12):
+            k = rng.randrange(nrows)
+            t0 = time.perf_counter()
+            s.sql(f"select v, n from point_kv where k = {k}")
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        lat.sort()
+        out["analytic_cold_p50_ms"] = round(_pct(lat, 0.50), 3)
+        config.set("enable_plan_cache", prev_plan)
+        config.set("enable_short_circuit", True)
+
+        # sustained in-proc point loop (single client; the wire adds its
+        # own per-protocol cost on top of the engine answer path)
+        lat = []
+        deadline = time.monotonic() + seconds
+        t_all = time.monotonic()
+        while time.monotonic() < deadline:
+            k = rng.randrange(int(nrows * 1.02))  # ~2% misses in the mix
+            t0 = time.perf_counter()
+            s.sql(f"select v, n from point_kv where k = {k}")
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        wall = time.monotonic() - t_all
+        lat.sort()
+        out.update({
+            "point_requests": len(lat),
+            "point_qps": round(len(lat) / wall, 1) if wall else 0.0,
+            "point_p50_ms": round(_pct(lat, 0.50), 3),
+            "point_p99_ms": round(_pct(lat, 0.99), 3),
+        })
+        if out["point_p50_ms"]:
+            out["point_vs_analytic_cold"] = round(
+                out["analytic_cold_p50_ms"] / out["point_p50_ms"], 1)
+
+        if mixed:
+            out["mixed"] = _run_mixed_lane_phase(s, nrows, seconds)
+    finally:
+        config.set("enable_plan_cache", prev_plan)
+        config.set("enable_short_circuit", True)
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def run_feedback_phase(cat, statements) -> dict:
     """A/B of the plan-feedback loop (ISSUE 11) over the serve mix plus a
     guaranteed-overflow expansion join. Three passes per arm, in process:
@@ -321,7 +468,8 @@ def run_serve_bench(threads: int = 32, seconds: float = 8.0,
                     sf: float = 0.01, pool: int = 4,
                     include_ssb: bool = False, http_frac: float = 0.25,
                     chaos: bool = False, single_thread_ab: bool = True,
-                    warm: bool = True, feedback: bool = True) -> dict:
+                    warm: bool = True, feedback: bool = True,
+                    points: bool = True) -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -334,6 +482,12 @@ def run_serve_bench(threads: int = 32, seconds: float = 8.0,
     from starrocks_tpu.runtime.serving import ServingTier
     from starrocks_tpu.runtime.session import Session
     from starrocks_tpu.storage.catalog import tpch_catalog
+
+    out_points = None
+    if points:
+        # runs FIRST so its store-backed table allocates before the leak
+        # audit's baseline snapshot
+        out_points = run_point_phase(seconds=min(seconds, 4.0))
 
     t_setup = time.monotonic()
     cat = tpch_catalog(sf=sf)
@@ -435,6 +589,9 @@ def run_serve_bench(threads: int = 32, seconds: float = 8.0,
     if feedback:
         out["feedback"] = run_feedback_phase(cat, statements)
 
+    if out_points is not None:
+        out["points"] = out_points
+
     # leak + witness audit (the chaos-suite contract, applied to serving)
     wm = getattr(cat, "workgroups", None)
     out["leaks"] = {
@@ -465,15 +622,28 @@ def main():
                     help="skip the warm (query-cache on) phase")
     ap.add_argument("--no-feedback", action="store_true",
                     help="skip the plan-feedback effectiveness A/B phase")
+    ap.add_argument("--points", action="store_true",
+                    help="run ONLY the short-circuit point-query phase")
+    ap.add_argument("--no-points", action="store_true",
+                    help="skip the point-query phase in the full run")
     ap.add_argument("--detail", action="store_true",
                     help="merge a 'serve' section into BENCH_DETAIL.json")
     args = ap.parse_args()
+
+    if args.points:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        res = {"points": run_point_phase(seconds=args.seconds)}
+        print(json.dumps(res))
+        return 0
 
     res = run_serve_bench(
         threads=args.threads, seconds=args.seconds, sf=args.sf,
         pool=args.pool, include_ssb=args.ssb, http_frac=args.http_frac,
         chaos=args.chaos, single_thread_ab=not args.no_ab,
-        warm=not args.no_warm, feedback=not args.no_feedback)
+        warm=not args.no_warm, feedback=not args.no_feedback,
+        points=not args.no_points)
     if args.detail:
         path = os.path.join(REPO, "BENCH_DETAIL.json")
         detail = {}

@@ -65,6 +65,16 @@ R7 metric-name-prefix: every LITERAL metric name handed to
    (tools/check_metrics_endpoint.py) can assert the same invariant on the
    wire and the two meet at the registry.
 
+R8 point-query-scope: the short-circuit point lane's execution entry
+   (runtime/point.py `try_execute`) may be called from exactly ONE place —
+   `Session._sql_inner` (runtime/session.py), which always runs inside
+   `lifecycle.query_scope` (the R5 contract applied to the lane). Serving
+   code may consult the PURE text probe `point.peek_select` for its gate
+   claim but must never call the lane's execution internals; a second
+   entry point would execute PK lookups outside the registered/killable/
+   accounted plane. `try_execute` itself must hit a `lifecycle.checkpoint`
+   before the index probe so an in-flight KILL lands.
+
 The lint also counts `fail_point()` call sites across the package and
 fails below the chaos-suite floor (MIN_FAILPOINT_SITES): fault-injection
 coverage is an invariant here, not a nice-to-have.
@@ -500,6 +510,67 @@ def lint_serving_scope(sources) -> list:
     return findings
 
 
+POINT_MODULE = os.path.join("starrocks_tpu", "runtime", "point.py")
+SESSION_MODULE = os.path.join("starrocks_tpu", "runtime", "session.py")
+_POINT_INTERNALS = {"try_execute", "_run_select", "_run_update",
+                    "_run_delete", "_resolve"}
+
+
+def lint_point_scope(sources) -> list:
+    """R8: see module docstring."""
+    pm = next((m for m in sources if m.rel == POINT_MODULE), None)
+    if pm is None:
+        return [f"{POINT_MODULE}:1: [point-query-scope] point-lane module "
+                f"missing (the short-circuit read path is a tier-1 "
+                f"surface)"]
+    findings = []
+    # the lane's entry must checkpoint before the probe: a KILL delivered
+    # mid-lookup needs a stage boundary to land on
+    entry = next((n for n in ast.walk(pm.tree)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n.name == "try_execute"), None)
+    if entry is None:
+        findings.append(
+            f"{pm.rel}:1: [point-query-scope] missing `try_execute` (the "
+            f"lane's single execution entry point)")
+    elif not any(isinstance(c, ast.Call) and _call_name(c) == "checkpoint"
+                 for c in ast.walk(entry)):
+        findings.append(
+            f"{pm.rel}:{entry.lineno}: [point-query-scope] try_execute "
+            f"must call lifecycle.checkpoint(...) before the index probe "
+            f"— an unkillable point lane breaks the KILL contract")
+    # callers: point-lane execution internals are reachable from exactly
+    # one site, Session._sql_inner (itself pinned inside query_scope)
+    for ms in sources:
+        if ms.rel == POINT_MODULE:
+            continue
+        sql_inner = next(
+            (n for n in ast.walk(ms.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and n.name == "_sql_inner"), None) \
+            if ms.rel == SESSION_MODULE else None
+        allowed = set()
+        if sql_inner is not None:
+            allowed = {id(c) for c in ast.walk(sql_inner)
+                       if isinstance(c, ast.Call)}
+        for node in ast.walk(ms.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _POINT_INTERNALS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "point"):
+                continue
+            if id(node) in allowed:
+                continue
+            findings.append(
+                f"{ms.rel}:{node.lineno}: [point-query-scope] "
+                f"point.{node.func.attr}() outside Session._sql_inner — "
+                f"the short-circuit lane must enter through the "
+                f"query_scope'd session path (peek_select is the only "
+                f"serving-side probe)")
+    return findings
+
+
 def lint_module(ms) -> list:
     linter = Linter(ms.path, ms.rel, ms.src)
     linter.collect(ms.tree)
@@ -523,6 +594,7 @@ def main():
     findings += lint_feedback_keys()
     findings += lint_serving_scope(sources)
     findings += lint_metric_names(sources)
+    findings += lint_point_scope(sources)
     n_fp = count_failpoints(sources)
     if n_fp < MIN_FAILPOINT_SITES:
         findings.append(

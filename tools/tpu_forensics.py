@@ -151,7 +151,7 @@ def deep_probe(results, hang_s=110, total_s=130):
     }
 
 
-def main():
+def run_probe() -> dict:
     results: dict = {"env": {
         k: v for k, v in os.environ.items()
         if any(t in k for t in ("AXON", "TPU", "JAX", "PALLAS"))
@@ -187,16 +187,51 @@ def main():
     verdict = "wedged"
     if results.get("compile", {}).get("status") == "ok":
         verdict = "live"
+        # "live" must mean the axon backend answered — a compile that ran on
+        # the plain CPU PJRT client (env never routed to axon, or the plugin
+        # isn't installed) is a healthy interpreter, not a healthy tunnel
+        devs = results.get("backend-init", {}).get("stdout", "")
+        axon_env = any("axon" in v.lower()
+                       for v in results["env"].values())
+        if not axon_env and "Tpu" not in devs and "Axon" not in devs:
+            verdict = "cpu-only"
     elif results.get("backend-init", {}).get("status") != "ok":
         verdict = "init-failure"
     results["verdict"] = verdict
+    return results
+
+
+def _stamp(results: dict) -> None:
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "TPU_PROBE.json")
     with open(path, "w") as f:
         json.dump(results, f, indent=1)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--retries", type=int, default=1,
+                    help="probe attempts before giving up (standing retry: "
+                         "the tunnel may come up mid-round)")
+    ap.add_argument("--sleep", type=float, default=30.0,
+                    help="seconds between attempts")
+    args = ap.parse_args(argv)
+    results: dict = {}
+    for attempt in range(1, max(args.retries, 1) + 1):
+        results = run_probe()
+        results["attempt"] = attempt
+        results["stamped_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime())
+        _stamp(results)
+        if results["verdict"] == "live":
+            break
+        if attempt <= args.retries - 1:
+            time.sleep(args.sleep)
     print(json.dumps({k: v.get("status", "n/a") if isinstance(v, dict) else v
                       for k, v in results.items() if k != "env"}))
-    return 0 if verdict == "live" else 1
+    return 0 if results["verdict"] == "live" else 1
 
 
 if __name__ == "__main__":
