@@ -675,6 +675,17 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
                     "mixed_point_p99_ms": pts.get(
                         "mixed", {}).get("point_p99_ms", 0),
                 })
+            obs = sres.get("obs", {})
+            if obs:
+                # observability-plane tax on the two latency-critical
+                # lanes (audit+sampler on vs off; gate is <5%)
+                serve.update({
+                    "obs_warm_regress_pct": obs.get(
+                        "obs_warm_regress_pct", 0),
+                    "obs_point_regress_pct": obs.get(
+                        "obs_point_regress_pct", 0),
+                    "obs_pass": int(bool(obs.get("obs_pass", False))),
+                })
             fb = sres.get("feedback", {})
             if fb:
                 on = fb.get("on", {})

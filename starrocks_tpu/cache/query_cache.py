@@ -183,12 +183,20 @@ class QueryCache:
         self._entries[k] = e
         self._bytes += e.nbytes
         budget = config.get("query_cache_capacity_mb") << 20
+        evicted = 0
         while self._bytes > budget and self._entries:
             _, victim = self._entries.popitem(last=False)
             self._bytes -= victim.nbytes
             self.evictions += 1
+            evicted += 1
             QCACHE_EVICTIONS.inc()
         QCACHE_BYTES.set(self._bytes)
+        if evicted:
+            from ..runtime import events
+
+            # the journal lock is a leaf, safe under the cache lock
+            events.emit("cache_evict_pressure", evicted=evicted,
+                        resident_bytes=self._bytes)
 
     def _drop(self, k):  # lint: holds _lock
         e = self._entries.pop(k, None)

@@ -227,10 +227,28 @@ class Heartbeater:
         except (OSError, FailPointError):
             return False  # coordinator away (or injected fault): back off
 
+    def _observe(self, ok: bool):
+        """Fold one beat outcome into the failure ladder AND the event
+        journal. The ladder reset used to be silent: a reconnect after
+        capped backoff left no record that the worker had ever been away
+        — the `heartbeat_reconnect` event (with the failure count it
+        recovered from) is the observable. Loss is journaled once per
+        outage, on the 0 -> 1 transition."""
+        from . import events
+
+        if ok:
+            if self._failures:
+                events.emit("heartbeat_reconnect", worker=self.worker_id,
+                            after_failures=self._failures)
+            self._failures = 0
+            return
+        self._failures += 1
+        if self._failures == 1:
+            events.emit("heartbeat_loss", worker=self.worker_id)
+
     def _run(self):
         while not self._stop.is_set():
-            ok = self._beat_once()
-            self._failures = 0 if ok else self._failures + 1
+            self._observe(self._beat_once())
             self._wait(self._next_delay())
 
     def stop(self):

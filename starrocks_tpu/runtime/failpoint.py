@@ -43,6 +43,11 @@ class _Registry:
                 if ent["times"] <= 0:
                     return
                 ent["times"] -= 1
+        # armed path only (disarmed sites return above): journal the
+        # trigger before the fault fires, outside the registry lock
+        from . import events
+
+        events.emit("failpoint_trigger", site=name)
         if ent["action"] is None:
             raise FailPointError(f"failpoint {name!r} triggered")
         ent["action"]()

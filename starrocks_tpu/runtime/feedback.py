@@ -229,8 +229,10 @@ class FeedbackStore:
             # a band-tier move can flip a banded() outcome with identical
             # observations, so it must invalidate token-extended opt-plan
             # keys exactly like a changed observation
-            changed = (before != after or feedback_band(max(obs_before, 1))
-                       != feedback_band(e["obs"]))
+            band_before = feedback_band(max(obs_before, 1))
+            band_after = feedback_band(e["obs"])
+            obs_after = e["obs"]
+            changed = before != after or band_before != band_after
             if changed:
                 e["token"] = e.get("token", 0) + 1
             self._entries.pop(fp, None)  # re-insert = LRU touch
@@ -239,6 +241,13 @@ class FeedbackStore:
                 del self._entries[next(iter(self._entries))]
             if changed:
                 self._save_locked()
+        if band_before != band_after:
+            from . import events
+
+            # journaled outside the store lock (record holds it through
+            # the sidecar save above)
+            events.emit("feedback_band_move", fingerprint=fp[:16],
+                        obs=obs_after, band=band_after)
         FEEDBACK_RECORDS.inc()
 
     # --- invalidation ---------------------------------------------------------

@@ -84,6 +84,28 @@ def make_handler(session: Session, tier: ServingTier):
                         "mem_bytes", "stage", "sql")
                 self._send(200, json.dumps(
                     [dict(zip(cols, r)) for r in REGISTRY.snapshot()]))
+            elif self.path == "/api/audit":
+                from .audit import AUDIT
+
+                self._send(200, json.dumps(
+                    {"audit": AUDIT.snapshot(limit=500),
+                     "stats": AUDIT.stats()}, default=str))
+            elif self.path == "/api/events":
+                from .events import EVENTS
+
+                self._send(200, json.dumps(
+                    {"events": EVENTS.snapshot(limit=500),
+                     "counts": EVENTS.stats()}, default=str))
+            elif self.path == "/api/metrics/history":
+                from .metrics import HISTORY
+
+                self._send(200, json.dumps(
+                    {"samples": HISTORY.snapshot()}, default=str))
+            elif self.path == "/api/debug/bundle":
+                from .audit import diagnostic_bundle
+
+                self._send(200, json.dumps(
+                    diagnostic_bundle(session), default=str))
             else:
                 self._send(404, json.dumps({"error": "not found"}))
 
@@ -197,6 +219,12 @@ class SqlHttpServer:
         self._thread: threading.Thread | None = None
 
     def start(self):
+        from .metrics import HISTORY
+
+        # a serving surface is up: start the metrics-history sampler so
+        # /api/metrics/history has trajectory data (idempotent; gated by
+        # enable_metrics_history)
+        HISTORY.ensure_started()
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True
         )

@@ -147,6 +147,13 @@ class QueryContext:
             config.get("query_mem_soft_limit_bytes") or 0)
         self.process_limit = int(config.get("process_mem_limit_bytes") or 0)
         self.mem_bytes = 0          # cumulative charged bytes (this query)
+        # high-water mark of mem_bytes, maintained by the accountant's
+        # charge (release_query zeroes mem_bytes BEFORE the unwind's
+        # observability hook runs, so the audit row needs its own peak)
+        self.mem_peak = 0
+        # referenced base tables (sorted, unioned across nested
+        # statements) — set by the session/point lanes for the audit row
+        self.tables: tuple = ()
         self.degraded = False       # soft limit crossed: degrade gracefully
         self.degrade_reason = None
         self.last_stage = "start"
@@ -159,6 +166,10 @@ class QueryContext:
         # latency-histogram class override: the short-circuit point lane
         # sets "point" so its latencies never skew the read/dml classes
         self.stmt_class = None
+        # terminal error text (set by the query_scope handlers); the
+        # audit record carries it — exception objects don't survive the
+        # unwind into the observability hook
+        self.error = ""
         self._cancel_reason = None
         self._cleanups: list = []   # run LIFO on scope exit, every path
 
@@ -341,6 +352,8 @@ class MemoryAccountant:
             return
         with self._lock:
             ctx.mem_bytes += nbytes
+            if ctx.mem_bytes > ctx.mem_peak:
+                ctx.mem_peak = ctx.mem_bytes
             self.process_bytes += nbytes
             if ctx.group:
                 self.group_bytes[ctx.group] = (
@@ -379,6 +392,10 @@ class MemoryAccountant:
             ctx.degrade_reason = (
                 f"soft limit {ctx.mem_soft_limit} crossed at {stage!r}")
             MEM_DEGRADED.inc()
+            from . import events
+
+            events.emit("soft_mem_degrade", qid=ctx.qid, stage=stage,
+                        soft_limit=ctx.mem_soft_limit)
 
     def release_query(self, ctx: QueryContext):
         with self._lock:
@@ -470,6 +487,12 @@ def _finalize_observability(ctx: QueryContext):
             profile=ctx.profile)
         observe_query_latency(ctx.sql, ctx.elapsed_ms(),
                               getattr(ctx, "stmt_class", None))
+        from .audit import AUDIT
+
+        # same contract as the profile: EVERY terminal state (done,
+        # error, cancelled, timeout, memlimit, reaped-while-queued)
+        # leaves exactly one audit record
+        AUDIT.record_query(ctx)
     except Exception:  # noqa: BLE001  # lint: swallow-ok — observability must never fail the unwind
         pass
 
@@ -480,6 +503,7 @@ def finalize_queued(ctx: QueryContext):
     bookkeeping as a cancelled query_scope exit (state, counter, cleanup
     stack, accountant, registry), run by the waiting connection thread."""
     ctx.state = "cancelled"
+    ctx.error = str(ctx.cancel_reason() or "killed while queued")
     QUERIES_CANCELLED.inc()
     ctx.run_cleanups()
     ACCOUNTANT.release_query(ctx)
@@ -520,19 +544,23 @@ def query_scope(sql: str, user: str = "root", group: str | None = None,
         yield ctx
         if ctx.state == "running":
             ctx.state = "done"
-    except QueryCancelledError:
+    except QueryCancelledError as e:
         ctx.state = "cancelled"
+        ctx.error = str(e)
         QUERIES_CANCELLED.inc()
         raise
-    except QueryTimeoutError:
+    except QueryTimeoutError as e:
         ctx.state = "timeout"
+        ctx.error = str(e)
         QUERIES_TIMEOUT.inc()
         raise
-    except MemLimitExceeded:
+    except MemLimitExceeded as e:
         ctx.state = "memlimit"
+        ctx.error = str(e)
         raise
-    except BaseException:
+    except BaseException as e:
         ctx.state = "error"
+        ctx.error = f"{type(e).__name__}: {e}"
         raise
     finally:
         _tls.ctx = None

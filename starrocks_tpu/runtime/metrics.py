@@ -17,6 +17,9 @@ sees a torn read ordering against `inc`.
 from __future__ import annotations
 
 import bisect
+import threading
+import time
+from collections import deque
 
 from .. import lockdep
 
@@ -150,6 +153,27 @@ class MetricRegistry:
                 m = self._metrics[name] = Histogram(name, help_, buckets)
             return m
 
+    def snapshot_values(self) -> dict:
+        """One consistent-enough pass over every registered metric:
+        name -> ("counter"|"gauge", value) or ("histogram", (p50, p95,
+        p99, count, sum)). The registry lock covers only the listing;
+        each metric's own lock covers its read (same discipline as
+        render_prometheus)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out = {}
+        for name, m in items:
+            if isinstance(m, Histogram):
+                _, s, n = m.snapshot()
+                out[name] = ("histogram",
+                             (m.percentile(0.5), m.percentile(0.95),
+                              m.percentile(0.99), n, s))
+            elif isinstance(m, Gauge):
+                out[name] = ("gauge", m.value)
+            else:
+                out[name] = ("counter", m.value)
+        return out
+
     def render_prometheus(self) -> str:
         with self._lock:
             items = sorted(self._metrics.items())
@@ -179,3 +203,131 @@ PROGRAM_COMPILES = metrics.counter(
     "fresh program traces (cache misses across local/batched/hybrid paths)"
 )
 ROWS_LOADED = metrics.counter("sr_tpu_rows_loaded_total", "rows ingested")
+
+
+class MetricsHistory:
+    """Fixed-capacity time-series ring over the registry: each sample
+    holds counter DELTAS since the previous sample, gauge values, and
+    histogram p50/p95/p99 estimates — the "what did the metrics look
+    like five minutes ago" surface (`information_schema.metrics_history`,
+    `GET /api/metrics/history`, serve_bench trajectory reporting).
+
+    A daemon sampler thread fills the ring every
+    `metrics_history_interval_s`; `ensure_started()` is idempotent and
+    called from the HTTP/serving entry points, so pure-library use never
+    pays for a thread. Bounded by `metrics_history_capacity` samples
+    (defaults: 5s x 120 = ~10 minutes)."""
+
+    def __init__(self, registry: MetricRegistry, capacity: int = 120):
+        self._registry = registry
+        self._lock = lockdep.lock("MetricsHistory._lock")
+        self._cap = int(capacity)    # guarded_by: _lock
+        self._ring: deque = deque()  # guarded_by: _lock
+        self._prev: dict = {}        # guarded_by: _lock — counters at last sample
+        self._thread = None          # guarded_by: _lock
+        # internally synchronized; replaced only under _lock (restart)
+        self._stop = threading.Event()  # lint: unguarded-ok
+
+    def set_capacity(self, n: int):
+        with self._lock:
+            self._cap = max(int(n), 1)
+            while len(self._ring) > self._cap:
+                self._ring.popleft()
+
+    def sample(self):
+        """Take one sample now (the sampler thread's body; tests call it
+        directly for determinism)."""
+        vals = self._registry.snapshot_values()  # registry locks, not ours
+        ts = time.time()
+        with self._lock:
+            counters, gauges, hists, nxt = {}, {}, {}, {}
+            for name, (kind, v) in vals.items():
+                if kind == "counter":
+                    nxt[name] = v
+                    d = v - self._prev.get(name, 0)
+                    if d:
+                        counters[name] = d
+                elif kind == "gauge":
+                    gauges[name] = v
+                else:
+                    p50, p95, p99, n, s = v
+                    hists[name] = {"p50": round(p50, 3),
+                                   "p95": round(p95, 3),
+                                   "p99": round(p99, 3), "count": n}
+            self._prev = nxt
+            self._ring.append({"ts": ts, "counters": counters,
+                               "gauges": gauges, "histograms": hists})
+            while len(self._ring) > self._cap:
+                self._ring.popleft()
+
+    def snapshot(self, limit: int | None = None) -> list:
+        """Newest-last samples (shallow copies)."""
+        with self._lock:
+            rows = [dict(e) for e in self._ring]
+        return rows[-limit:] if limit else rows
+
+    def ensure_started(self):
+        """Idempotently start the sampler thread (no-op when disabled).
+        The first sample is taken synchronously by the new thread, so a
+        scrape right after server start already sees history."""
+        from .config import config
+
+        if not config.get("enable_metrics_history"):
+            return
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._run, name="sr-tpu-metrics-history", daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        from .config import config
+
+        while not self._stop.is_set():
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001  # lint: swallow-ok — the sampler must survive scrape races
+                pass
+            interval = float(
+                config.get("metrics_history_interval_s") or 5.0)
+            self._stop.wait(max(interval, 0.05))
+
+    def stop(self):
+        """Tests only: stop the sampler and keep the ring."""
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        self._stop.set()
+        if t is not None:
+            t.join(timeout=2)
+
+    def clear(self):
+        """Tests only."""
+        with self._lock:
+            self._ring.clear()
+            self._prev = {}
+
+
+HISTORY = MetricsHistory(metrics)
+
+
+def _define_history_knobs():
+    # late import: config never imports metrics, but keeping the
+    # dependency out of the module header keeps the core registry usable
+    # from config-free contexts (unit tests, tools)
+    from .config import config
+
+    config.define("enable_metrics_history", True, True,
+                  "run the metrics-history sampler thread when a serving "
+                  "surface starts (HTTP/serving tier)")
+    config.define("metrics_history_interval_s", 5.0, True,
+                  "seconds between metrics-history samples")
+    config.define("metrics_history_capacity", 120, True,
+                  "bounded sample count of the metrics-history ring "
+                  "(default ~10 minutes at the default interval)")
+    config.on_set("metrics_history_capacity", HISTORY.set_capacity)
+
+
+_define_history_knobs()

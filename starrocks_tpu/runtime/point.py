@@ -328,6 +328,7 @@ def try_execute(session, text: str):
     if ctx is not None:
         ctx.stmt_class = "point"  # own latency class (LATENCY_POINT_MS)
         ctx.profile = profile
+        ctx.tables = tuple(sorted(set(ctx.tables) | {handle.name}))
     # the lane is admission-exempt but NOT lifecycle-exempt: a queued
     # KILL lands here, before the index probe
     lifecycle.checkpoint("point::probe")

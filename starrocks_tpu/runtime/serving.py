@@ -107,6 +107,23 @@ SERVE_POINT_INLINE = metrics.counter(
     "point statements served inline on the connection thread (no pool "
     "hop) by the short-circuit lane")
 
+# a writer that waited this long on the gate is journaled as a
+# `gate_writer_stall` event (runtime/events.py) — reads contend freely,
+# so only the exclusive side can starve visibly
+_GATE_STALL_EVENT_S = 0.25
+
+
+def _note_writer_stall(table, waited_s: float):
+    """Journal a stalled gate writer (called AFTER acquisition, outside
+    the gate lock — the event journal lock stays a leaf)."""
+    if waited_s < _GATE_STALL_EVENT_S:
+        return
+    from . import events
+
+    events.emit("gate_writer_stall", table=table or "",
+                waited_ms=round(waited_s * 1000.0, 1))
+
+
 # leading keyword -> shared (read) side of the statement gate; anything
 # else (DML/DDL/SET/ADMIN/...) is exclusive. KILL never reaches the tier.
 _READ_KEYWORDS = frozenset(
@@ -259,6 +276,7 @@ class StatementGate:
         if table is not None:
             yield from self._table_exclusive(table, reads)
             return
+        t0 = time.monotonic()
         with self._lock:
             self._writers_waiting += 1
             try:
@@ -268,6 +286,7 @@ class StatementGate:
                 self._writer = True
             finally:
                 self._writers_waiting -= 1
+        _note_writer_stall(None, time.monotonic() - t0)
         try:
             yield
         finally:
@@ -279,6 +298,7 @@ class StatementGate:
         from . import lifecycle
 
         reads = frozenset(reads) - {table}
+        t0 = time.monotonic()
         with self._lock:
             self._table_writers_waiting[table] = \
                 self._table_writers_waiting.get(table, 0) + 1
@@ -304,6 +324,7 @@ class StatementGate:
                     self._table_writers_waiting[table] = n
                 else:
                     self._table_writers_waiting.pop(table, None)
+        _note_writer_stall(table, time.monotonic() - t0)
         try:
             yield
         finally:
@@ -482,6 +503,11 @@ class ServingTier:
         size = pool_size if pool_size is not None \
             else int(config.get("serve_pool_size"))
         self.pool = ExecutorPool(size, self.gate)
+        from .metrics import HISTORY
+
+        # a serving surface exists: keep the metrics-history ring warm
+        # (idempotent; gated by enable_metrics_history)
+        HISTORY.ensure_started()
 
     def new_session(self, user: str = "root") -> Session:
         """A per-connection session over the SHARED catalog/cache/store:

@@ -462,6 +462,14 @@ class Session:
         # measurement so the trace export covers parse->...->fetch
         self._last_parse = (_pw0, _time.perf_counter() - _pt0)
         self._enforce_privileges(stmt)
+        from . import lifecycle as _lc
+
+        _ctx = _lc.current()
+        if _ctx is not None and isinstance(getattr(stmt, "table", None), str):
+            # DML/DDL target table into the audit row's referenced set
+            # (SELECT plans contribute theirs in _query_planned)
+            _ctx.tables = tuple(sorted(
+                set(_ctx.tables) | {stmt.table.lower()}))
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt)
         if isinstance(stmt, (ast.Select, ast.SetOp)):
@@ -617,6 +625,13 @@ class Session:
 
             failpoint.set_from_sql(stmt.name, stmt.value)
             return None
+        if isinstance(stmt, ast.AdminDiagnose):
+            import json as _json
+
+            from .audit import diagnostic_bundle
+
+            # one parseable JSON document — the flight-recorder dump
+            return _json.dumps(diagnostic_bundle(self), default=str)
         if isinstance(stmt, ast.ShowProfile):
             # the reference's SHOW PROFILE [FOR QUERY <id>]: the last
             # query's RuntimeProfile tree, or a retained profile from the
@@ -847,7 +862,8 @@ class Session:
                                ast.CreateExternalTable,
                                ast.CreateResourceGroup,
                                ast.DropResourceGroup,
-                               ast.AdminSetFailpoint)):
+                               ast.AdminSetFailpoint,
+                               ast.AdminDiagnose)):
             raise PermissionError(
                 f"user {user!r} lacks the admin privileges for DDL")
 
@@ -951,6 +967,16 @@ class Session:
             # retained on every exit path by the scope's unwind — a killed
             # query's profile reports the stage it died at
             ctx.profile = profile
+            from ..sql.logical import LScan, walk_plan
+
+            # referenced-table union for the audit row; UNIONED (not
+            # replaced) so INSERT..SELECT's nested select adds to the
+            # outer statement's set instead of clobbering it
+            refs = {n.table for n in walk_plan(plan)
+                    if isinstance(n, LScan)
+                    and not n.table.startswith("__")}
+            if refs:
+                ctx.tables = tuple(sorted(set(ctx.tables) | refs))
         self._check_select_privs(plan)
         lifecycle.checkpoint("session::analyzed")
         # admission() releases the slot on ANY exit path — including a KILL

@@ -222,6 +222,10 @@ class WorkgroupManager:
                 f"preemption hint: admission lane {lane!r} backed up"):
             self._last_hint[lane] = now
             ADMISSION_PREEMPT_HINTS.inc()
+            from . import events
+
+            # the journal lock is a leaf, safe under the manager lock
+            events.emit("preempt_hint", qid=victim.qid, lane=lane)
 
     def _acquire_lane(self, lane: str, prio: float, deadline: float,
                       aging: float, hint_s: float, ctx):

@@ -351,3 +351,10 @@ class AdminSetFailpoint:
 
     name: str
     value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class AdminDiagnose:
+    """ADMIN DIAGNOSE: the one-shot diagnostic bundle (running queries,
+    profiles, audit/event tails, metrics history, lock-witness state,
+    cache stats, non-default config) as one JSON document."""

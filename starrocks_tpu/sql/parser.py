@@ -242,6 +242,11 @@ class Parser:
         if (self.peek().kind == "ident"
                 and self.peek().value.lower() == "admin"):
             self.next()
+            if (self.peek().kind == "ident"
+                    and self.peek().value.lower() == "diagnose"):
+                self.next()
+                self.accept_op(";")
+                return ast.AdminDiagnose()
             self.expect_kw("set")
             word = self.expect_ident()
             if word.lower() != "failpoint":

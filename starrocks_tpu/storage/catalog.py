@@ -669,6 +669,76 @@ class Catalog:
                 ("name", T.VARCHAR, names),
                 ("value", T.BIGINT, [mreg._metrics[n].value for n in names]),
             ])
+        if view == "audit_log":
+            from ..runtime.audit import AUDIT
+
+            rows = AUDIT.snapshot()
+            return vtable([
+                ("seq", T.BIGINT, [e["seq"] for e in rows]),
+                ("query_id", T.BIGINT, [e["query_id"] for e in rows]),
+                ("ts", T.DOUBLE, [e["ts"] for e in rows]),
+                ("user", T.VARCHAR, [e["user"] for e in rows]),
+                ("statement", T.VARCHAR, [e["stmt"] for e in rows]),
+                ("stmt_class", T.VARCHAR, [e["stmt_class"] for e in rows]),
+                ("tables", T.VARCHAR, [e["tables"] for e in rows]),
+                ("state", T.VARCHAR, [e["state"] for e in rows]),
+                ("stage", T.VARCHAR, [e["stage"] for e in rows]),
+                ("ms", T.BIGINT, [e["ms"] for e in rows]),
+                ("queue_wait_ms", T.BIGINT,
+                 [e["queue_wait_ms"] for e in rows]),
+                ("rows", T.BIGINT, [e["rows"] for e in rows]),
+                ("mem_peak_bytes", T.BIGINT,
+                 [e["mem_peak_bytes"] for e in rows]),
+                ("degraded", T.INT, [e["degraded"] for e in rows]),
+                ("plan_cache_hit", T.INT,
+                 [e["plan_cache_hit"] for e in rows]),
+                ("result_cache_hit", T.INT,
+                 [e["result_cache_hit"] for e in rows]),
+                ("partial_cache_hit", T.INT,
+                 [e["partial_cache_hit"] for e in rows]),
+                ("feedback_hit", T.INT,
+                 [e["feedback_hit"] for e in rows]),
+                ("error", T.VARCHAR, [e["error"] for e in rows]),
+            ])
+        if view == "events":
+            import json as _json
+
+            from ..runtime.events import EVENTS
+
+            rows = EVENTS.snapshot()
+            return vtable([
+                ("seq", T.BIGINT, [e["seq"] for e in rows]),
+                ("ts", T.DOUBLE, [e["ts"] for e in rows]),
+                ("name", T.VARCHAR, [e["name"] for e in rows]),
+                ("detail", T.VARCHAR,
+                 [_json.dumps(e["detail"], sort_keys=True, default=str)
+                  for e in rows]),
+            ])
+        if view == "metrics_history":
+            from ..runtime.metrics import HISTORY
+
+            # flattened (sample_ts, metric, kind, value): histogram
+            # samples expand to _p50/_p95/_p99 rows
+            flat = []
+            for s in HISTORY.snapshot():
+                for name, v in sorted(s["counters"].items()):
+                    flat.append((s["ts"], name, "counter_delta", float(v)))
+                for name, v in sorted(s["gauges"].items()):
+                    flat.append((s["ts"], name, "gauge", float(v)))
+                for name, h in sorted(s["histograms"].items()):
+                    for q in ("p50", "p95", "p99"):
+                        flat.append((s["ts"], f"{name}_{q}", "histogram",
+                                     float(h[q])))
+            ts = [r[0] for r in flat]
+            nm = [r[1] for r in flat]
+            kd = [r[2] for r in flat]
+            vals = [r[3] for r in flat]
+            return vtable([
+                ("ts", T.DOUBLE, ts),
+                ("name", T.VARCHAR, nm),
+                ("kind", T.VARCHAR, kd),
+                ("value", T.DOUBLE, vals),
+            ])
         if view == "columns":
             tn, cn, ty, nu = [], [], [], []
             for n in sorted(self.tables):

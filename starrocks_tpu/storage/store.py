@@ -243,7 +243,10 @@ class TabletStore:
                     f.write(json.dumps(op) + "\n")
             os.replace(tmp, self.log_path)
             self.tail_count = len(keep)
-            return seq
+        from ..runtime import events
+
+        events.emit("checkpoint", seq=seq, tail_ops=len(keep))
+        return seq
 
     # --- table lifecycle ------------------------------------------------------
     def _tdir(self, name: str) -> str:
@@ -578,6 +581,10 @@ class TabletStore:
         if record:
             self.log({"op": "compact", "table": name, "rows": total_rows})
         self._notify(name, "compact")
+        from ..runtime import events
+
+        events.emit("compaction", table=name, rows=total_rows,
+                    rowsets_merged=len(old_files))
         return total_rows
 
     # --- primary-key delta path -------------------------------------------------

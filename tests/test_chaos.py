@@ -643,3 +643,82 @@ def test_hybrid_mem_hard_limit_names_stage_and_frees_partitions():
     _assert_clean(s, before)
     config.set("query_mem_limit_bytes", 0)
     assert s.sql(_Q_HYBRID).rows() == exp
+
+
+# --- audit-log terminal records under chaos (observability plane) ------------
+#
+# The audit contract (runtime/audit.py): EVERY terminal state leaves
+# exactly ONE record, registered at the same unwind hook that releases
+# slots/bytes — so a chaos kill that leaks nothing must still be fully
+# accounted for in the flight recorder.
+
+
+def _audit_records_for(qid: int) -> list:
+    from starrocks_tpu.runtime.audit import AUDIT
+
+    return [r for r in AUDIT.snapshot() if r["query_id"] == qid]
+
+
+def test_killed_query_leaves_exactly_one_audit_record():
+    s = _mk_session()
+    seen = []
+
+    def kill_current():
+        ctx = lifecycle.current()
+        seen.append(ctx.qid)
+        REGISTRY.cancel(ctx.qid, requester="root", admin=True)
+
+    before = _leak_snapshot(s)
+    with failpoint.scoped("executor::before_dispatch", action=kill_current):
+        with pytest.raises(QueryCancelledError):
+            s.sql("select b, sum(a) from t group by b")
+    recs = _audit_records_for(seen[0])
+    assert len(recs) == 1
+    assert recs[0]["state"] == "cancelled"
+    assert recs[0]["error"]  # the kill reason rides the record
+    assert recs[0]["stage"]  # ... and the stage it landed in
+    _assert_clean(s, before)
+    _probe_correct(s)
+
+
+def test_timed_out_query_leaves_exactly_one_audit_record():
+    from starrocks_tpu.runtime.audit import AUDIT
+
+    s = _mk_session(rows=64)
+    config.set("batch_rows_threshold", 16)
+    config.set("query_timeout_s", 0.05)
+    before = _leak_snapshot(s)
+    n0 = AUDIT.stats()["registered"]
+    with failpoint.scoped("spill::batch_loop",
+                          action=lambda: time.sleep(0.06)):
+        with pytest.raises(QueryTimeoutError):
+            s.sql("select b, sum(a) from t group by b")
+    assert AUDIT.stats()["registered"] - n0 == 1
+    rec = AUDIT.snapshot()[-1]
+    assert rec["state"] == "timeout"
+    assert "query_timeout_s" in rec["error"]
+    assert rec["ms"] >= 50
+    _assert_clean(s, before)
+    config.set("query_timeout_s", 0.0)
+    config.set("batch_rows_threshold", 0)
+    _probe_correct(s, rows=64)
+
+
+def test_failpoint_failed_query_leaves_exactly_one_audit_record():
+    s = _mk_session()
+    seen = []
+    before = _leak_snapshot(s)
+
+    def note_qid():
+        seen.append(lifecycle.current().qid)
+        raise FailPointError("executor::fetch_results (chaos)")
+
+    with failpoint.scoped("executor::fetch_results", action=note_qid):
+        with pytest.raises(FailPointError):
+            s.sql("select b, sum(a) from t group by b")
+    recs = _audit_records_for(seen[0])
+    assert len(recs) == 1
+    assert recs[0]["state"] == "error"
+    assert recs[0]["stage"]  # terminal stage attributed (unwind-dependent)
+    _assert_clean(s, before)
+    _probe_correct(s)

@@ -13,6 +13,13 @@ Prometheus exposition the way a collector would:
   * every exported family name carries the `sr_tpu_` prefix — the wire
     half of src_lint's R7 metric-name-prefix rule (declaration half).
 
+Also scrapes the observability-plane JSON endpoints against their
+schemas on the same live server: /api/audit (one record per driven
+statement, terminal fields present), /api/events (list + per-type
+counts over the closed taxonomy), /api/metrics/history (sampler ring
+populated, samples carry counters/gauges/histograms), and
+/api/debug/bundle (the ADMIN DIAGNOSE document, all sections present).
+
 Exit 1 with a finding list on any violation, 0 otherwise.
 """
 
@@ -41,6 +48,73 @@ def scrape(port: int) -> str:
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
         return r.read().decode()
+
+
+def scrape_json(port: int, path: str):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+AUDIT_FIELDS = ("query_id", "user", "stmt", "stmt_class", "tables",
+                "state", "stage", "ms", "rows", "mem_peak_bytes")
+BUNDLE_SECTIONS = ("running", "memory", "profiles", "audit_tail",
+                   "events_tail", "event_counts", "metrics_history",
+                   "lock_witness", "failpoints", "config_non_default")
+
+
+def validate_observability(port: int, n_statements: int) -> list[str]:
+    """Schema-check the JSON observability endpoints on the live server
+    (called while the statements just driven are still in the rings)."""
+    findings: list[str] = []
+
+    audit = scrape_json(port, "/api/audit")
+    recs = audit.get("audit", [])
+    if len(recs) < n_statements:
+        findings.append(f"/api/audit retains {len(recs)} records after "
+                        f"{n_statements} statements")
+    for rec in recs[-n_statements:]:
+        missing = [f for f in AUDIT_FIELDS if f not in rec]
+        if missing:
+            findings.append(f"/api/audit record {rec.get('seq')} missing "
+                            f"fields {missing}")
+            break
+    if not isinstance(audit.get("stats", {}).get("registered"), int):
+        findings.append("/api/audit stats.registered missing")
+
+    from starrocks_tpu.runtime.events import TAXONOMY
+
+    ev = scrape_json(port, "/api/events")
+    if not isinstance(ev.get("events"), list):
+        findings.append("/api/events payload missing 'events' list")
+    for e in ev.get("events", []):
+        if e.get("name") not in TAXONOMY:
+            findings.append(f"/api/events entry {e.get('seq')} has "
+                            f"off-taxonomy name {e.get('name')!r}")
+            break
+    for name in ev.get("counts", {}):
+        if name not in TAXONOMY:
+            findings.append(f"/api/events counts has off-taxonomy key "
+                            f"{name!r}")
+            break
+
+    hist = scrape_json(port, "/api/metrics/history")
+    samples = hist.get("samples")
+    if not isinstance(samples, list) or not samples:
+        findings.append("/api/metrics/history has no samples (sampler "
+                        "not running on a live server?)")
+    else:
+        s = samples[-1]
+        for key in ("ts", "counters", "gauges", "histograms"):
+            if key not in s:
+                findings.append(f"/api/metrics/history sample missing "
+                                f"{key!r}")
+
+    bundle = scrape_json(port, "/api/debug/bundle")
+    missing = [s for s in BUNDLE_SECTIONS if s not in bundle]
+    if missing:
+        findings.append(f"/api/debug/bundle missing sections {missing}")
+    return findings
 
 
 def validate(text: str) -> list[str]:
@@ -102,10 +176,11 @@ def main() -> int:
             with urllib.request.urlopen(req, timeout=120) as r:
                 json.loads(r.read())
         text = scrape(srv.port)
+        obs_findings = validate_observability(srv.port, len(STATEMENTS))
     finally:
         srv.stop()
 
-    findings = validate(text)
+    findings = validate(text) + obs_findings
     # the queries above must have landed samples in the read-latency and
     # compile histograms — an exposition that validates but never observes
     # would pass the shape checks while the instrumentation is dead

@@ -29,6 +29,10 @@ Phases:
      learn/repeat/steady passes with `plan_feedback` off vs on; the on
      arm must pre-tighten the restart-analog repeat pass to zero
      adaptive recompiles and hold steady-state fresh compiles at zero.
+  6. **obs** — observability-plane overhead A/B (audit log +
+     metrics-history sampler on vs off, interleaved rounds): warm
+     fast-path p50 and point-lane p50 must regress <5% with the
+     defaults ON (`--obs` runs just this phase; `--no-obs` skips it).
 
 Summary JSON prints on the last line (the driver's bench contract);
 --detail merges a "serve" section into BENCH_DETAIL.json.
@@ -464,12 +468,102 @@ def run_feedback_phase(cat, statements) -> dict:
     return out
 
 
+def run_obs_phase(iters: int = 240, nrows: int = 8000) -> dict:
+    """Observability-plane overhead A/B: audit log + metrics-history
+    sampler ON (the shipped defaults) vs OFF, over the two latencies the
+    plane must NOT tax — the warm in-proc fast path (result-cache inline
+    answer) and the point lane (planner-free PK lookup). The event
+    journal has no off switch, but none of its ten sites fire on either
+    lane, so audit+sampler IS the per-statement delta. Arms alternate in
+    interleaved rounds so host drift cancels out of the comparison;
+    acceptance is <5% p50 regression on both lanes (obs work rides the
+    unwind hook and a background thread, never the answer path)."""
+    import shutil
+    import tempfile
+
+    from starrocks_tpu.runtime import audit  # noqa: F401 — knob define
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.metrics import HISTORY
+    from starrocks_tpu.runtime.session import Session
+
+    d = tempfile.mkdtemp(prefix="sr_obsbench_")
+    prev_audit = config.get("enable_audit_log")
+    prev_hist = config.get("enable_metrics_history")
+    prev_qc = config.get("enable_query_cache")
+    out: dict = {}
+    try:
+        s = Session(data_dir=os.path.join(d, "db"))
+        s.sql("create table obs_kv (k bigint, v varchar, n bigint, "
+              "primary key(k))")
+        for base in range(0, nrows, 2000):
+            rows = ",".join(f"({i}, 'v{i}', {i * 3})"
+                            for i in range(base, min(base + 2000, nrows)))
+            s.sql(f"insert into obs_kv values {rows}")
+        config.set("enable_query_cache", True)
+        warm_sql = "select count(*) c, sum(n) sn from obs_kv"
+        rng = random.Random(7)
+
+        def one_warm():
+            s.sql(warm_sql)
+
+        def one_point():
+            s.sql(f"select v, n from obs_kv where k = {rng.randrange(nrows)}")
+
+        def set_arm(on: bool):
+            config.set("enable_audit_log", on)
+            config.set("enable_metrics_history", on)
+            if on:
+                HISTORY.ensure_started()
+            else:
+                HISTORY.stop()
+
+        for _ in range(20):  # shared warmup: pay compiles, prime caches
+            one_warm()
+            one_point()
+        lats: dict = {(lane, on): []
+                      for lane in ("warm", "point") for on in (True, False)}
+        rounds = 8
+        per = max(iters // rounds, 10)
+        for r in range(rounds):
+            for on in ((True, False) if r % 2 == 0 else (False, True)):
+                set_arm(on)
+                for _ in range(3):  # settle the arm switch
+                    one_warm()
+                    one_point()
+                for lane, fn in (("warm", one_warm), ("point", one_point)):
+                    for _ in range(per):
+                        t0 = time.perf_counter()
+                        fn()
+                        lats[(lane, on)].append(
+                            (time.perf_counter() - t0) * 1000)
+
+        def p50(lane, on):
+            v = sorted(lats[(lane, on)])
+            return v[len(v) // 2]
+
+        out["obs_on_warm_p50_ms"] = round(p50("warm", True), 3)
+        out["obs_off_warm_p50_ms"] = round(p50("warm", False), 3)
+        out["obs_on_point_p50_ms"] = round(p50("point", True), 3)
+        out["obs_off_point_p50_ms"] = round(p50("point", False), 3)
+        warm_reg = p50("warm", True) / max(p50("warm", False), 1e-9) - 1
+        point_reg = p50("point", True) / max(p50("point", False), 1e-9) - 1
+        out["obs_warm_regress_pct"] = round(warm_reg * 100, 1)
+        out["obs_point_regress_pct"] = round(point_reg * 100, 1)
+        out["obs_pass"] = bool(warm_reg < 0.05 and point_reg < 0.05)
+    finally:
+        config.set("enable_audit_log", prev_audit)
+        config.set("enable_metrics_history", prev_hist)
+        config.set("enable_query_cache", prev_qc)
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def run_serve_bench(threads: int = 32, seconds: float = 8.0,
                     sf: float = 0.01, pool: int = 4,
                     include_ssb: bool = False, http_frac: float = 0.25,
                     chaos: bool = False, single_thread_ab: bool = True,
                     warm: bool = True, feedback: bool = True,
-                    points: bool = True) -> dict:
+                    points: bool = True, obs: bool = True) -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -488,6 +582,12 @@ def run_serve_bench(threads: int = 32, seconds: float = 8.0,
         # runs FIRST so its store-backed table allocates before the leak
         # audit's baseline snapshot
         out_points = run_point_phase(seconds=min(seconds, 4.0))
+
+    out_obs = None
+    if obs:
+        # also before the leak baseline: the A/B builds (and drops) its
+        # own store-backed PK table
+        out_obs = run_obs_phase()
 
     t_setup = time.monotonic()
     cat = tpch_catalog(sf=sf)
@@ -591,6 +691,8 @@ def run_serve_bench(threads: int = 32, seconds: float = 8.0,
 
     if out_points is not None:
         out["points"] = out_points
+    if out_obs is not None:
+        out["obs"] = out_obs
 
     # leak + witness audit (the chaos-suite contract, applied to serving)
     wm = getattr(cat, "workgroups", None)
@@ -626,6 +728,11 @@ def main():
                     help="run ONLY the short-circuit point-query phase")
     ap.add_argument("--no-points", action="store_true",
                     help="skip the point-query phase in the full run")
+    ap.add_argument("--obs", action="store_true",
+                    help="run ONLY the observability-overhead A/B phase "
+                         "(audit+events+sampler on vs off; <5%% gate)")
+    ap.add_argument("--no-obs", action="store_true",
+                    help="skip the observability A/B phase in the full run")
     ap.add_argument("--detail", action="store_true",
                     help="merge a 'serve' section into BENCH_DETAIL.json")
     args = ap.parse_args()
@@ -638,12 +745,20 @@ def main():
         print(json.dumps(res))
         return 0
 
+    if args.obs:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        res = {"obs": run_obs_phase()}
+        print(json.dumps(res))
+        return 0 if res["obs"]["obs_pass"] else 1
+
     res = run_serve_bench(
         threads=args.threads, seconds=args.seconds, sf=args.sf,
         pool=args.pool, include_ssb=args.ssb, http_frac=args.http_frac,
         chaos=args.chaos, single_thread_ab=not args.no_ab,
         warm=not args.no_warm, feedback=not args.no_feedback,
-        points=not args.no_points)
+        points=not args.no_points, obs=not args.no_obs)
     if args.detail:
         path = os.path.join(REPO, "BENCH_DETAIL.json")
         detail = {}
@@ -657,8 +772,10 @@ def main():
             json.dump(detail, f, indent=1)
     print(json.dumps(res))
     leaks = res.get("leaks", {})
+    obs_fail = "obs" in res and not res["obs"].get("obs_pass")
     bad = (res.get("witness_cycles", 0)
-           or leaks.get("process_bytes") or leaks.get("slots_running"))
+           or leaks.get("process_bytes") or leaks.get("slots_running")
+           or obs_fail)
     return 1 if bad else 0
 
 

@@ -75,6 +75,15 @@ R8 point-query-scope: the short-circuit point lane's execution entry
    accounted plane. `try_execute` itself must hit a `lifecycle.checkpoint`
    before the index probe so an in-flight KILL lands.
 
+R9 event-taxonomy: system events are journaled ONLY through the
+   sanctioned API `events.emit("<name>", ...)` with a LITERAL name in
+   the closed TAXONOMY statically parsed from runtime/events.py (no
+   import — same discipline as R3/R6). Computed names, off-taxonomy
+   literals, and direct `EVENTS.emit(...)` calls outside events.py all
+   fail: the taxonomy is the contract dashboards and the /api/events
+   schema check key on, and an ad-hoc event string silently drops out
+   of every per-type counter.
+
 The lint also counts `fail_point()` call sites across the package and
 fails below the chaos-suite floor (MIN_FAILPOINT_SITES): fault-injection
 coverage is an invariant here, not a nice-to-have.
@@ -466,6 +475,66 @@ def lint_metric_names(sources) -> list:
     return findings
 
 
+def _declared_event_taxonomy() -> frozenset:
+    """Statically parse the closed event taxonomy from the
+    `TAXONOMY = frozenset((...))` literal in runtime/events.py — no
+    import, same discipline as _declared_key_knobs."""
+    path = os.path.join(REPO, "starrocks_tpu", "runtime", "events.py")
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return frozenset()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and node.targets
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "TAXONOMY"):
+            continue
+        names = set()
+        for c in ast.walk(node.value):
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                names.add(c.value)
+        return frozenset(names)
+    return frozenset()
+
+
+def lint_event_names(sources) -> list:
+    """R9: see module docstring."""
+    taxonomy = _declared_event_taxonomy()
+    findings = []
+    for ms in sources:
+        in_events_module = ms.rel.endswith("runtime/events.py")
+        for node in ast.walk(ms.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit"
+                    and isinstance(node.func.value, ast.Name)):
+                continue
+            owner = node.func.value.id
+            if owner == "EVENTS" and not in_events_module:
+                findings.append(
+                    f"{ms.rel}:{node.lineno}: [event-taxonomy] direct "
+                    f"EVENTS.emit(...) — journal through the sanctioned "
+                    f"events.emit(...) API")
+                continue
+            if owner != "events":
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                findings.append(
+                    f"{ms.rel}:{node.lineno}: [event-taxonomy] "
+                    f"events.emit(...) with a computed name — event types "
+                    f"are a closed taxonomy (runtime/events.py)")
+                continue
+            name = node.args[0].value
+            if name not in taxonomy:
+                findings.append(
+                    f"{ms.rel}:{node.lineno}: [event-taxonomy] "
+                    f"events.emit({name!r}) — not in the declared "
+                    f"taxonomy (runtime/events.py TAXONOMY)")
+    return findings
+
+
 def lint_serving_scope(sources) -> list:
     """R5: see module docstring."""
     ms = next((m for m in sources if m.rel == SERVING_MODULE), None)
@@ -595,6 +664,7 @@ def main():
     findings += lint_serving_scope(sources)
     findings += lint_metric_names(sources)
     findings += lint_point_scope(sources)
+    findings += lint_event_names(sources)
     n_fp = count_failpoints(sources)
     if n_fp < MIN_FAILPOINT_SITES:
         findings.append(
