@@ -678,13 +678,22 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
             obs = sres.get("obs", {})
             if obs:
                 # observability-plane tax on the two latency-critical
-                # lanes (audit+sampler on vs off; gate is <5%)
+                # lanes (the full derived plane on vs off; gate is <5%)
+                # plus the round-19 bounded-state bookkeeping
                 serve.update({
                     "obs_warm_regress_pct": obs.get(
                         "obs_warm_regress_pct", 0),
                     "obs_point_regress_pct": obs.get(
                         "obs_point_regress_pct", 0),
                     "obs_pass": int(bool(obs.get("obs_pass", False))),
+                    "workload_entries": obs.get("workload_entries", 0),
+                    "workload_registered": obs.get(
+                        "workload_registered", 0),
+                    "workload_evicted": obs.get("workload_evicted", 0),
+                    "alert_rules": obs.get("alert_rules", 0),
+                    "alert_firing": obs.get("alert_firing", 0),
+                    "alert_fires": obs.get("alert_fires", 0),
+                    "sentinel_entries": obs.get("sentinel_entries", 0),
                 })
             fb = sres.get("feedback", {})
             if fb:

@@ -237,9 +237,12 @@ def diagnostic_bundle(session) -> dict:
     live wedged server."""
     from .. import lockdep as _ld
     from . import events, failpoint
+    from .alerts import ALERTS
     from .lifecycle import ACCOUNTANT, REGISTRY
     from .metrics import HISTORY
     from .profile import PROFILE_MANAGER
+    from .sentinel import SENTINEL
+    from .workload import WORKLOAD
 
     cycles = _ld.WITNESS.order_cycles()
     bundle = {
@@ -254,6 +257,14 @@ def diagnostic_bundle(session) -> dict:
             for e in PROFILE_MANAGER.snapshot()[-50:]],
         "audit_tail": AUDIT.snapshot(limit=100),
         "audit_stats": AUDIT.stats(),
+        # derived-observability plane (round 19): the heaviest workload
+        # shapes, every alert rule (firing first), and the sentinel's
+        # baseline state — what an operator reads FIRST in a postmortem
+        "workload": WORKLOAD.snapshot(limit=20),
+        "workload_stats": WORKLOAD.stats(),
+        "alerts": ALERTS.snapshot(),
+        "alerts_active": ALERTS.active(),
+        "sentinel": SENTINEL.stats(),
         "events_tail": events.EVENTS.snapshot(limit=100),
         "event_counts": events.EVENTS.stats(),
         "metrics_history": HISTORY.snapshot(limit=50),
@@ -274,4 +285,10 @@ def diagnostic_bundle(session) -> dict:
             "qcache_resident_bytes": cache.qcache.resident_bytes,
             "plan_cache": cache.plan_cache.stats(),
         }
+        fb = getattr(cache, "feedback", None)
+        if fb is not None:
+            # fingerprints the plan-regression sentinel has pulled out
+            # of planning, with the baselines re-admission must beat
+            bundle["feedback_quarantine"] = fb.quarantined()
+            bundle["feedback_stats"] = fb.stats()
     return bundle

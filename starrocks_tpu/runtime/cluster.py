@@ -39,9 +39,15 @@ import time
 from typing import Callable, Optional
 
 from .. import lockdep
+from .metrics import metrics
 
 ALIVE = "ALIVE"
 DEAD = "DEAD"
+
+WORKERS_DEAD = metrics.gauge(
+    "sr_tpu_cluster_workers_dead",
+    "registered workers currently marked DEAD by the liveness watchdog "
+    "(feeds the default heartbeat_loss alert rule)")
 
 
 def init_multihost(coordinator_address: str, num_processes: int,
@@ -138,6 +144,8 @@ class ClusterMonitor:
         with self._lock:
             self._beats[worker_id] = time.monotonic()
             self._state[worker_id] = ALIVE
+            dead = sum(1 for s in self._state.values() if s == DEAD)
+        WORKERS_DEAD.set(dead)
 
     def members(self) -> dict:
         with self._lock:
@@ -158,6 +166,8 @@ class ClusterMonitor:
                     if now - last > deadline and self._state[w] == ALIVE:
                         self._state[w] = DEAD
                         fire.append(w)
+                dead = sum(1 for s in self._state.values() if s == DEAD)
+            WORKERS_DEAD.set(dead)
             for w in fire:  # hooks run outside the lock
                 if self.on_failure is not None:
                     try:

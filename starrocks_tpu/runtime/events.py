@@ -44,6 +44,10 @@ TAXONOMY = frozenset((
     "heartbeat_reconnect",   # runtime/cluster.py — beat after failures
     "gate_writer_stall",     # runtime/serving.py — writer waited on gate
     "feedback_band_move",    # runtime/feedback.py — band-tier transition
+    "plan_regression",       # runtime/sentinel.py — feedback quarantined
+    "query_stuck",           # runtime/watchdog.py — RUNNING query flagged
+    "alert_fire",            # runtime/alerts.py — alert rule fired
+    "alert_resolve",         # runtime/alerts.py — alert rule resolved
 ))
 
 config.define("events_ring_size", 512, True,

@@ -750,6 +750,17 @@ class Executor:
                 }
             else:
                 self._fb_ctx = None
+            # sentinel coordinates on the query context (same anti-
+            # pollution placement as _fb_ctx: the OUTER query's
+            # assignment lands last, after nested sub-plan executions) —
+            # the terminal hook keys its latency baseline to the consult
+            # token and reaches the store for quarantine/readmit
+            ctx = lifecycle.current()
+            if ctx is not None and fb_fp is not None:
+                ctx.fb_fp = fb_fp
+                ctx.fb_token = (None if fb_entry is None
+                                else fb_entry["token"])
+                ctx.fb_store = self.cache.feedback
             out_chunk = self._run(plan, profile)
             fail_point("executor::fetch_results")
             lifecycle.checkpoint("executor::fetch_results")

@@ -45,6 +45,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 
 from .. import lockdep
 from .config import config
@@ -71,6 +72,10 @@ FEEDBACK_EST_ERRSUM = metrics.counter(
 FEEDBACK_EST_JOINS = metrics.counter(
     "sr_tpu_feedback_est_joins_total",
     "join cardinality observations behind sr_tpu_feedback_est_errsum")
+FEEDBACK_QUARANTINED = metrics.counter(
+    "sr_tpu_feedback_quarantined_total",
+    "plan-feedback consults refused because the fingerprint is "
+    "quarantined by the plan-regression sentinel")
 
 
 def _version_token(catalog, table: str) -> str:
@@ -110,10 +115,17 @@ class FeedbackStore:
     session has a TabletStore."""
 
     MAX_ENTRIES = 256
+    MAX_QUARANTINE = 64
 
     def __init__(self, path: str | None = None):
         self._lock = lockdep.lock("FeedbackStore._lock")
         self._entries: dict = {}  # guarded_by: _lock — fp -> entry dict
+        # fingerprints the plan-regression sentinel (runtime/sentinel.py)
+        # has pulled out of planning: fp -> {"baseline_ms", "ts"}. While
+        # quarantined, consult() answers None (estimate-driven planning)
+        # and record() refuses new observations; readmit() drops BOTH the
+        # quarantine mark and the poisoned entry so learning restarts.
+        self._quarantine: dict = {}  # guarded_by: _lock
         self._path = None  # guarded_by: _lock — sidecar path, set by attach()
         if path is not None:
             self.attach(path)
@@ -134,6 +146,9 @@ class FeedbackStore:
                     for fp, e in data.get("entries", {}).items():
                         if isinstance(e, dict) and "versions" in e:
                             self._entries[fp] = e
+                    for fp, q in data.get("quarantine", {}).items():
+                        if isinstance(q, dict) and "baseline_ms" in q:
+                            self._quarantine[fp] = q
             except (OSError, ValueError):
                 pass
 
@@ -143,7 +158,8 @@ class FeedbackStore:
         tmp = self._path + ".tmp"
         try:
             with open(tmp, "w") as f:
-                json.dump({"entries": self._entries}, f)
+                json.dump({"entries": self._entries,
+                           "quarantine": self._quarantine}, f)
             os.replace(tmp, self._path)
         except OSError:
             pass  # read-only root: keep learning in memory
@@ -159,7 +175,15 @@ class FeedbackStore:
         data that no longer exists."""
         fp = plan if isinstance(plan, str) else plan_fingerprint(plan)
         with self._lock:
-            e = self._entries.get(fp)
+            if fp in self._quarantine:
+                e = None
+                quarantined = True
+            else:
+                e = self._entries.get(fp)
+                quarantined = False
+        if quarantined:
+            FEEDBACK_QUARANTINED.inc()
+            return None
         if e is None:
             return None
         for t, v in e["versions"].items():
@@ -192,6 +216,11 @@ class FeedbackStore:
             except (KeyError, ValueError):
                 return  # table vanished mid-query; nothing durable to learn
         with self._lock:
+            if fp in self._quarantine:
+                # the sentinel pulled this fingerprint: refuse to keep
+                # learning on top of the poisoned entry — readmit() drops
+                # it and learning restarts from zero
+                return
             e = self._entries.get(fp)
             if e is None or e["versions"] != versions:
                 # first observation, or the data moved under the old entry:
@@ -264,9 +293,43 @@ class FeedbackStore:
                 FEEDBACK_INVALIDATED.inc(len(dead))
                 self._save_locked()
 
+    # --- quarantine (plan-regression sentinel, runtime/sentinel.py) ---------
+    def quarantine(self, fp: str, baseline_ms: float):
+        """Pull a fingerprint out of planning: consult() answers None (the
+        executor falls back to estimate-driven optimization) and record()
+        refuses observations until readmit(). baseline_ms is the pre-
+        regression latency the sentinel demands fresh runs beat before
+        re-admission."""
+        with self._lock:
+            self._quarantine.pop(fp, None)  # re-insert = LRU touch
+            self._quarantine[fp] = {"baseline_ms": float(baseline_ms),
+                                    "ts": time.time()}
+            while len(self._quarantine) > self.MAX_QUARANTINE:
+                del self._quarantine[next(iter(self._quarantine))]
+            self._save_locked()
+
+    def readmit(self, fp: str):
+        """Lift a quarantine AND drop the poisoned entry: the next
+        executions learn from scratch against the recovered baseline."""
+        with self._lock:
+            q = self._quarantine.pop(fp, None)
+            dropped = self._entries.pop(fp, None)
+            if q is not None or dropped is not None:
+                self._save_locked()
+
+    def is_quarantined(self, fp: str) -> bool:
+        with self._lock:
+            return fp in self._quarantine
+
+    def quarantined(self) -> dict:
+        """fp -> {"baseline_ms", "ts"} copies (diagnostic surfaces)."""
+        with self._lock:
+            return {fp: dict(q) for fp, q in self._quarantine.items()}
+
     def clear(self):
         with self._lock:
             self._entries.clear()
+            self._quarantine.clear()
             self._save_locked()
 
     def stats(self) -> dict:
@@ -275,5 +338,6 @@ class FeedbackStore:
                 "entries": len(self._entries),
                 "tokens": sum(e.get("token", 0)
                               for e in self._entries.values()),
+                "quarantined": len(self._quarantine),
                 "persistent": self._path is not None,
             }

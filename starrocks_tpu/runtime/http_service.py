@@ -44,9 +44,10 @@ def make_handler(session: Session, tier: ServingTier):
         def do_GET(self):
             import re
 
-            m = re.fullmatch(r"/api/query/(\d+)/(profile|trace)", self.path)
+            m = re.fullmatch(r"/api/query/(\d+)/(profile|trace|otel)",
+                             self.path)
             if m is not None:
-                from .profile import PROFILE_MANAGER, trace_json
+                from .profile import PROFILE_MANAGER, otel_json, trace_json
 
                 e = PROFILE_MANAGER.get(int(m.group(1)))
                 if e is None:
@@ -57,6 +58,10 @@ def make_handler(session: Session, tier: ServingTier):
                     # Chrome trace_event format — loads directly in
                     # Perfetto / chrome://tracing
                     self._send(200, json.dumps(trace_json(e)))
+                elif m.group(2) == "otel":
+                    # OTLP/JSON ResourceSpans — POSTable verbatim to any
+                    # OpenTelemetry collector's /v1/traces
+                    self._send(200, json.dumps(otel_json(e)))
                 else:
                     body = {k: e.get(k) for k in (
                         "query_id", "user", "sql", "state", "ms", "rows",
@@ -101,6 +106,18 @@ def make_handler(session: Session, tier: ServingTier):
 
                 self._send(200, json.dumps(
                     {"samples": HISTORY.snapshot()}, default=str))
+            elif self.path == "/api/workload":
+                from .workload import WORKLOAD
+
+                self._send(200, json.dumps(
+                    {"workload": WORKLOAD.snapshot(limit=500),
+                     "stats": WORKLOAD.stats()}, default=str))
+            elif self.path == "/api/alerts":
+                from .alerts import ALERTS
+
+                self._send(200, json.dumps(
+                    {"alerts": ALERTS.snapshot(),
+                     "stats": ALERTS.stats()}, default=str))
             elif self.path == "/api/debug/bundle":
                 from .audit import diagnostic_bundle
 
@@ -220,11 +237,13 @@ class SqlHttpServer:
 
     def start(self):
         from .metrics import HISTORY
+        from .watchdog import WATCHDOG
 
         # a serving surface is up: start the metrics-history sampler so
-        # /api/metrics/history has trajectory data (idempotent; gated by
-        # enable_metrics_history)
+        # /api/metrics/history has trajectory data, and the stuck-query
+        # watchdog (both idempotent; gated by their enable knobs)
         HISTORY.ensure_started()
+        WATCHDOG.ensure_started()
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True
         )

@@ -493,6 +493,15 @@ def _finalize_observability(ctx: QueryContext):
         # error, cancelled, timeout, memlimit, reaped-while-queued)
         # leaves exactly one audit record
         AUDIT.record_query(ctx)
+        from .workload import WORKLOAD
+
+        # the derived layer rides the same hook: workload shapes fold
+        # every terminal record, the sentinel weighs successful runs
+        # against their fingerprint's latency baseline
+        WORKLOAD.record_query(ctx)
+        from .sentinel import SENTINEL
+
+        SENTINEL.observe(ctx)
     except Exception:  # noqa: BLE001  # lint: swallow-ok — observability must never fail the unwind
         pass
 

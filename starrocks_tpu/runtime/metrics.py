@@ -255,10 +255,17 @@ class MetricsHistory:
                                    "p95": round(p95, 3),
                                    "p99": round(p99, 3), "count": n}
             self._prev = nxt
-            self._ring.append({"ts": ts, "counters": counters,
-                               "gauges": gauges, "histograms": hists})
+            sample = {"ts": ts, "counters": counters,
+                      "gauges": gauges, "histograms": hists}
+            self._ring.append(sample)
             while len(self._ring) > self._cap:
                 self._ring.popleft()
+        # the alert engine rides the sampler tick but runs AFTER the ring
+        # lock drops (it takes its own leaf lock and may emit events);
+        # evaluate() never raises
+        from .alerts import ALERTS
+
+        ALERTS.evaluate(sample, ts)
 
     def snapshot(self, limit: int | None = None) -> list:
         """Newest-last samples (shallow copies)."""

@@ -195,6 +195,81 @@ def trace_json(entry: dict) -> dict:
             "otherData": meta}
 
 
+def _otel_attr(key, value) -> dict:
+    if isinstance(value, bool):
+        return {"key": key, "value": {"boolValue": value}}
+    if isinstance(value, int):
+        return {"key": key, "value": {"intValue": str(value)}}
+    if isinstance(value, float):
+        return {"key": key, "value": {"doubleValue": value}}
+    return {"key": key, "value": {"stringValue": str(value)}}
+
+
+def otel_json(entry: dict) -> dict:
+    """One retained ProfileManager entry as an OpenTelemetry OTLP/JSON
+    ResourceSpans document (`GET /api/query/{id}/otel`): a root SERVER
+    span for the statement plus one INTERNAL child span per profile
+    phase — POSTable verbatim to a collector's /v1/traces. IDs are
+    deterministic functions of the query id (hex-encoded per the OTLP
+    JSON mapping; nano timestamps are decimal strings), so the export
+    is stable across calls and golden-fixture testable."""
+    import hashlib
+
+    qid = int(entry.get("query_id") or 0)
+    trace_id = hashlib.sha256(f"sr_tpu_query:{qid}".encode()
+                              ).hexdigest()[:32]
+    root_id = hashlib.sha256(f"sr_tpu_span:{qid}:root".encode()
+                             ).hexdigest()[:16]
+    evts = trace_json(entry)["traceEvents"]  # admission_wait included
+    if evts:
+        t0 = min(e["ts"] for e in evts)
+        t1 = max(e["ts"] + e["dur"] for e in evts)
+    else:
+        t0, t1 = 0, int(entry.get("ms") or 0) * 1000
+    state = str(entry.get("state") or "")
+    spans = [{
+        "traceId": trace_id, "spanId": root_id, "parentSpanId": "",
+        "name": "query", "kind": 2,  # SPAN_KIND_SERVER
+        "startTimeUnixNano": str(t0 * 1000),
+        "endTimeUnixNano": str(max(t1, t0 + 1) * 1000),
+        "attributes": [
+            _otel_attr("db.system", "starrocks_tpu"),
+            _otel_attr("db.statement", (entry.get("sql") or "")[:512]),
+            _otel_attr("db.user", entry.get("user") or ""),
+            _otel_attr("sr_tpu.query_id", qid),
+            _otel_attr("sr_tpu.state", state),
+            _otel_attr("sr_tpu.rows", int(entry.get("rows") or 0)),
+            _otel_attr("sr_tpu.queue_wait_ms",
+                       int(entry.get("queue_wait_ms") or 0)),
+            _otel_attr("sr_tpu.stage", entry.get("stage") or ""),
+        ],
+        "status": ({"code": 1} if state == "done"
+                   else {"code": 2, "message": state}),
+    }]
+    for i, e in enumerate(evts):
+        spans.append({
+            "traceId": trace_id,
+            "spanId": hashlib.sha256(
+                f"sr_tpu_span:{qid}:{i}".encode()).hexdigest()[:16],
+            "parentSpanId": root_id,
+            "name": e["name"], "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(e["ts"] * 1000),
+            "endTimeUnixNano": str((e["ts"] + e["dur"]) * 1000),
+            "attributes": [_otel_attr("sr_tpu.phase_path", e["cat"])],
+            "status": {"code": 0},  # UNSET: phases carry no verdict
+        })
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            _otel_attr("service.name", "starrocks_tpu"),
+            _otel_attr("telemetry.sdk.name", "starrocks_tpu.profile"),
+        ]},
+        "scopeSpans": [{
+            "scope": {"name": "starrocks_tpu.profile", "version": "1"},
+            "spans": spans,
+        }],
+    }]}
+
+
 # capacity-key family -> logical node class it may annotate
 _FAMILY_NODE = {"join": "LJoin", "agg": "LAggregate", "wtop": "LWindow",
                 "unnest": "LUnnest"}

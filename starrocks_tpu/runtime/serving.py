@@ -504,10 +504,13 @@ class ServingTier:
             else int(config.get("serve_pool_size"))
         self.pool = ExecutorPool(size, self.gate)
         from .metrics import HISTORY
+        from .watchdog import WATCHDOG
 
         # a serving surface exists: keep the metrics-history ring warm
-        # (idempotent; gated by enable_metrics_history)
+        # and the stuck-query watchdog scanning (both idempotent; gated
+        # by their enable knobs)
         HISTORY.ensure_started()
+        WATCHDOG.ensure_started()
 
     def new_session(self, user: str = "root") -> Session:
         """A per-connection session over the SHARED catalog/cache/store:

@@ -620,10 +620,21 @@ class Session:
             from .lifecycle import REGISTRY
 
             return REGISTRY.snapshot()
+        if isinstance(stmt, ast.ShowWorkload):
+            from .workload import WORKLOAD
+
+            # heaviest shapes first, tuple rows in the
+            # information_schema.workload_summary column order
+            return [tuple(r.values()) for r in WORKLOAD.snapshot()]
         if isinstance(stmt, ast.AdminSetFailpoint):
             from . import failpoint
 
             failpoint.set_from_sql(stmt.name, stmt.value)
+            return None
+        if isinstance(stmt, ast.AdminSetAlert):
+            from .alerts import ALERTS
+
+            ALERTS.set_from_sql(stmt.name, stmt.value)
             return None
         if isinstance(stmt, ast.AdminDiagnose):
             import json as _json
@@ -863,6 +874,7 @@ class Session:
                                ast.CreateResourceGroup,
                                ast.DropResourceGroup,
                                ast.AdminSetFailpoint,
+                               ast.AdminSetAlert,
                                ast.AdminDiagnose)):
             raise PermissionError(
                 f"user {user!r} lacks the admin privileges for DDL")

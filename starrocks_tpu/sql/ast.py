@@ -346,8 +346,23 @@ class ShowProcesslist:
 
 
 @dataclasses.dataclass(frozen=True)
+class ShowWorkload:
+    """SHOW WORKLOAD: the per-(fingerprint, class) rolling workload
+    shapes derived from the audit stream (runtime/workload.py)."""
+
+
+@dataclasses.dataclass(frozen=True)
 class AdminSetFailpoint:
     """ADMIN SET failpoint '<name>' = 'enable[:times=N]'|'disable'."""
+
+    name: str
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class AdminSetAlert:
+    """ADMIN SET alert '<name>' = '<json spec>'|'off'
+    (runtime/alerts.py rule management)."""
 
     name: str
     value: str

@@ -249,18 +249,22 @@ class Parser:
                 return ast.AdminDiagnose()
             self.expect_kw("set")
             word = self.expect_ident()
-            if word.lower() != "failpoint":
+            if word.lower() not in ("failpoint", "alert"):
                 raise ParseError(
                     f"unsupported ADMIN SET target {word!r} "
-                    "(only 'failpoint')")
+                    "(only 'failpoint' or 'alert')")
             t = self.next()
             if t.kind != "string":
-                raise ParseError("expected a quoted failpoint name")
+                raise ParseError(
+                    f"expected a quoted {word.lower()} name")
             self.expect_op("=")
             v = self.next()
             if v.kind != "string":
-                raise ParseError("expected a quoted failpoint action")
+                raise ParseError(
+                    f"expected a quoted {word.lower()} value")
             self.accept_op(";")
+            if word.lower() == "alert":
+                return ast.AdminSetAlert(t.value, v.value)
             return ast.AdminSetFailpoint(t.value, v.value)
         if self.accept_kw("show"):
             if (self.peek().kind == "ident"
@@ -268,6 +272,11 @@ class Parser:
                 self.next()
                 self.accept_op(";")
                 return ast.ShowProcesslist()
+            if (self.peek().kind == "ident"
+                    and self.peek().value.lower() == "workload"):
+                self.next()
+                self.accept_op(";")
+                return ast.ShowWorkload()
             if (self.peek().kind == "ident"
                     and self.peek().value.lower() == "grants"):
                 self.next()

@@ -739,6 +739,59 @@ class Catalog:
                 ("kind", T.VARCHAR, kd),
                 ("value", T.DOUBLE, vals),
             ])
+        if view == "workload_summary":
+            from ..runtime.workload import WORKLOAD
+
+            rows = WORKLOAD.snapshot()
+            return vtable([
+                ("fingerprint", T.VARCHAR,
+                 [e["fingerprint"] for e in rows]),
+                ("stmt_class", T.VARCHAR, [e["stmt_class"] for e in rows]),
+                ("count", T.BIGINT, [e["count"] for e in rows]),
+                ("p50_ms", T.DOUBLE, [e["p50_ms"] for e in rows]),
+                ("p95_ms", T.DOUBLE, [e["p95_ms"] for e in rows]),
+                ("p99_ms", T.DOUBLE, [e["p99_ms"] for e in rows]),
+                ("avg_ms", T.DOUBLE, [e["avg_ms"] for e in rows]),
+                ("avg_rows", T.DOUBLE, [e["avg_rows"] for e in rows]),
+                ("mem_peak_bytes", T.BIGINT,
+                 [e["mem_peak_bytes"] for e in rows]),
+                ("avg_queue_wait_ms", T.DOUBLE,
+                 [e["avg_queue_wait_ms"] for e in rows]),
+                ("errors", T.BIGINT, [e["errors"] for e in rows]),
+                ("cancelled", T.BIGINT, [e["cancelled"] for e in rows]),
+                ("timeouts", T.BIGINT, [e["timeouts"] for e in rows]),
+                ("memlimit", T.BIGINT, [e["memlimit"] for e in rows]),
+                ("degraded", T.BIGINT, [e["degraded"] for e in rows]),
+                ("last_ts", T.DOUBLE, [e["last_ts"] for e in rows]),
+                ("sample_sql", T.VARCHAR, [e["sample_sql"] for e in rows]),
+                ("plan_cache_hit_ratio", T.DOUBLE,
+                 [e["plan_cache_hit_ratio"] for e in rows]),
+                ("result_cache_hit_ratio", T.DOUBLE,
+                 [e["result_cache_hit_ratio"] for e in rows]),
+                ("partial_cache_hit_ratio", T.DOUBLE,
+                 [e["partial_cache_hit_ratio"] for e in rows]),
+                ("feedback_hit_ratio", T.DOUBLE,
+                 [e["feedback_hit_ratio"] for e in rows]),
+            ])
+        if view == "alerts":
+            from ..runtime.alerts import ALERTS
+
+            rows = ALERTS.snapshot()
+            return vtable([
+                ("name", T.VARCHAR, [e["name"] for e in rows]),
+                ("state", T.VARCHAR, [e["state"] for e in rows]),
+                ("metric", T.VARCHAR, [e["metric"] for e in rows]),
+                ("condition", T.VARCHAR, [e["condition"] for e in rows]),
+                ("for_s", T.DOUBLE, [e["for_s"] for e in rows]),
+                ("value", T.DOUBLE,
+                 [-1.0 if e["value"] is None else float(e["value"])
+                  for e in rows]),
+                ("fired_ts", T.DOUBLE,
+                 [0.0 if e["fired_ts"] is None else e["fired_ts"]
+                  for e in rows]),
+                ("fires", T.BIGINT, [e["fires"] for e in rows]),
+                ("help", T.VARCHAR, [e["help"] for e in rows]),
+            ])
         if view == "columns":
             tn, cn, ty, nu = [], [], [], []
             for n in sorted(self.tables):

@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+"""Randomized fault-schedule fuzzer over the failpoint registry (the
+NEXT 7d "randomized schedules" first cut).
+
+The curated chaos suite (tests/test_chaos.py) injects ONE fault per
+scenario at hand-picked sites; this tool fuzzes the schedule instead:
+every round arms a seeded-random subset of the statically-enumerated
+`fail_point(...)` sites (random `times` budgets, so faults land mid-
+workload, not just on the first hit) and drives a short mixed workload
+— DDL, DML, analytic reads, point lookups, KILL-adjacent shapes —
+accepting that statements may fail, while asserting the lifecycle
+contract that NOTHING may leak:
+
+  1. memory accountant process_bytes back to the baseline;
+  2. zero admission slots held, empty running-query registry;
+  3. the lock witness still acyclic (no ordering cycle latched);
+  4. exactly ONE audit record per driven statement (every exit path
+     unwinds through lifecycle._finalize_observability);
+  5. a clean probe query returns oracle-correct rows after each round.
+
+Determinism: `--seed` fixes the whole schedule (run_tier1.sh pins one);
+every failure prints the seed so any red run replays bit-identically.
+
+Usage: chaos_fuzz.py [--seed N] [--rounds N] [--sites-per-round N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast as pyast
+import os
+import random
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "starrocks_tpu")
+sys.path.insert(0, REPO)
+
+# sites whose faults are out-of-band for a single-process fuzz loop:
+# cluster heartbeats need a monitor/worker pair, and the serving-pool
+# sites need the ExecutorPool front door (this tool drives Session.sql)
+_SKIP_PREFIXES = ("heartbeat::", "serve::")
+
+
+def enumerate_sites() -> list:
+    """Every literal fail_point("<name>") call site in the package,
+    statically (same AST approach as src_lint.count_failpoints — the
+    registry keeps no site list by design)."""
+    sites = set()
+    for dirpath, _dirs, files in os.walk(PKG):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                try:
+                    tree = pyast.parse(f.read())
+                except SyntaxError:
+                    continue
+            for node in pyast.walk(tree):
+                if (isinstance(node, pyast.Call)
+                        and isinstance(node.func, pyast.Name)
+                        and node.func.id == "fail_point"
+                        and node.args
+                        and isinstance(node.args[0], pyast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    sites.add(node.args[0].value)
+    return sorted(s for s in sites
+                  if not s.startswith(_SKIP_PREFIXES))
+
+
+def _mixed_workload(rng: random.Random, round_no: int) -> list:
+    """A short statement mix over the fixture tables; literals vary by
+    round so plan/result caches see both hits and misses."""
+    k = rng.randint(1, 3)
+    stmts = [
+        f"insert into fz values ({round_no * 100 + 1}, {k}),"
+        f" ({round_no * 100 + 2}, {k + 1})",
+        "select b, sum(a) from fz group by b order by b",
+        f"select a, b from fz where a > {rng.randint(0, 50)} order by a",
+        "select f.b, count(*) from fz f join fzd d on f.b = d.k "
+        "group by f.b order by f.b",
+        f"select v from fzd where k = {rng.randint(0, 4)}",  # point lane
+        f"update fz set b = b + 1 where a = {round_no * 100 + 1}",
+        f"delete from fz where a = {round_no * 100 + 2}",
+        "show processlist",
+    ]
+    rng.shuffle(stmts)
+    return stmts[: rng.randint(4, len(stmts))]
+
+
+def run(seed: int, rounds: int, sites_per_round: int) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("SR_TPU_LOCK_WITNESS", "1")
+    from starrocks_tpu import lockdep
+    from starrocks_tpu.runtime import failpoint
+    from starrocks_tpu.runtime.audit import AUDIT
+    from starrocks_tpu.runtime.failpoint import FailPointError
+    from starrocks_tpu.runtime.lifecycle import (
+        ACCOUNTANT, REGISTRY, QueryAbortError,
+    )
+    from starrocks_tpu.runtime.session import Session
+
+    sites = enumerate_sites()
+    if not sites:
+        print("chaos_fuzz: no failpoint sites found", file=sys.stderr)
+        return 2
+    rng = random.Random(seed)
+    print(f"chaos_fuzz: seed={seed} rounds={rounds} "
+          f"sites={len(sites)} (<= {sites_per_round}/round)")
+
+    s = Session()
+    s.sql("create table fz (a int, b int)")
+    s.sql("create table fzd (k int, v int, primary key (k))")
+    s.sql("insert into fzd values (0, 10), (1, 11), (2, 12), "
+          "(3, 13), (4, 14)")
+
+    def leak_snapshot():
+        wm = getattr(s.catalog, "workgroups", None)
+        return {
+            "process_bytes": ACCOUNTANT.snapshot()["process_bytes"],
+            "slots": sum(wm.running.values()) if wm is not None else 0,
+            "registry": len(REGISTRY.snapshot()),
+        }
+
+    def fail(msg: str):
+        print(f"chaos_fuzz: FAIL (replay with --seed {seed}): {msg}",
+              file=sys.stderr)
+        return 1
+
+    baseline = leak_snapshot()
+    driven = faults = 0
+    for r in range(rounds):
+        armed = rng.sample(sites, k=min(sites_per_round, len(sites)))
+        schedule = [(site, rng.randint(1, 2)) for site in armed]
+        for site, times in schedule:
+            failpoint.arm(site, times=times)
+        stmts = _mixed_workload(rng, r)
+        try:
+            for stmt in stmts:
+                driven += 1
+                try:
+                    s.sql(stmt)
+                except (FailPointError, QueryAbortError):
+                    faults += 1
+                except Exception as e:  # noqa: BLE001 — a fault mid-DDL
+                    # may surface as a wrapped engine error; what matters
+                    # is the leak/witness/audit contract below
+                    faults += 1
+                    del e
+        finally:
+            for site, _times in schedule:
+                failpoint.disarm(site)
+        leaks = leak_snapshot()
+        if leaks != baseline:
+            return fail(f"round {r} schedule={schedule}: leaked state "
+                        f"{leaks} != baseline {baseline}")
+        cycles = lockdep.WITNESS.order_cycles()
+        if cycles:
+            return fail(f"round {r} schedule={schedule}: lock witness "
+                        f"cycle {lockdep.WITNESS.render(cycles)}")
+        try:
+            got = s.sql("select count(*) from fzd").rows()
+        except Exception as e:  # noqa: BLE001
+            return fail(f"round {r}: clean probe failed after disarm: "
+                        f"{type(e).__name__}: {e}")
+        if got != [(5,)]:
+            return fail(f"round {r}: probe returned {got}, expected "
+                        "[(5,)] — fault corrupted committed data")
+        driven += 1  # the probe statement audits too
+    AUDIT.flush()
+    registered = AUDIT.stats()["registered"]
+    expected = driven + 3  # + the three fixture statements
+    if registered != expected:
+        return fail(f"audit records {registered} != statements driven "
+                    f"{expected} (every exit path must audit once)")
+    print(f"chaos_fuzz: OK — {rounds} rounds, {driven} statements, "
+          f"{faults} injected faults, audit={registered}, zero leaks, "
+          "witness acyclic")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int,
+                    default=int.from_bytes(os.urandom(4), "big"))
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument("--sites-per-round", type=int, default=3)
+    a = ap.parse_args()
+    return run(a.seed, a.rounds, a.sites_per_round)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,8 +17,11 @@ Also scrapes the observability-plane JSON endpoints against their
 schemas on the same live server: /api/audit (one record per driven
 statement, terminal fields present), /api/events (list + per-type
 counts over the closed taxonomy), /api/metrics/history (sampler ring
-populated, samples carry counters/gauges/histograms), and
-/api/debug/bundle (the ADMIN DIAGNOSE document, all sections present).
+populated, samples carry counters/gauges/histograms), /api/workload
+(per-fingerprint rolling stats aggregated the warm repeat), /api/alerts
+(default rule set installed, states on the ok/firing enum), and
+/api/debug/bundle (the ADMIN DIAGNOSE document, all sections present —
+including the round-19 workload/alerts sections).
 
 Exit 1 with a finding list on any violation, 0 otherwise.
 """
@@ -60,7 +63,11 @@ AUDIT_FIELDS = ("query_id", "user", "stmt", "stmt_class", "tables",
                 "state", "stage", "ms", "rows", "mem_peak_bytes")
 BUNDLE_SECTIONS = ("running", "memory", "profiles", "audit_tail",
                    "events_tail", "event_counts", "metrics_history",
-                   "lock_witness", "failpoints", "config_non_default")
+                   "lock_witness", "failpoints", "config_non_default",
+                   "workload", "alerts")
+WORKLOAD_FIELDS = ("fingerprint", "stmt_class", "count", "p50_ms",
+                   "p95_ms", "p99_ms", "avg_ms", "errors", "sample_sql")
+ALERT_FIELDS = ("name", "state", "metric", "condition", "for_s", "fires")
 
 
 def validate_observability(port: int, n_statements: int) -> list[str]:
@@ -109,6 +116,33 @@ def validate_observability(port: int, n_statements: int) -> list[str]:
             if key not in s:
                 findings.append(f"/api/metrics/history sample missing "
                                 f"{key!r}")
+
+    wl = scrape_json(port, "/api/workload")
+    entries = wl.get("workload")
+    if not isinstance(entries, list) or not entries:
+        findings.append("/api/workload has no entries after live queries")
+    else:
+        missing = [f for f in WORKLOAD_FIELDS if f not in entries[0]]
+        if missing:
+            findings.append(f"/api/workload entry missing fields {missing}")
+        # the warm repeat in STATEMENTS lands twice on one fingerprint
+        if not any(e.get("count", 0) >= 2 for e in entries):
+            findings.append("/api/workload never aggregated a repeated "
+                            "statement shape (fingerprinting dead?)")
+
+    al = scrape_json(port, "/api/alerts")
+    rules = al.get("alerts")
+    if not isinstance(rules, list) or not rules:
+        findings.append("/api/alerts exposes no rules (default rule set "
+                        "not installed?)")
+    else:
+        missing = [f for f in ALERT_FIELDS if f not in rules[0]]
+        if missing:
+            findings.append(f"/api/alerts rule missing fields {missing}")
+        bad = [r.get("name") for r in rules
+               if r.get("state") not in ("ok", "firing")]
+        if bad:
+            findings.append(f"/api/alerts rules with off-enum state: {bad}")
 
     bundle = scrape_json(port, "/api/debug/bundle")
     missing = [s for s in BUNDLE_SECTIONS if s not in bundle]

@@ -29,5 +29,17 @@ echo "== chaos suite (failpoint/KILL/timeout/mem-limit scenarios) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
   -q -m chaos -p no:cacheprovider || exit 1
 
+# Opt-in randomized fault-schedule fuzz (NEXT 7d first cut): set
+# SR_TPU_CHAOS_FUZZ=1 to run with the pinned seed below; set it to any
+# other integer to fuzz that seed instead. Failures print the seed, so
+# a red run replays bit-identically via tools/chaos_fuzz.py --seed N.
+if [ -n "${SR_TPU_CHAOS_FUZZ:-}" ]; then
+  seed=20260805
+  [ "$SR_TPU_CHAOS_FUZZ" != "1" ] && seed="$SR_TPU_CHAOS_FUZZ"
+  echo "== chaos_fuzz (randomized fault schedules, seed=$seed) =="
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/chaos_fuzz.py \
+    --seed "$seed" --rounds 8 || exit 1
+fi
+
 echo "== tier-1 pytest =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

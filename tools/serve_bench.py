@@ -469,26 +469,35 @@ def run_feedback_phase(cat, statements) -> dict:
 
 
 def run_obs_phase(iters: int = 240, nrows: int = 8000) -> dict:
-    """Observability-plane overhead A/B: audit log + metrics-history
-    sampler ON (the shipped defaults) vs OFF, over the two latencies the
-    plane must NOT tax — the warm in-proc fast path (result-cache inline
-    answer) and the point lane (planner-free PK lookup). The event
-    journal has no off switch, but none of its ten sites fire on either
-    lane, so audit+sampler IS the per-statement delta. Arms alternate in
-    interleaved rounds so host drift cancels out of the comparison;
-    acceptance is <5% p50 regression on both lanes (obs work rides the
-    unwind hook and a background thread, never the answer path)."""
+    """Observability-plane overhead A/B: the WHOLE derived plane ON (the
+    shipped defaults — audit log, metrics-history sampler + alert rules,
+    workload aggregator, plan sentinel, stuck-query watchdog) vs OFF,
+    over the two latencies the plane must NOT tax — the warm in-proc
+    fast path (result-cache inline answer) and the point lane
+    (planner-free PK lookup). The event journal has no off switch, but
+    none of its sites fire on either lane, so the toggled set IS the
+    per-statement delta. Arms alternate in interleaved rounds so host
+    drift cancels out of the comparison; acceptance is <5% p50
+    regression on both lanes (obs work rides the unwind hook and
+    background threads, never the answer path)."""
     import shutil
     import tempfile
 
     from starrocks_tpu.runtime import audit  # noqa: F401 — knob define
+    from starrocks_tpu.runtime.alerts import ALERTS
     from starrocks_tpu.runtime.config import config
     from starrocks_tpu.runtime.metrics import HISTORY
+    from starrocks_tpu.runtime.sentinel import SENTINEL
     from starrocks_tpu.runtime.session import Session
+    from starrocks_tpu.runtime.watchdog import WATCHDOG
+    from starrocks_tpu.runtime.workload import WORKLOAD
 
     d = tempfile.mkdtemp(prefix="sr_obsbench_")
-    prev_audit = config.get("enable_audit_log")
-    prev_hist = config.get("enable_metrics_history")
+    # every knob the A/B toggles (the round-19 derived plane included)
+    _ARM_KNOBS = ("enable_audit_log", "enable_metrics_history",
+                  "enable_alerts", "enable_workload_stats",
+                  "enable_plan_sentinel", "enable_watchdog")
+    prev = {k: config.get(k) for k in _ARM_KNOBS}
     prev_qc = config.get("enable_query_cache")
     out: dict = {}
     try:
@@ -510,12 +519,14 @@ def run_obs_phase(iters: int = 240, nrows: int = 8000) -> dict:
             s.sql(f"select v, n from obs_kv where k = {rng.randrange(nrows)}")
 
         def set_arm(on: bool):
-            config.set("enable_audit_log", on)
-            config.set("enable_metrics_history", on)
+            for k in _ARM_KNOBS:
+                config.set(k, on)
             if on:
                 HISTORY.ensure_started()
+                WATCHDOG.ensure_started()
             else:
                 HISTORY.stop()
+                WATCHDOG.stop()
 
         for _ in range(20):  # shared warmup: pay compiles, prime caches
             one_warm()
@@ -550,9 +561,21 @@ def run_obs_phase(iters: int = 240, nrows: int = 8000) -> dict:
         out["obs_warm_regress_pct"] = round(warm_reg * 100, 1)
         out["obs_point_regress_pct"] = round(point_reg * 100, 1)
         out["obs_pass"] = bool(warm_reg < 0.05 and point_reg < 0.05)
+        # derived-plane bookkeeping after the sustained run: the summary
+        # JSON records that the new state stayed hard-bounded while every
+        # statement of the bench flowed through it
+        wst = WORKLOAD.stats()
+        ast_ = ALERTS.stats()
+        out["workload_entries"] = wst["entries"]
+        out["workload_registered"] = wst["registered"]
+        out["workload_evicted"] = wst["evicted"]
+        out["alert_rules"] = ast_["rules"]
+        out["alert_firing"] = ast_["firing"]
+        out["alert_fires"] = ast_["fires"]
+        out["sentinel_entries"] = SENTINEL.stats()["entries"]
     finally:
-        config.set("enable_audit_log", prev_audit)
-        config.set("enable_metrics_history", prev_hist)
+        for k, v in prev.items():
+            config.set(k, v)
         config.set("enable_query_cache", prev_qc)
         shutil.rmtree(d, ignore_errors=True)
     return out
