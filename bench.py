@@ -387,6 +387,20 @@ def _concur_findings() -> int:
         return -1
 
 
+def _effects_findings() -> int:
+    """Warn-level count from the interprocedural effect analyzer
+    (analysis/effects_check.py) — suppression annotations missing a
+    reason. Tracked next to `concur_findings` so the reviewed-exception
+    census only moves one way. -1 = analyzer crashed."""
+    try:
+        from starrocks_tpu.analysis import effects_check
+
+        rep = effects_check.check_package()
+        return sum(1 for f in rep.findings if f.severity == "warn")
+    except Exception:  # noqa: BLE001 — a lint bug must not kill the bench
+        return -1
+
+
 def run_suite(sf: float, repeats: int, probe_failed: bool = False,
               only=(), skip=(), qrepeat: int = 0):
     """All BASELINE.json config families.  Headline JSON line prints right
@@ -729,6 +743,7 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
         "join_multiway_hits": join_totals.get("join_multiway_hits", 0),
         "verify_findings": _sr_analysis.findings_total(),
         "concur_findings": _concur_findings(),
+        "effects_findings": _effects_findings(),
         "qcancelled": chaos["qcancelled"],
         "qtimeout": chaos["qtimeout"],
         **_latency_percentiles(),

@@ -88,22 +88,37 @@ class Witness:
                     f"self-deadlock: thread {get_ident()} re-acquiring "
                     f"non-reentrant lock {lock.name!r} it already holds")
         for h, held_site in held:
-            a, b = h.name, lock.name
-            if a == b:
-                continue  # same lock class: reentrancy / sibling instance
-            key = (a, b)
-            with self._mu:
-                info = self._edges.get(key)
-                if info is not None:
-                    info["count"] += 1
-                    continue
-                self._edges[key] = {
-                    "count": 1,
-                    "thread": get_ident(),
-                    "held_at": held_site,
-                    "acquire_stack": "".join(
-                        traceback.format_stack(limit=16)[:-2]),
-                }
+            self._edge(h.name, lock.name, held_site)
+
+    def _edge(self, a: str, b: str, held_site: str):
+        """Record one a -> b order edge (a's holder waited on b)."""
+        if a == b:
+            return  # same lock class: reentrancy / sibling instance
+        key = (a, b)
+        with self._mu:
+            info = self._edges.get(key)
+            if info is not None:
+                info["count"] += 1
+                return
+            self._edges[key] = {
+                "count": 1,
+                "thread": get_ident(),
+                "held_at": held_site,
+                "acquire_stack": "".join(
+                    traceback.format_stack(limit=16)[:-2]),
+            }
+
+    def on_event_set(self, event):
+        """Called when a witnessed Event fires while this thread holds
+        locks: record event -> held edges ("this event only fires after
+        these locks are taken") — the REVERSE direction of the held ->
+        event edges `before_block` records at wait sites. Together they
+        close the classic handoff deadlock into a visible cycle: thread
+        1 parks on E holding A (edge A -> E), thread 2 can only reach
+        its `E.set()` under A (edge E -> A) — neither run has to hang
+        for `order_cycles()` to report A -> E -> A."""
+        for h, held_site in self._held():
+            self._edge(event.name, h.name, held_site)
 
     def push(self, lock):
         self._held().append((lock, _site()))
@@ -313,6 +328,39 @@ class DebugRLock:
         self._witness.push(self)
 
 
+class DebugEvent:
+    """threading.Event wrapper that feeds the witness: `wait` records
+    held -> event edges via `before_block`, `set` records the reverse
+    event -> held edges via `on_event_set`. Reentrant and never pushed
+    onto the held stack — any number of threads may park on one event,
+    and holding it is not a concept. Covers the serving pool's
+    `_Work.done` handoff (NEXT: Event-based handoffs were the one
+    synchronization primitive the witness couldn't see)."""
+
+    reentrant = True
+
+    __slots__ = ("name", "_witness", "_ev")
+
+    def __init__(self, name: str, witness: Witness):
+        self.name = name
+        self._witness = witness
+        self._ev = threading.Event()
+
+    def wait(self, timeout=None):
+        self._witness.before_block(self)
+        return self._ev.wait(timeout)
+
+    def set(self):
+        self._witness.on_event_set(self)
+        self._ev.set()
+
+    def clear(self):
+        self._ev.clear()
+
+    def is_set(self):
+        return self._ev.is_set()
+
+
 # --- factories ----------------------------------------------------------------
 
 WITNESS = Witness()
@@ -349,6 +397,15 @@ def rlock(name: str, witness: Witness | None = None):
     if not _enabled:
         return threading.RLock()
     return DebugRLock(name, witness or WITNESS)
+
+
+def event(name: str, witness: Witness | None = None):
+    """An Event whose wait/set sites join the lock-order graph (the
+    serving pool's worker -> connection-thread handoff). Plain
+    threading.Event when the witness is off."""
+    if not _enabled:
+        return threading.Event()
+    return DebugEvent(name, witness or WITNESS)
 
 
 def condition(name: str, witness: Witness | None = None):

@@ -172,7 +172,7 @@ class AuditLog:
         ) + tuple(int(bool(counters.get(c, (0, ""))[0]))
                   for c, _col in _HIT_COUNTERS)
 
-    def _sink_locked(self, path, rotate_bytes, rec):  # lint: holds _lock
+    def _sink_locked(self, path, rotate_bytes, rec):  # lint: holds _lock  # lint: blocking-ok — the JSONL append is the audit durability contract: the sink must serialize with ring rotation, and writes are one bounded line
         line = json.dumps(dict(zip(_FIELDS, rec)), default=str) + "\n"
         try:
             if os.path.getsize(path) + len(line) > rotate_bytes:

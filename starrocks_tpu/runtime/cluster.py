@@ -228,11 +228,16 @@ class Heartbeater:
             fail_point("heartbeat::send")
             conn = http.client.HTTPConnection(
                 self.host, self.port, timeout=2)
-            conn.request("POST", "/heartbeat",
-                         json.dumps({"id": self.worker_id}),
-                         {"Content-Type": "application/json"})
-            conn.getresponse().read()
-            conn.close()
+            try:
+                conn.request("POST", "/heartbeat",
+                             json.dumps({"id": self.worker_id}),
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+            finally:
+                # an OSError from request/getresponse must not leak the
+                # socket — before this finally, every failed beat left
+                # one behind (effects_check contract 1 caught it)
+                conn.close()
             return True
         except (OSError, FailPointError):
             return False  # coordinator away (or injected fault): back off

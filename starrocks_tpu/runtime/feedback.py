@@ -140,7 +140,7 @@ class FeedbackStore:
                 return
             self._path = path
             try:
-                with open(path) as f:
+                with open(path) as f:  # lint: blocking-ok — one-shot startup load; attach must see a consistent store vs concurrent record()
                     data = json.load(f)
                 if isinstance(data, dict):
                     for fp, e in data.get("entries", {}).items():
@@ -152,7 +152,7 @@ class FeedbackStore:
             except (OSError, ValueError):
                 pass
 
-    def _save_locked(self):  # lint: holds _lock
+    def _save_locked(self):  # lint: holds _lock  # lint: blocking-ok — sidecar persistence must serialize with entry mutation; the tmp+replace write is bounded by the entry cap and tolerates OSError
         if self._path is None:
             return
         tmp = self._path + ".tmp"

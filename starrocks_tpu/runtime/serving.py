@@ -349,8 +349,11 @@ class _Work:
     prio: float
     seq: int
     t0: float
+    # witnessed handoff: under SR_TPU_LOCK_WITNESS the worker's set()
+    # and the connection thread's wait() join the lock-order graph
+    # (plain threading.Event otherwise)
     done: threading.Event = dataclasses.field(
-        default_factory=threading.Event)
+        default_factory=lambda: lockdep.event("serving._Work.done"))
     result: object = None
     error: BaseException | None = None
     # lifecycle context registered at ENQUEUE (stage serve::queued) so
@@ -433,6 +436,7 @@ class ExecutorPool:
         """Blocking pop of the highest effective-priority statement (the
         pool-level priority lane; same aging knob as admission)."""
         with self._lock:
+            # lint: checkpoint-exempt — worker idle loop, not query context; shutdown unblocks via notify_all and each adopted statement checkpoints inside its own query_scope
             while True:
                 if self._shutdown:
                     return None
@@ -542,15 +546,35 @@ class ServingTier:
                              prio)
         from . import lifecycle
 
-        # the wait doubles as the queued-kill reaper: if a KILL lands
+        # the wait doubles as the queued-kill AND queued-deadline reaper:
+        # if a KILL lands — or the statement's own deadline passes —
         # while the work still sits in the pool queue, pull it out and
-        # unwind here — the victim must not wait for a worker to free up
-        # just to die (NEXT 7f)
+        # unwind here. The victim must not wait for a worker to free up
+        # just to die (NEXT 7f), and a deadline-expired statement must
+        # not consume a worker slot just to time out at its first
+        # checkpoint. This poll IS the cancellation enforcement for the
+        # serve::queued stage, so the loop itself is checkpoint-free by
+        # design. # lint: checkpoint-exempt — this wait IS the reaper: it polls kill+deadline every 50ms and unwinds via finalize_queued
         while not w.done.wait(0.05):
             ctx = w.ctx
-            if (ctx is not None and ctx.cancelled()
-                    and self.pool.abandon(w)):
+            if ctx is None:
+                continue
+            timed_out = (not ctx.cancelled() and ctx.deadline is not None
+                         and time.monotonic() > ctx.deadline)
+            if (ctx.cancelled() or timed_out) and self.pool.abandon(w):
+                if timed_out:
+                    # abandon succeeded: no worker will ever adopt this
+                    # work, so route the timeout through the normal kill
+                    # machinery and finalize_queued records the reason.
+                    # (If a worker had adopted it, its own checkpoint
+                    # raises the natural QueryTimeoutError instead.)
+                    ctx.cancel(f"query_timeout_s={ctx.timeout_s:g} "
+                               f"exceeded while queued")
                 lifecycle.finalize_queued(ctx)
+                if timed_out:
+                    raise lifecycle.QueryTimeoutError(
+                        f"query {ctx.qid} exceeded query_timeout_s="
+                        f"{ctx.timeout_s:g} at stage 'serve::queued'")
                 raise lifecycle.QueryCancelledError(
                     f"query {ctx.qid} cancelled at stage 'serve::queued': "
                     f"{ctx.cancel_reason()}")
@@ -584,9 +608,10 @@ class ServingTier:
                 or shape.table in self.catalog.mv_defs):
             return _FAST_MISS
         tabs = frozenset((shape.table,))
+        t0 = time.perf_counter()  # before the claim: nothing may raise
+        #                           between acquire and the try-finally
         if not self.gate.try_shared(tabs):
             return _FAST_MISS  # DML active/queued on this table: pool path
-        t0 = time.perf_counter()
         try:
             SERVE_POINT_INLINE.inc()
             SERVE_STATEMENTS.inc()
@@ -616,9 +641,10 @@ class ServingTier:
             return _FAST_MISS  # plan shapes simply take the pool path
         if not self.cache.qcache.has_result(skey, self.catalog):
             return _FAST_MISS
+        t0 = time.perf_counter()  # before the claim: nothing may raise
+        #                           between acquire and the try-finally
         if not self.gate.try_shared():
             return _FAST_MISS  # a mutation is active/queued: pool path
-        t0 = time.perf_counter()
         try:
             SERVE_FAST_PATH.inc()
             SERVE_STATEMENTS.inc()

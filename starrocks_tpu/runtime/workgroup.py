@@ -377,12 +377,15 @@ class WorkgroupManager:
         context so a KILL unwinding the scope releases it too — release is
         idempotent, so double-calling is safe."""
         release = self.admit(group_name, est_scan_rows, est_scan_bytes)
-        from . import lifecycle
-
-        ctx = lifecycle.current()
-        if ctx is not None:
-            ctx.on_exit(release)
         try:
+            # context registration sits INSIDE the try: a raise from the
+            # lifecycle import or the cleanup-stack append must release
+            # the slot too, not leak it (effects_check contract 1)
+            from . import lifecycle
+
+            ctx = lifecycle.current()
+            if ctx is not None:
+                ctx.on_exit(release)
             yield release
         finally:
             release()

@@ -156,7 +156,7 @@ class TabletStore:
     # checkpoint() snapshots catalog-level metadata into image.json and
     # truncates the log to the ops after the image, so startup replays
     # image + tail instead of the whole history.
-    def _scan_seq(self) -> int:  # lint: holds _journal_lock
+    def _scan_seq(self) -> int:  # lint: holds _journal_lock  # lint: blocking-ok — the lazy seq scan reads image+log and must serialize vs writers: a log append racing the scan would mint a duplicate seq
         img = self.read_image()
         base = img["seq"] if img else 0
         seq = base
@@ -168,13 +168,13 @@ class TabletStore:
         self.tail_count = n_tail
         return seq
 
-    def ensure_seq(self):
+    def ensure_seq(self):  # lint: blocking-ok — startup-path journal scan under the journal lock: same serialization contract as _scan_seq
         """Force the lazy journal scan (startup paths want tail_count)."""
         with self._journal_lock:
             if self._next_seq is None:
                 self._next_seq = self._scan_seq()
 
-    def log(self, op: dict) -> int:
+    def log(self, op: dict) -> int:  # lint: blocking-ok — the edit-log append IS the serialization point: writing outside the journal lock could tear op order against checkpoint truncation
         with self._journal_lock:
             # injected failures here must release the journal lock (the
             # with-block guarantees it) and leave the log un-torn: nothing
@@ -213,7 +213,7 @@ class TabletStore:
         except (OSError, json.JSONDecodeError):
             return None  # torn image: fall back to full log replay
 
-    def checkpoint(self, catalog_image: dict) -> int:
+    def checkpoint(self, catalog_image: dict) -> int:  # lint: blocking-ok — image write + fsync + log truncation must be atomic vs concurrent log(): holding the journal lock across the IO is the durability contract
         """Write the catalog image at the current journal position and
         truncate the log. Image first (fsync'd tmp + atomic replace: the
         truncation destroys the image's redundant copy, so the image must
@@ -1324,6 +1324,7 @@ def backup(store: TabletStore, dest_dir: str, max_retries: int = 3) -> int:
     if os.path.exists(store.log_path):
         shutil.copy2(store.log_path, os.path.join(dest_dir, "edit_log.jsonl"))
     n = 0
+    # lint: checkpoint-exempt — offline admin utility (no in-package callers run it on an engine thread); there is no QueryContext to observe
     for t in store.table_names():
         src = store._tdir(t)
         dst = os.path.join(dest_dir, t)

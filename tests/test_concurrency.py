@@ -112,6 +112,64 @@ def test_one_way_nesting_no_cycle():
     assert ("T.outer", "T.inner") in w.edges()
 
 
+def test_event_handoff_inversion_reports_cycle():
+    """Seeded Event-handoff deadlock shape (the NEXT "witness coverage
+    for threading.Event-based handoffs" item): thread 1 parks on the
+    event while holding A — edge A -> E via before_block — and thread 2
+    fires the event under A — edge E -> A via on_event_set. Neither run
+    hangs (the wait has a timeout and the ordering is seeded), but the
+    two edges close the waiter-holds-lock-the-setter-needs cycle."""
+    w = lockdep.Witness()
+    a = lockdep.DebugLock("T.A", w)
+    done = lockdep.DebugEvent("T.done", w)
+    waited = threading.Event()
+
+    def waiter():
+        with a:
+            done.wait(0.05)   # parks holding A: records A -> T.done
+        waited.set()
+
+    def setter():
+        waited.wait(5)        # seeded order: the wait edge lands first
+        with a:
+            done.set()        # fires under A: records T.done -> A
+
+    th1 = threading.Thread(target=waiter)
+    th2 = threading.Thread(target=setter)
+    th1.start()
+    th2.start()
+    th1.join(5)
+    th2.join(5)
+    assert ["T.A", "T.done"] in w.order_cycles()
+    edges = w.edges()
+    assert ("T.A", "T.done") in edges and ("T.done", "T.A") in edges
+
+
+def test_event_handoff_correct_order_no_cycle():
+    """The serving-pool shape: the worker sets the done event holding no
+    lock, the connection thread waits holding no lock — no edges at all,
+    let alone a cycle."""
+    w = lockdep.Witness()
+    a = lockdep.DebugLock("T.A", w)
+    done = lockdep.DebugEvent("T.done", w)
+    with a:
+        pass           # the lock is used, but never across the handoff
+    done.set()
+    assert done.wait(1)
+    assert w.edges() == {} and w.order_cycles() == []
+
+
+def test_event_factory_obeys_witness_toggle():
+    assert isinstance(lockdep.event("t_ev"), lockdep.DebugEvent)
+    lockdep.disable()
+    try:
+        ev = lockdep.event("t_ev_plain")
+        assert not isinstance(ev, lockdep.DebugEvent)
+        assert type(ev).__name__ == "Event"
+    finally:
+        lockdep.enable()
+
+
 def test_self_deadlock_raises_instead_of_hanging():
     w = lockdep.Witness()
     mu = lockdep.DebugLock("T.mu", w)

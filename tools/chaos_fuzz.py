@@ -42,11 +42,12 @@ sys.path.insert(0, REPO)
 _SKIP_PREFIXES = ("heartbeat::", "serve::")
 
 
-def enumerate_sites() -> list:
-    """Every literal fail_point("<name>") call site in the package,
+def _scan_failpoints():
+    """(site names, rel paths of modules containing at least one site):
+    every literal fail_point("<name>") call site in the package,
     statically (same AST approach as src_lint.count_failpoints — the
     registry keeps no site list by design)."""
-    sites = set()
+    names, mods = set(), set()
     for dirpath, _dirs, files in os.walk(PKG):
         for fn in sorted(files):
             if not fn.endswith(".py"):
@@ -57,6 +58,7 @@ def enumerate_sites() -> list:
                     tree = pyast.parse(f.read())
                 except SyntaxError:
                     continue
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
             for node in pyast.walk(tree):
                 if (isinstance(node, pyast.Call)
                         and isinstance(node.func, pyast.Name)
@@ -64,9 +66,50 @@ def enumerate_sites() -> list:
                         and node.args
                         and isinstance(node.args[0], pyast.Constant)
                         and isinstance(node.args[0].value, str)):
-                    sites.add(node.args[0].value)
-    return sorted(s for s in sites
+                    names.add(node.args[0].value)
+                    mods.add(rel)
+    return names, mods
+
+
+def enumerate_sites() -> list:
+    names, _mods = _scan_failpoints()
+    return sorted(s for s in names
                   if not s.startswith(_SKIP_PREFIXES))
+
+
+def coverage_cross_check() -> int:
+    """Warn-only ratchet against analysis/effects_check.py: every acquire
+    site the effect analyzer discovers statically should sit in a module
+    with at least one failpoint — an acquire in a failpoint-free module
+    has NO fuzz-injectable unwind path, so this tool can never probe
+    whether a fault there leaks it (only the static contract covers it).
+    Prints each uncovered (acquire site, kind) pair; returns the count.
+    The pinned-seed run stays green regardless."""
+    import importlib.util
+
+    def load(name, rel):
+        mod = sys.modules.get(name)
+        if mod is None:
+            spec = importlib.util.spec_from_file_location(
+                name, os.path.join(REPO, rel))
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[name] = mod
+            spec.loader.exec_module(mod)
+        return mod
+
+    astwalk = load("sr_astwalk", "starrocks_tpu/analysis/astwalk.py")
+    effects_check = load("sr_effects_check",
+                         "starrocks_tpu/analysis/effects_check.py")
+    acquires = effects_check.acquire_sites(astwalk.package_sources(REPO))
+    _names, fp_mods = _scan_failpoints()
+    uncovered = [s for s in acquires if s.rel not in fp_mods]
+    for s in uncovered:
+        print(f"chaos_fuzz: uncovered acquire {s.rel}:{s.line} "
+              f"({s.kind} in {s.func}) — module has no failpoint, so no "
+              f"fuzzable unwind path reaches this acquire")
+    print(f"chaos_fuzz: acquire coverage {len(acquires) - len(uncovered)}"
+          f"/{len(acquires)} sites in failpoint-covered modules")
+    return len(uncovered)
 
 
 def _mixed_workload(rng: random.Random, round_no: int) -> list:
@@ -105,6 +148,7 @@ def run(seed: int, rounds: int, sites_per_round: int) -> int:
     if not sites:
         print("chaos_fuzz: no failpoint sites found", file=sys.stderr)
         return 2
+    coverage_cross_check()  # warn-only: uncovered acquires print above
     rng = random.Random(seed)
     print(f"chaos_fuzz: seed={seed} rounds={rounds} "
           f"sites={len(sites)} (<= {sites_per_round}/round)")

@@ -10,10 +10,12 @@ cd "$(dirname "$0")/.." || exit 1
 echo "== src_lint =="
 python tools/src_lint.py || exit 1
 
-echo "== concur_lint (lock order + guarded-by + module boundaries) =="
+echo "== concur_lint (lock order + guarded-by + effects + module boundaries) =="
 # --strict-warn: the round-11 coverage ratchet is LOCKED (round 12 burned
 # the last TabletStore warnings down to zero) — any new unannotated
-# mutable attr on a lock-owning class fails the gate
+# mutable attr on a lock-owning class fails the gate. The effects pass
+# (acquire safety / checkpoint density / no-blocking-under-lock / thread
+# lifecycle) rides the same flag: a suppression without a reason fails.
 python tools/concur_lint.py --strict-warn || exit 1
 
 echo "== plan_lint --corpus =="
