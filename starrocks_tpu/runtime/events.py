@@ -48,6 +48,9 @@ TAXONOMY = frozenset((
     "query_stuck",           # runtime/watchdog.py — RUNNING query flagged
     "alert_fire",            # runtime/alerts.py — alert rule fired
     "alert_resolve",         # runtime/alerts.py — alert rule resolved
+    "ingest_commit",         # ingest/plane.py — micro-batch made visible
+    "ingest_backpressure",   # ingest/plane.py — staging over budget (429)
+    "ingest_job_error",      # ingest/poller.py — routine-load poll failed
 ))
 
 config.define("events_ring_size", 512, True,

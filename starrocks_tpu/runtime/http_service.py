@@ -5,6 +5,12 @@ http_service.h, http/action/*: SQL execute, metrics, profile endpoints; FE
 http/rest/ExecuteSqlAction.java). Minimal but real server:
 
   POST /query   {"sql": "..."}  -> {"columns": [...], "rows": [...], "ms": t}
+  PUT  /api/load/{table}        -> stream load: CSV/JSON body staged +
+                                   micro-batch committed by the ingest
+                                   plane (?format=csv|json&label=...&
+                                   columns=a,b&column_separator=,);
+                                   429 on staging backpressure
+  GET  /api/ingest              -> ingest plane stats + routine-load jobs
   GET  /metrics                 -> Prometheus text
   GET  /profile                 -> last query's RuntimeProfile render
   GET  /tables                  -> catalog listing
@@ -118,6 +124,11 @@ def make_handler(session: Session, tier: ServingTier):
                 self._send(200, json.dumps(
                     {"alerts": ALERTS.snapshot(),
                      "stats": ALERTS.stats()}, default=str))
+            elif self.path == "/api/ingest":
+                plane = session.ingest_plane()
+                self._send(200, json.dumps(
+                    {"ingest": plane.stats(),
+                     "jobs": plane.poller.snapshot()}, default=str))
             elif self.path == "/api/debug/bundle":
                 from .audit import diagnostic_bundle
 
@@ -142,6 +153,63 @@ def make_handler(session: Session, tier: ServingTier):
                 except Exception:  # lint: swallow-ok — bad header = deny
                     return None
             return user if auth.verify_plain(user, pw) else None
+
+        def do_PUT(self):
+            """Stream load (reference: the BE's `PUT /api/{db}/{table}/
+            _stream_load`): body rows stage into the ingest plane and
+            this request returns once its micro-batch commit is visible,
+            with the txn-label receipt. A replayed label answers with
+            the ORIGINAL receipt (exactly-once); staging over budget
+            answers 429 and the client retries with the SAME label."""
+            import re
+            from urllib.parse import parse_qs, urlparse
+
+            u = urlparse(self.path)
+            m = re.fullmatch(r"/api/load/([A-Za-z_][A-Za-z0-9_]*)", u.path)
+            if m is None:
+                self._send(404, json.dumps({"error": "not found"}))
+                return
+            user = self._auth_user()
+            if user is None:
+                self.send_response(401)
+                self.send_header("WWW-Authenticate",
+                                 'Basic realm="starrocks_tpu"')
+                self.end_headers()
+                return
+            from ..ingest import IngestBackpressure
+
+            table = m.group(1).lower()
+            q = {k: v[-1] for k, v in parse_qs(u.query).items()}
+            columns = [c for c in q.get("columns", "").split(",")
+                       if c.strip()] or None
+            t0 = time.time()
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode("utf-8", errors="replace")
+                auth = session.auth()
+                if not auth.is_admin(user):
+                    auth.require(user, table, "insert")
+                plane = session.ingest_plane()
+                rows = plane.parse_body(
+                    session, table, body,
+                    fmt=q.get("format", "csv").lower(), columns=columns,
+                    sep=q.get("column_separator", ","))
+                receipt = dict(plane.load(
+                    tier.new_session(user), table, rows,
+                    label=q.get("label"), user=user))
+                receipt["ms"] = round((time.time() - t0) * 1000, 1)
+                self._send(200, json.dumps(
+                    {"status": "ok", **receipt}, default=str))
+            except IngestBackpressure as e:
+                self._send(429, json.dumps(
+                    {"status": "backpressure", "error": str(e)}))
+            except PermissionError as e:
+                self._send(403, json.dumps({"error": str(e)}))
+            except Exception as e:  # lint: swallow-ok — typed error -> 400
+                self._send(
+                    400,
+                    json.dumps({"error": f"{type(e).__name__}: {e}"}),
+                )
 
         def do_POST(self):
             import re

@@ -74,6 +74,10 @@ LATENCY_POINT_MS = metrics.histogram(
     "sr_tpu_point_latency_ms",
     "wall milliseconds of short-circuit point statements (the planner/"
     "compiler-free PK-lookup lane; its context sets stmt_class='point')")
+LATENCY_LOAD_MS = metrics.histogram(
+    "sr_tpu_query_latency_ms_load",
+    "wall milliseconds of ingest-plane loads (stream/routine micro-batch "
+    "loads, stage->commit-visible; their contexts set stmt_class='load')")
 
 _DML_HEADS = frozenset(("insert", "update", "delete", "load"))
 _DDL_HEADS = frozenset(("create", "drop", "alter", "truncate", "refresh"))
@@ -100,7 +104,7 @@ def observe_query_latency(sql: str, ms: float, cls: str | None = None):
     though its text says SELECT/UPDATE/DELETE."""
     {"read": LATENCY_READ_MS, "dml": LATENCY_DML_MS,
      "ddl": LATENCY_DDL_MS, "other": LATENCY_OTHER_MS,
-     "point": LATENCY_POINT_MS}[
+     "point": LATENCY_POINT_MS, "load": LATENCY_LOAD_MS}[
         cls or statement_class(sql)].observe(float(ms))
 
 

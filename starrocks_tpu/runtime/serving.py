@@ -141,17 +141,36 @@ _DML_TARGET_RE = re.compile(
     r"([A-Za-z_][A-Za-z0-9_]*)", re.IGNORECASE)
 
 
-def _read_footprint(sql: str, catalog):
+def _read_footprint(sql: str, catalog, cache=None):
     """Base tables of a read statement, or None when the footprint is not
-    provable from the text (view/MV references pull in unlisted bases;
-    SHOW/EXPLAIN/DESCRIBE read stats and catalog state). The token scan
-    OVER-approximates: a spurious table claim only costs concurrency,
-    while a missed claim would race DML — so anything uncertain degrades
-    to the strong (every-table-writer-excluding) reader."""
+    provable (SHOW/EXPLAIN/DESCRIBE read stats and catalog state).
+
+    Preferred source: the statement's CACHED ANALYZED PLAN (counter-free
+    `PlanCache.peek` + `plan_tables`) — exact base tables even through
+    view/MV expansion and subqueries, so a warm dashboard query over a
+    view never degrades to the strong reader and never stalls behind
+    ingest commits on unrelated tables. Internal relations (__dual__,
+    information_schema) claim nothing: their backing state is guarded by
+    its own leaf locks, and DDL still bars them via the global side.
+
+    Fallback (cold statements): the token scan, which OVER-approximates —
+    a spurious table claim only costs concurrency, while a missed claim
+    would race DML — so anything uncertain (view/MV tokens, no provable
+    tables) degrades to the strong (every-table-writer-excluding)
+    reader. Probe-then-execute races are benign either way: claims are
+    granted atomically under the gate lock, and execution re-validates
+    through the normal session.sql path."""
     head = sql.lstrip().split(None, 1)
     kw = head[0].lower().rstrip("(") if head else ""
     if kw not in ("select", "with", "values"):
         return None
+    if cache is not None and config.get("enable_plan_cache"):
+        plan = cache.plan_cache.peek(sql, catalog)
+        if plan is not None:
+            from ..sql.optimizer import plan_tables
+
+            return frozenset(
+                t for t in plan_tables(plan) if t in catalog.tables)
     toks = {t.lower() for t in _IDENT_RE.findall(sql)}
     if toks & (set(catalog.views) | set(catalog.mv_defs)):
         return None
@@ -487,7 +506,7 @@ class ExecutorPool:
             gate_side = self.gate.exclusive(target, reads)
         else:
             gate_side = self.gate.shared(
-                _read_footprint(w.sql, sess.catalog))
+                _read_footprint(w.sql, sess.catalog, sess.cache))
         with lifecycle.query_scope(w.sql, user=sess.current_user,
                                    group=sess.resource_group,
                                    group_limit=group_limit, ctx=w.ctx):
@@ -504,6 +523,13 @@ class ServingTier:
         self.cache = template.cache
         self.store = template.store
         self.gate = StatementGate()
+        # publish the gate catalog-wide: the ingest plane's micro-batch
+        # commits take its per-table exclusive side (Session.ingest_plane
+        # reads serve_gate at plane wire-up; one tier per catalog)
+        self.catalog.serve_gate = self.gate
+        ip = getattr(self.catalog, "ingest_plane", None)
+        if ip is not None:
+            ip.gate = self.gate
         size = pool_size if pool_size is not None \
             else int(config.get("serve_pool_size"))
         self.pool = ExecutorPool(size, self.gate)
@@ -641,16 +667,23 @@ class ServingTier:
             return _FAST_MISS  # plan shapes simply take the pool path
         if not self.cache.qcache.has_result(skey, self.catalog):
             return _FAST_MISS
+        from ..sql.optimizer import plan_tables
+
+        # the analyzed plan is in hand: claim its exact base tables
+        # instead of the strong reader, so warm dashboards over table Y
+        # glide past ingest commits and DML on table X
+        tabs = frozenset(t for t in plan_tables(plan)
+                         if t in self.catalog.tables)
         t0 = time.perf_counter()  # before the claim: nothing may raise
         #                           between acquire and the try-finally
-        if not self.gate.try_shared():
+        if not self.gate.try_shared(tabs):
             return _FAST_MISS  # a mutation is active/queued: pool path
         try:
             SERVE_FAST_PATH.inc()
             SERVE_STATEMENTS.inc()
             return session.sql(sql)
         finally:
-            self.gate.release_shared()
+            self.gate.release_shared(tabs)
             SERVE_FAST_PATH_HIST.observe(
                 (time.perf_counter() - t0) * 1000.0)
 

@@ -165,6 +165,12 @@ class Session:
                 n: h.location for n, h in self.catalog.tables.items()
                 if isinstance(h, ExternalTableHandle)},
         }
+        ip = getattr(self.catalog, "ingest_plane", None)
+        if ip is not None:
+            # the txn-label ledger + routine-load jobs/offsets ride the
+            # image, so exactly-once replay detection and job progress
+            # survive restarts (ingest/labels.py, ingest/poller.py)
+            img["ingest"] = ip.image()
         return self.store.checkpoint(img)
 
     def _restore_catalog_meta(self):
@@ -199,6 +205,8 @@ class Session:
                     ExternalTableHandle(name, location))
             except ValueError:
                 pass  # files vanished; the definition stays until DROP
+        if cat.get("ingest"):
+            self.ingest_plane().restore_image(cat["ingest"])
         for op in self.store.replay(after_seq=base):
             k = op["op"]
             if k == "create_rg":
@@ -233,6 +241,17 @@ class Session:
                 self.auth().grant(op["user"], op["table"], op["privs"])
             elif k == "revoke":
                 self.auth().revoke(op["user"], op["table"], op["privs"])
+            elif k == "ingest_label":
+                # micro-batch commit receipts (exactly-once replay state)
+                self.ingest_plane().labels.restore(op["labels"])
+            elif k == "ingest_job":
+                self.ingest_plane().poller.restore_job(op["name"],
+                                                       op["spec"])
+            elif k == "drop_ingest_job":
+                self.ingest_plane().poller.drop_job(op["name"])
+            elif k == "ingest_offset":
+                self.ingest_plane().poller.restore_offset(
+                    op["name"], op["file"], op["offset"])
         for n, text in mv_defs.items():
             self.catalog.mv_defs[n] = text
             try:
@@ -242,6 +261,11 @@ class Session:
                 # without dropping the MV): keep the definition visible and
                 # unmaterialized; queries against it fail with the real error
                 pass
+        ip = getattr(self.catalog, "ingest_plane", None)
+        if ip is not None:
+            # restored routine-load jobs resume from their persisted
+            # offsets; a no-op when no jobs survived (zero threads)
+            ip.poller.ensure_started()
         self.store.ensure_seq()
 
     def _log_meta(self, op: dict):
@@ -636,6 +660,12 @@ class Session:
 
             ALERTS.set_from_sql(stmt.name, stmt.value)
             return None
+        if isinstance(stmt, ast.AdminSetIngestJob):
+            # routine-load CRUD: `ADMIN SET ingest_job 'name' = '<json
+            # spec>'` creates/replaces, `= 'drop'` drops (the CREATE/
+            # PAUSE/DROP ROUTINE LOAD analog; specs journal + image)
+            return self.ingest_plane().admin_set_job(self, stmt.name,
+                                                     stmt.value)
         if isinstance(stmt, ast.AdminDiagnose):
             import json as _json
 
@@ -851,6 +881,29 @@ class Session:
             self.catalog.workgroups = WorkgroupManager()
         return self.catalog.workgroups
 
+    def ingest_plane(self):
+        """The catalog-wide continuous ingest plane (HTTP stream load +
+        routine-load poller; ingest/plane.py). Lazily created like
+        workgroups; its commit session is a dedicated sibling sharing
+        this session's catalog/cache/store, so poller commits ride the
+        same PK delta-write path, cache invalidation, and data epochs —
+        the ingest package itself never imports Session."""
+        from ..ingest import IngestPlane
+
+        if getattr(self.catalog, "ingest_plane", None) is None:
+            self.catalog.ingest_plane = IngestPlane()
+        plane = self.catalog.ingest_plane
+        if plane.gate is None:
+            # under a serving tier, commits take the tier's per-table
+            # exclusive gate side; bare sessions have no gate (the store
+            # serializes, matching direct-session DML semantics)
+            plane.gate = getattr(self.catalog, "serve_gate", None)
+        if plane.commit_session is None:
+            plane.commit_session = Session(
+                catalog=self.catalog, cache=self.cache,
+                store=self.store, dist_shards=self.dist_shards)
+        return plane
+
     def _enforce_privileges(self, stmt):
         """Statement-level checks (reference: authorization/Authorizer.java
         checks in StmtExecutor). SELECT privileges are checked per base
@@ -875,6 +928,7 @@ class Session:
                                ast.DropResourceGroup,
                                ast.AdminSetFailpoint,
                                ast.AdminSetAlert,
+                               ast.AdminSetIngestJob,
                                ast.AdminDiagnose)):
             raise PermissionError(
                 f"user {user!r} lacks the admin privileges for DDL")

@@ -58,8 +58,9 @@ def sql_shape(sql: str) -> str:
     return _WS_RE.sub(" ", s).strip().lower()
 
 
-# workload classes are the lifecycle latency classes plus the point lane
-_CLASSES = ("read", "dml", "ddl", "other", "point")
+# workload classes are the lifecycle latency classes plus the point and
+# ingest-load lanes (their contexts set stmt_class explicitly)
+_CLASSES = ("read", "dml", "ddl", "other", "point", "load")
 
 
 def _new_entry() -> dict:

@@ -369,6 +369,15 @@ class AdminSetAlert:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdminSetIngestJob:
+    """ADMIN SET ingest_job '<name>' = '<json spec>'|'drop'
+    (routine-load CRUD; ingest/poller.py)."""
+
+    name: str
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
 class AdminDiagnose:
     """ADMIN DIAGNOSE: the one-shot diagnostic bundle (running queries,
     profiles, audit/event tails, metrics history, lock-witness state,

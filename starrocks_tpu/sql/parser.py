@@ -249,10 +249,10 @@ class Parser:
                 return ast.AdminDiagnose()
             self.expect_kw("set")
             word = self.expect_ident()
-            if word.lower() not in ("failpoint", "alert"):
+            if word.lower() not in ("failpoint", "alert", "ingest_job"):
                 raise ParseError(
                     f"unsupported ADMIN SET target {word!r} "
-                    "(only 'failpoint' or 'alert')")
+                    "(only 'failpoint', 'alert', or 'ingest_job')")
             t = self.next()
             if t.kind != "string":
                 raise ParseError(
@@ -265,6 +265,8 @@ class Parser:
             self.accept_op(";")
             if word.lower() == "alert":
                 return ast.AdminSetAlert(t.value, v.value)
+            if word.lower() == "ingest_job":
+                return ast.AdminSetIngestJob(t.value, v.value)
             return ast.AdminSetFailpoint(t.value, v.value)
         if self.accept_kw("show"):
             if (self.peek().kind == "ident"

@@ -773,6 +773,30 @@ class Catalog:
                 ("feedback_hit_ratio", T.DOUBLE,
                  [e["feedback_hit_ratio"] for e in rows]),
             ])
+        if view == "ingest_jobs":
+            # routine-load jobs + progress (the SHOW ROUTINE LOAD analog;
+            # CRUD surface is ADMIN SET ingest_job, ingest/poller.py)
+            import json as _json
+
+            ip = getattr(self, "ingest_plane", None)
+            rows = ip.poller.snapshot() if ip is not None else []
+            return vtable([
+                ("name", T.VARCHAR, [e["name"] for e in rows]),
+                ("table_name", T.VARCHAR, [e["table"] for e in rows]),
+                ("path", T.VARCHAR, [e["path"] for e in rows]),
+                ("format", T.VARCHAR, [e["format"] for e in rows]),
+                ("state", T.VARCHAR, [e["state"] for e in rows]),
+                ("rows_loaded", T.BIGINT,
+                 [e["rows_loaded"] for e in rows]),
+                ("commits", T.BIGINT, [e["commits"] for e in rows]),
+                ("errors", T.BIGINT, [e["errors"] for e in rows]),
+                ("last_error", T.VARCHAR, [e["last_error"] for e in rows]),
+                ("last_poll_ts", T.DOUBLE,
+                 [e["last_poll_ts"] for e in rows]),
+                ("offsets", T.VARCHAR,
+                 [_json.dumps(e["offsets"], sort_keys=True)
+                  for e in rows]),
+            ])
         if view == "alerts":
             from ..runtime.alerts import ALERTS
 

@@ -43,6 +43,15 @@ def _reset_lifecycle_knobs():
     config.set("batch_rows_threshold", 0)
     config.set("spill_batch_rows", 0)
     config.set("enable_query_cache", False)
+    # ingest knobs exist only once starrocks_tpu.ingest imported
+    for knob, dflt in (("ingest_batch_age_ms", 200),
+                       ("ingest_batch_rows", 4096),
+                       ("ingest_staging_limit_bytes", 64 << 20),
+                       ("enable_ingest_plane", True)):
+        try:
+            config.set(knob, dflt)
+        except KeyError:
+            pass
 
 
 def _mk_session(rows: int = 8) -> Session:
@@ -722,3 +731,185 @@ def test_failpoint_failed_query_leaves_exactly_one_audit_record():
     assert recs[0]["stage"]  # terminal stage attributed (unwind-dependent)
     _assert_clean(s, before)
     _probe_correct(s)
+
+
+# --- ingest plane: faults at stage/commit/label-journal ----------------------
+
+
+def _mk_ingest(s=None):
+    """PK fixture table + the catalog-attached ingest plane, micro-batch
+    age tightened so single loads commit promptly."""
+    s = s or Session()
+    s.sql("create table ti (k int, v int, primary key (k))")
+    plane = s.ingest_plane()
+    config.set("ingest_batch_age_ms", 5)
+    return s, plane
+
+
+def _ingest_leaks(s, plane) -> dict:
+    d = _leak_snapshot(s)
+    d["ingest_staged"] = plane.stats()["staged_bytes"]
+    return d
+
+
+def test_ingest_commit_fault_fails_whole_batch_atomically():
+    """A fault before the append fails the WHOLE batch: no partial rows
+    become visible, nothing stays staged, and a retry with the SAME
+    label commits exactly once (not a replay — the label never landed)."""
+    from starrocks_tpu.ingest import IngestError
+
+    s, plane = _mk_ingest()
+    plane.load(s, "ti", [{"k": 1, "v": 1}], label="seed")
+    before = _ingest_leaks(s, plane)
+    # the committer re-raises the raw fault (so kill/timeout keep their
+    # typed classification); waiters in the same batch get IngestError
+    with failpoint.scoped("ingest::commit"):
+        with pytest.raises((IngestError, FailPointError)):
+            plane.load(s, "ti", [{"k": 2, "v": 2}, {"k": 3, "v": 3}],
+                       label="L")
+    assert s.sql("select count(*) from ti").rows() == [(1,)]
+    assert _ingest_leaks(s, plane) == before
+    r = plane.load(s, "ti", [{"k": 2, "v": 2}, {"k": 3, "v": 3}],
+                   label="L")
+    assert "replayed" not in r
+    assert s.sql("select count(*) from ti").rows() == [(3,)]
+
+
+def test_ingest_label_journal_fault_retry_is_idempotent(tmp_path):
+    """A fault AFTER the append but BEFORE the label journal is the
+    at-least-once window: the retry re-upserts the same keys (PK delta
+    path), so the net effect is exactly-once — and the label then
+    replays as a durable no-op, including across a restart."""
+    from starrocks_tpu.ingest import IngestError
+
+    s = Session(data_dir=str(tmp_path / "db"))
+    s, plane = _mk_ingest(s)
+    before = _ingest_leaks(s, plane)
+    with failpoint.scoped("ingest::label_journal"):
+        with pytest.raises((IngestError, FailPointError)):
+            plane.load(s, "ti", [{"k": 1, "v": 1}], label="L1")
+    assert _ingest_leaks(s, plane) == before
+    r = plane.load(s, "ti", [{"k": 1, "v": 1}], label="L1")
+    assert "replayed" not in r  # the faulted attempt never journaled it
+    assert s.sql("select k, v from ti").rows() == [(1, 1)]
+    r2 = plane.load(s, "ti", [{"k": 1, "v": 9}], label="L1")
+    assert r2["replayed"] is True
+    assert s.sql("select k, v from ti").rows() == [(1, 1)]
+    # restart: journal tail replays the ledger; still a durable no-op
+    s2 = Session(data_dir=str(tmp_path / "db"))
+    config.set("ingest_batch_age_ms", 5)
+    r3 = s2.ingest_plane().load(s2, "ti", [{"k": 1, "v": 9}], label="L1")
+    assert r3["replayed"] is True
+    assert s2.sql("select k, v from ti").rows() == [(1, 1)]
+
+
+def test_ingest_kill_while_staged_unwinds_clean():
+    """KILL lands while the load waits for its micro-batch: the staged
+    rows unstage (no leak), nothing commits, and the load leaves exactly
+    one audit record in state 'cancelled' with stmt_class load."""
+    s, plane = _mk_ingest()
+    config.set("ingest_batch_age_ms", 60_000)
+    config.set("ingest_batch_rows", 1_000_000)
+    before = _ingest_leaks(s, plane)
+    qids = []
+
+    def note():
+        qids.append(lifecycle.current().qid)
+
+    def killer():
+        time.sleep(0.15)
+        REGISTRY.cancel(qids[0], requester="root", admin=True)
+
+    t = threading.Thread(target=killer, daemon=True)
+    with failpoint.scoped("ingest::stage", action=note):
+        t.start()
+        with pytest.raises(QueryCancelledError):
+            plane.load(s, "ti", [{"k": 5, "v": 5}], label="K")
+    t.join()
+    assert _ingest_leaks(s, plane) == before
+    assert s.sql("select count(*) from ti").rows() == [(0,)]
+    recs = _audit_records_for(qids[0])
+    assert len(recs) == 1
+    assert recs[0]["state"] == "cancelled"
+
+
+def test_ingest_backpressure_rejects_before_staging():
+    """Over-budget staging rejects the load BEFORE anything stages
+    (zero leak) and emits the ingest_backpressure event; after the
+    budget is restored the SAME label loads normally."""
+    from starrocks_tpu.ingest import IngestBackpressure
+    from starrocks_tpu.runtime.events import EVENTS
+
+    s, plane = _mk_ingest()
+    config.set("ingest_staging_limit_bytes", 1)
+    before = _ingest_leaks(s, plane)
+    n0 = EVENTS.stats().get("ingest_backpressure", 0)
+    with pytest.raises(IngestBackpressure):
+        plane.load(s, "ti", [{"k": 1, "v": 1}], label="B")
+    assert EVENTS.stats().get("ingest_backpressure", 0) == n0 + 1
+    assert _ingest_leaks(s, plane) == before
+    assert s.sql("select count(*) from ti").rows() == [(0,)]
+    config.set("ingest_staging_limit_bytes", 64 << 20)
+    r = plane.load(s, "ti", [{"k": 1, "v": 1}], label="B")
+    assert "replayed" not in r
+    assert s.sql("select count(*) from ti").rows() == [(1,)]
+
+
+def test_ingest_group_commit_audits_once_per_load():
+    """Loads folded into ONE micro-batch commit still audit once EACH
+    (each has its own query_scope); the shared commit is visible in the
+    matching commit_seq on their receipts."""
+    from starrocks_tpu.runtime.audit import AUDIT
+
+    s, plane = _mk_ingest()
+    config.set("ingest_batch_age_ms", 150)
+    config.set("ingest_batch_rows", 1_000_000)
+    AUDIT.flush()
+    n0 = AUDIT.stats()["registered"]
+    out = {}
+
+    def load(i):
+        out[i] = plane.load(s, "ti", [{"k": i, "v": i}], label=f"g{i}")
+
+    threads = [threading.Thread(target=load, args=(i,), daemon=True)
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    AUDIT.flush()
+    assert AUDIT.stats()["registered"] - n0 == 3
+    assert len({r["commit_seq"] for r in out.values()}) == 1  # one batch
+    assert s.sql("select count(*) from ti").rows() == [(3,)]
+    assert plane.stats()["staged_bytes"] == 0
+
+
+def test_ingest_poller_fault_surfaces_on_job_and_loop_survives(tmp_path):
+    """A fault at ingest::poll fails that tick, journals an
+    ingest_job_error event, and the NEXT tick (fault disarmed) loads the
+    file — the poll loop never dies with its job."""
+    import json as _json
+
+    from starrocks_tpu.runtime.events import EVENTS
+
+    s = Session(data_dir=str(tmp_path / "db"))
+    s, plane = _mk_ingest(s)
+    config.set("ingest_poll_interval_s", 0.05)
+    src = tmp_path / "in.csv"
+    src.write_text("1,10\n2,20\n")
+    n0 = EVENTS.stats().get("ingest_job_error", 0)
+    spec = _json.dumps({"table": "ti", "path": str(src)})
+    with failpoint.scoped("ingest::poll", times=2):
+        s.sql(f"admin set ingest_job 'j' = '{spec}'")
+        deadline = time.monotonic() + 5
+        while (EVENTS.stats().get("ingest_job_error", 0) <= n0
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+    assert EVENTS.stats().get("ingest_job_error", 0) > n0
+    deadline = time.monotonic() + 5
+    while (s.sql("select count(*) from ti").rows() != [(2,)]
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert s.sql("select count(*) from ti").rows() == [(2,)]
+    s.sql("admin set ingest_job 'j' = 'drop'")
+    assert plane.poller.stats() == {"jobs": 0, "running": False}

@@ -158,6 +158,13 @@ def run(seed: int, rounds: int, sites_per_round: int) -> int:
     s.sql("create table fzd (k int, v int, primary key (k))")
     s.sql("insert into fzd values (0, 10), (1, 11), (2, 12), "
           "(3, 13), (4, 14)")
+    s.sql("create table fzi (k int, v int, primary key (k))")
+    # the ingest lane: fuzzed stream loads land here, so faults at the
+    # ingest:: sites (stage/commit/label_journal) get a real unwind path
+    plane = s.ingest_plane()
+    from starrocks_tpu.runtime.config import config as _cfg
+
+    _cfg.set("ingest_batch_age_ms", 5)  # commit promptly per round
 
     def leak_snapshot():
         wm = getattr(s.catalog, "workgroups", None)
@@ -165,6 +172,7 @@ def run(seed: int, rounds: int, sites_per_round: int) -> int:
             "process_bytes": ACCOUNTANT.snapshot()["process_bytes"],
             "slots": sum(wm.running.values()) if wm is not None else 0,
             "registry": len(REGISTRY.snapshot()),
+            "ingest_staged": plane.stats()["staged_bytes"],
         }
 
     def fail(msg: str):
@@ -192,6 +200,19 @@ def run(seed: int, rounds: int, sites_per_round: int) -> int:
                     # is the leak/witness/audit contract below
                     faults += 1
                     del e
+            # stream-load lane under the SAME armed schedule: each load
+            # audits exactly once (its own query_scope) whether it
+            # commits, replays, or faults at an ingest:: site
+            driven += 1
+            try:
+                plane.load(
+                    s, "fzi",
+                    [{"k": r * 10 + i, "v": rng.randint(0, 99)}
+                     for i in range(rng.randint(1, 3))],
+                    label=f"fuzz:{r}")
+            except Exception as e:  # noqa: BLE001 — same contract as SQL
+                faults += 1
+                del e
         finally:
             for site, _times in schedule:
                 failpoint.disarm(site)
@@ -212,9 +233,24 @@ def run(seed: int, rounds: int, sites_per_round: int) -> int:
             return fail(f"round {r}: probe returned {got}, expected "
                         "[(5,)] — fault corrupted committed data")
         driven += 1  # the probe statement audits too
+        # clean ingest probe: with faults disarmed a fresh-label load
+        # must commit and be immediately visible (freshness contract)
+        driven += 1
+        try:
+            plane.load(s, "fzi", [{"k": 100000 + r, "v": r}],
+                       label=f"probe:{r}")
+        except Exception as e:  # noqa: BLE001
+            return fail(f"round {r}: clean ingest probe failed after "
+                        f"disarm: {type(e).__name__}: {e}")
+        got = s.sql(
+            f"select count(*) from fzi where k = {100000 + r}").rows()
+        driven += 1
+        if got != [(1,)]:
+            return fail(f"round {r}: ingest probe row missing ({got}) — "
+                        "committed load not visible")
     AUDIT.flush()
     registered = AUDIT.stats()["registered"]
-    expected = driven + 3  # + the three fixture statements
+    expected = driven + 4  # + the four fixture statements
     if registered != expected:
         return fail(f"audit records {registered} != statements driven "
                     f"{expected} (every exit path must audit once)")

@@ -19,9 +19,11 @@ statement, terminal fields present), /api/events (list + per-type
 counts over the closed taxonomy), /api/metrics/history (sampler ring
 populated, samples carry counters/gauges/histograms), /api/workload
 (per-fingerprint rolling stats aggregated the warm repeat), /api/alerts
-(default rule set installed, states on the ok/firing enum), and
+(default rule set installed, states on the ok/firing enum),
 /api/debug/bundle (the ADMIN DIAGNOSE document, all sections present —
-including the round-19 workload/alerts sections).
+including the round-19 workload/alerts sections), and /api/ingest
+(plane stats + job rows after a driven PUT stream load, with the
+sr_tpu_ingest_* families observed on the same scrape).
 
 Exit 1 with a finding list on any violation, 0 otherwise.
 """
@@ -148,6 +150,24 @@ def validate_observability(port: int, n_statements: int) -> list[str]:
     missing = [s for s in BUNDLE_SECTIONS if s not in bundle]
     if missing:
         findings.append(f"/api/debug/bundle missing sections {missing}")
+
+    ing = scrape_json(port, "/api/ingest")
+    plane = ing.get("ingest")
+    if not isinstance(plane, dict):
+        findings.append("/api/ingest payload missing 'ingest' stats dict")
+    else:
+        for key in ("staged_bytes", "staged_tables", "commits", "labels",
+                    "jobs"):
+            if key not in plane:
+                findings.append(f"/api/ingest stats missing {key!r}")
+        if plane.get("commits", 0) < 1:
+            findings.append("/api/ingest shows no commit after the "
+                            "driven stream load")
+        if plane.get("staged_bytes", -1) != 0:
+            findings.append("/api/ingest staged_bytes nonzero after the "
+                            "load committed")
+    if not isinstance(ing.get("jobs"), list):
+        findings.append("/api/ingest payload missing 'jobs' list")
     return findings
 
 
@@ -209,6 +229,21 @@ def main() -> int:
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=120) as r:
                 json.loads(r.read())
+        # one stream load over PUT so the ingest counters/histograms
+        # observe, then /api/ingest and the sr_tpu_ingest_* families get
+        # validated on the same live scrape
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/api/load/m_load?label=probe1",
+            data=b"1,10\n2,20\n", method="PUT")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/query", timeout=120,
+                data=json.dumps({"sql": "create table m_load (k int, "
+                                 "v int, primary key (k))"}).encode()):
+            pass
+        with urllib.request.urlopen(req, timeout=120) as r:
+            if json.loads(r.read()).get("status") != "ok":
+                print("check_metrics_endpoint: PUT stream load failed")
+                return 1
         text = scrape(srv.port)
         obs_findings = validate_observability(srv.port, len(STATEMENTS))
     finally:
@@ -218,11 +253,21 @@ def main() -> int:
     # the queries above must have landed samples in the read-latency and
     # compile histograms — an exposition that validates but never observes
     # would pass the shape checks while the instrumentation is dead
-    for required in ("sr_tpu_query_latency_ms_read", "sr_tpu_compile_ms"):
+    for required in ("sr_tpu_query_latency_ms_read", "sr_tpu_compile_ms",
+                     "sr_tpu_ingest_freshness_ms",
+                     "sr_tpu_ingest_commit_ms"):
         m = re.search(rf"^{required}_count (\d+)$", text, re.M)
         if m is None or int(m.group(1)) == 0:
             findings.append(f"histogram {required} observed no samples "
                             f"after live queries")
+    # and the ingest counters: the PUT above staged, committed, and rode
+    # the load latency class
+    for required in ("sr_tpu_ingest_loads_total", "sr_tpu_ingest_rows_total",
+                     "sr_tpu_ingest_commits_total"):
+        m = re.search(rf"^{required} (\d+)$", text, re.M)
+        if m is None or int(m.group(1)) == 0:
+            findings.append(f"counter {required} never incremented after "
+                            f"the driven stream load")
     n_hist = sum(1 for t in types_of(text).values() if t == "histogram")
     for f in findings:
         print(f"check_metrics_endpoint: {f}")

@@ -33,6 +33,11 @@ Phases:
      metrics-history sampler on vs off, interleaved rounds): warm
      fast-path p50 and point-lane p50 must regress <5% with the
      defaults ON (`--obs` runs just this phase; `--no-obs` skips it).
+  7. **--ingest** — continuous-ingest phase: sustained HTTP stream-load
+     lanes into one PK table under live analytic + point serving of a
+     DIFFERENT table, reporting ingest_rows_s, staged->visible
+     freshness p50, serving p99 under ingest vs baseline, and the idle
+     cost of the enabled-but-unused plane.
 
 Summary JSON prints on the last line (the driver's bench contract);
 --detail merges a "serve" section into BENCH_DETAIL.json.
@@ -581,6 +586,218 @@ def run_obs_phase(iters: int = 240, nrows: int = 8000) -> dict:
     return out
 
 
+def run_ingest_phase(seconds: float = 6.0, nrows: int = 12000,
+                     loaders: int = 1, put_rows: int = 1000) -> dict:
+    """Continuous-ingest phase: sustained HTTP stream-load lanes into one
+    PK table while a Zipfian analytic lane and the point lane keep
+    serving a DIFFERENT table through the same tier — the plan-footprint
+    gate claims are what keep the serving lanes out of the ingest
+    commits' way. Reports sustained ingest rows/s, staged->visible
+    freshness p50 (the sr_tpu_ingest_freshness_ms histogram), serving
+    latency under ingest vs a no-ingest baseline on the SAME process,
+    and the idle cost of merely having the plane enabled (A/B toggling
+    `enable_ingest_plane` with zero load traffic)."""
+    import shutil
+    import tempfile
+
+    from starrocks_tpu.ingest.plane import INGEST_FRESHNESS_MS
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.http_service import SqlHttpServer
+    from starrocks_tpu.runtime.serving import ServingTier
+    from starrocks_tpu.runtime.session import Session
+
+    d = tempfile.mkdtemp(prefix="sr_ingestbench_")
+    prev_qc = config.get("enable_query_cache")
+    out: dict = {"loaders": loaders, "put_rows": put_rows}
+    half = max(seconds / 2.0, 2.0)
+    try:
+        s = Session(data_dir=os.path.join(d, "db"))
+        s.sql("create table serve_kv (k bigint, v varchar, n bigint, "
+              "primary key(k))")
+        for base in range(0, nrows, 2000):
+            rows = ",".join(f"({i}, 'v{i}', {i * 3})"
+                            for i in range(base, min(base + 2000, nrows)))
+            s.sql(f"insert into serve_kv values {rows}")
+        s.sql("create table ingest_sink (k bigint, v bigint, "
+              "primary key(k))")
+        tier = ServingTier(s, pool_size=2)
+        plane = s.ingest_plane()  # wires the tier's gate into commits
+        ht = SqlHttpServer(s, port=0, tier=tier).start()
+        config.set("enable_query_cache", False)
+        # freshness-oriented commit policy for the sustained window: a
+        # stream-load fleet tunes the age bound down exactly like this
+        config.set("ingest_batch_age_ms", 50)
+        analytic = [
+            "select count(*) c, sum(n) sn from serve_kv where n >= 0",
+            "select count(*) c, max(n) mn from serve_kv where k < "
+            f"{nrows // 2}",
+            "select min(k) a, max(k) b from serve_kv where n % 2 = 0",
+        ]
+        aw = zipf_weights(len(analytic))
+        sess = tier.new_session()
+        for sql in analytic:  # pay compiles before any timed window
+            tier.execute(sess, sql)
+
+        rng_idle = random.Random(13)
+
+        def point_once(sess_, rng):
+            tier.execute(sess_, "select v, n from serve_kv where k = "
+                         f"{rng.randrange(nrows)}")
+
+        # --- idle A/B: the enabled-but-unused plane must cost ~nothing
+        def idle_p50(iters=150):
+            lat = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                point_once(sess, rng_idle)
+                lat.append((time.perf_counter() - t0) * 1000)
+            lat.sort()
+            return lat[len(lat) // 2]
+
+        idle_p50(30)  # warm the lane before either arm samples
+        config.set("enable_ingest_plane", False)
+        p_off = idle_p50()
+        config.set("enable_ingest_plane", True)
+        p_on = idle_p50()
+        out["idle_point_p50_plane_off_ms"] = round(p_off, 3)
+        out["idle_point_p50_plane_on_ms"] = round(p_on, 3)
+        out["idle_regress_pct"] = round((p_on / max(p_off, 1e-9) - 1)
+                                        * 100, 1)
+
+        # --- serving lanes (shared by baseline and under-ingest windows)
+        def lanes(duration: float) -> dict:
+            buckets = {"point": [], "analytic": []}
+            lock = threading.Lock()
+            stop_at = time.monotonic() + duration
+
+            def loop(lane, fn):
+                sess_ = tier.new_session()
+                rng = random.Random(hash(lane) & 0xFFFF)
+                my = []
+                while time.monotonic() < stop_at:
+                    t0 = time.perf_counter()
+                    try:
+                        fn(sess_, rng)
+                    except Exception:  # noqa: BLE001
+                        continue
+                    my.append((time.perf_counter() - t0) * 1000)
+                with lock:
+                    buckets[lane].extend(my)
+
+            def analytic_once(sess_, rng):
+                tier.execute(
+                    sess_, rng.choices(analytic, weights=aw, k=1)[0])
+
+            ts = [threading.Thread(target=loop, args=("point", point_once),
+                                   daemon=True),
+                  threading.Thread(target=loop,
+                                   args=("analytic", analytic_once),
+                                   daemon=True)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=duration + 120)
+            res = {}
+            for lane, lat in buckets.items():
+                lat.sort()
+                res[f"{lane}_requests"] = len(lat)
+                res[f"{lane}_p50_ms"] = round(_pct(lat, 0.50), 3)
+                res[f"{lane}_p99_ms"] = round(_pct(lat, 0.99), 3)
+            return res
+
+        base = lanes(half)
+        out["baseline"] = base
+
+        # --- sustained stream load over HTTP + the same serving lanes
+        rows_acked = [0] * loaders
+        errors: list = []
+        stop_at = [time.monotonic() + half]
+
+        def loader(i: int):
+            conn = http.client.HTTPConnection("127.0.0.1", ht.port,
+                                              timeout=120)
+            seq = 0
+            while time.monotonic() < stop_at[0]:
+                base_k = (i << 40) + seq * put_rows
+                body = "\n".join(f"{base_k + j},{j}"
+                                 for j in range(put_rows))
+                try:
+                    conn.request("PUT", "/api/load/ingest_sink", body)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    if resp.status == 429:
+                        time.sleep(0.05)  # backpressure: retry later
+                        continue
+                    if resp.status != 200:
+                        errors.append(f"{resp.status}: {data[:120]!r}")
+                        continue
+                    rows_acked[i] += json.loads(data)["rows"]
+                    seq += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e)[:120])
+            conn.close()
+
+        f0_counts, _f0_sum, f0_n = INGEST_FRESHNESS_MS.snapshot()
+        ts = [threading.Thread(target=loader, args=(i,), daemon=True)
+              for i in range(loaders)]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        under = lanes(half)
+        for t in ts:
+            t.join(timeout=half + 120)
+        wall = time.monotonic() - t0
+        out["under_ingest"] = under
+        out["ingest_rows"] = sum(rows_acked)
+        out["ingest_rows_s"] = round(sum(rows_acked) / wall, 1)
+        out["ingest_errors"] = len(errors)
+        out["ingest_error_sample"] = errors[:3]
+        # freshness over THIS window: subtract the pre-window histogram
+        f1_counts, _f1_sum, f1_n = INGEST_FRESHNESS_MS.snapshot()
+        out["ingest_freshness_p50_ms"] = round(
+            _hist_delta_percentile(INGEST_FRESHNESS_MS, f0_counts, f0_n,
+                                   f1_counts, f1_n, 0.5), 1)
+        out["point_p99_under_ingest_ms"] = under["point_p99_ms"]
+        sink = s.sql("select count(*) from ingest_sink").rows()[0][0]
+        out["ingest_rows_visible"] = int(sink)
+        out["ingest_pass"] = bool(
+            out["ingest_rows_s"] >= 5000
+            and out["ingest_freshness_p50_ms"] < 1000
+            and under["point_p99_ms"] < 2 * max(base["point_p99_ms"], 0.5)
+            and sink == sum(rows_acked))
+        ht.stop()
+    finally:
+        config.set("enable_query_cache", prev_qc)
+        config.set("enable_ingest_plane", True)
+        config.set("ingest_batch_age_ms", 200)
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
+def _hist_delta_percentile(hist, c0, n0, c1, n1, q: float) -> float:
+    """q-quantile of the observations a histogram gained between two
+    snapshots (c0/n0 -> c1/n1), by the same interpolation its own
+    percentile() uses — serve_bench windows need per-phase freshness,
+    not process-lifetime freshness."""
+    n = n1 - n0
+    if n <= 0:
+        return 0.0
+    deltas = [a - b for a, b in zip(c1, c0)]
+    rank = q * n
+    seen = 0.0
+    for i, cnt in enumerate(deltas):
+        if cnt <= 0:
+            continue
+        if seen + cnt >= rank:
+            lo = hist.buckets[i - 1] if i > 0 else 0.0
+            hi = (hist.buckets[i] if i < len(hist.buckets)
+                  else hist.buckets[-1])
+            frac = (rank - seen) / cnt
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        seen += cnt
+    return hist.buckets[-1]
+
+
 def run_serve_bench(threads: int = 32, seconds: float = 8.0,
                     sf: float = 0.01, pool: int = 4,
                     include_ssb: bool = False, http_frac: float = 0.25,
@@ -751,6 +968,10 @@ def main():
                     help="run ONLY the short-circuit point-query phase")
     ap.add_argument("--no-points", action="store_true",
                     help="skip the point-query phase in the full run")
+    ap.add_argument("--ingest", action="store_true",
+                    help="run ONLY the continuous-ingest phase (stream "
+                         "load + serving lanes; rows/s, freshness, "
+                         "p99-under-ingest, idle-cost gates)")
     ap.add_argument("--obs", action="store_true",
                     help="run ONLY the observability-overhead A/B phase "
                          "(audit+events+sampler on vs off; <5%% gate)")
@@ -775,6 +996,23 @@ def main():
         res = {"obs": run_obs_phase()}
         print(json.dumps(res))
         return 0 if res["obs"]["obs_pass"] else 1
+
+    if args.ingest:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        res = {"ingest": run_ingest_phase(seconds=args.seconds)}
+        if args.detail:
+            path = os.path.join(REPO, "BENCH_DETAIL.json")
+            detail = {}
+            if os.path.exists(path):
+                with open(path) as f:
+                    detail = json.load(f)
+            detail["ingest"] = res["ingest"]
+            with open(path, "w") as f:
+                json.dump(detail, f, indent=1)
+        print(json.dumps(res))
+        return 0 if res["ingest"]["ingest_pass"] else 1
 
     res = run_serve_bench(
         threads=args.threads, seconds=args.seconds, sf=args.sf,
