@@ -725,6 +725,33 @@ def run_suite(sf: float, repeats: int, probe_failed: bool = False,
     except Exception as e:  # noqa: BLE001 — the bench line must print
         serve = {"serve_error": f"{type(e).__name__}: {e}"}
 
+    # Cluster-runtime snapshot (ISSUE 20): a short coordinator + 2-worker
+    # kill-one-worker run, in a SUBPROCESS — the phase needs a 2-device
+    # host platform, which this process's already-initialized backend
+    # can't provide. Feeds cluster_workers / cluster_retries /
+    # cluster_kill_p99_ms into the summary line.
+    try:
+        if _remaining_s() > 120:
+            import subprocess as _sp
+
+            out = _sp.run(
+                [sys.executable, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "tools", "serve_bench.py"), "--cluster",
+                 "--seconds", "4"],
+                timeout=max(60, min(300, _remaining_s())), check=False,
+                capture_output=True, text=True)
+            cres = json.loads(out.stdout.strip().splitlines()[-1])
+            detail["cluster"] = cres
+            flush_detail()
+            serve.update({
+                "cluster_workers": cres.get("cluster_workers", 0),
+                "cluster_retries": cres.get("cluster_retries", 0),
+                "cluster_kill_p99_ms": cres.get("cluster_kill_p99_ms", 0),
+            })
+    except Exception as e:  # noqa: BLE001 — the bench line must print
+        serve["cluster_error"] = f"{type(e).__name__}: {e}"
+
     # Enriched final line: same metric/value as the headline (either line
     # satisfies the driver), plus the suite geomean and runtime-filter
     # pruning totals (rf_rows_pruned / rf_segments_pruned / rf_bloom_bits).

@@ -129,6 +129,7 @@ _SOCKET_METHODS = frozenset(("request", "getresponse", "connect",
                              "create_connection", "sendall", "send",
                              "recv", "accept", "makefile"))
 _STOP_METHODS = frozenset(("stop", "close", "shutdown"))
+_PROC_STOP_METHODS = _STOP_METHODS | frozenset(("terminate", "kill"))
 
 
 @dataclasses.dataclass
@@ -169,7 +170,7 @@ class _EffectAnalyzer:
         self.acquire_sites: list = []
         self.stats = {"functions": 0, "acquire_sites": 0,
                       "blocking_sites": 0, "checkpoint_sites": 0,
-                      "threads": 0, "suppressions": 0,
+                      "threads": 0, "procs": 0, "suppressions": 0,
                       "suppressions_unexplained": 0}
         self.thread_targets: set = set()
         self._collect_thread_targets()
@@ -386,6 +387,10 @@ class _EffectAnalyzer:
             root = root.value
         if attr in _SOCKET_CTORS and self._ext(mi, root) in _SOCKET_ROOTS:
             return ("socket", f"{attr}()")
+        if attr == "Popen" and self._ext(mi, root) == "subprocess":
+            # a spawned worker process is an acquire: someone must own
+            # its termination (contract 4 enforces the stop pairing)
+            return ("proc", "subprocess.Popen()")
         return None
 
     # --- effect summaries (memoized, interprocedural) -------------------------
@@ -538,8 +543,12 @@ class _EffectAnalyzer:
                           ms, qual, stmts, i):
         for kind, label, node in found:
             record(kind, node)
-            if protected or kind == "mem":
-                continue  # mem: the query scope owns the release
+            if protected or kind in ("mem", "proc"):
+                continue  # mem: the query scope owns the release;
+                #   proc: the spawning OWNER owns termination — contract 4
+                #   requires its class to expose stop/terminate, which
+                #   covers raise-paths local try-finally cannot (a worker
+                #   may outlive the spawning call by design)
             if kind == "failpoint":
                 if has_disarm:
                     continue
@@ -696,7 +705,38 @@ class _EffectAnalyzer:
         for child in fn.body:
             visit(child, held0)
 
-    # === contract 4: daemon-thread lifecycle =================================
+    # === contract 4: daemon-thread + worker-process lifecycle ================
+    def _owner_has_stop(self, mi, ci, methods) -> bool:
+        """The enclosing class (or module) exposes one of `methods` — the
+        reachable teardown contract 4 requires of thread/process owners."""
+        if ci is not None:
+            return any(set(c.methods) & methods
+                       for c in self.idx.mro(ci))
+        return bool(set(self.idx.modules[mi.ms.dotted].functions) & methods)
+
+    def _check_procs(self, mi, ci, fn, key):
+        """subprocess.Popen is a process-handle acquire: the spawning
+        owner must expose a stop/terminate path (a worker a coordinator
+        cannot kill wedges shutdown exactly like a non-daemon thread,
+        plus leaks a whole interpreter)."""
+        ms = mi.ms
+        for node in self._walk_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            a = self._direct_acquire(mi, node, fn.name)
+            if a is None or a[0] != "proc":
+                continue
+            self.stats["procs"] += 1
+            if not self._owner_has_stop(mi, ci, _PROC_STOP_METHODS):
+                self.findings.append(Finding(
+                    "error", "proc-without-stop",
+                    f"{ms.rel}:{node.lineno}",
+                    f"{key[1]}.{key[2]} spawns a subprocess but its "
+                    f"owner exposes no stop/close/shutdown/terminate/"
+                    f"kill — pair every Popen with a reachable "
+                    f"termination path (the ClusterRuntime.stop "
+                    f"pattern: SHUTDOWN, then terminate, then kill)"))
+
     def _check_threads(self, mi, ci, fn, key):
         ms = mi.ms
         for node in self._walk_body(fn):
@@ -752,6 +792,7 @@ class _EffectAnalyzer:
                 self._check_loops(mi, ci, fn, key)
                 self._check_blocking_under_lock(mi, ci, fn, key)
                 self._check_threads(mi, ci, fn, key)
+                self._check_procs(mi, ci, fn, key)
 
 
 def check_sources(sources) -> concur_check.Report:

@@ -133,10 +133,13 @@ class AuditLog:
             rec = (seq,) + self._build(ctx, ts, ms)
             self._ring.append(rec)
             if path:
+                from .failpoint import FailPointError
+
                 try:
                     self._sink_locked(path, self._rotate_bytes, rec)
-                except OSError:
-                    pass  # disk hiccup: the ring still has the record
+                except (OSError, FailPointError):
+                    pass  # disk hiccup (or injected audit::sink fault):
+                    #   the ring still has the record
         while len(self._ring) > self._cap:
             self._ring.popleft()
             self._dropped += 1
@@ -173,6 +176,10 @@ class AuditLog:
                   for c, _col in _HIT_COUNTERS)
 
     def _sink_locked(self, path, rotate_bytes, rec):  # lint: holds _lock  # lint: blocking-ok — the JSONL append is the audit durability contract: the sink must serialize with ring rotation, and writes are one bounded line
+        from .failpoint import fail_point
+
+        fail_point("audit::sink")  # injected sink faults degrade exactly
+        #   like the disk hiccup below: ring keeps the record
         line = json.dumps(dict(zip(_FIELDS, rec)), default=str) + "\n"
         try:
             if os.path.getsize(path) + len(line) > rotate_bytes:

@@ -93,6 +93,9 @@ class ClusterMonitor:
         self._lock = lockdep.lock("ClusterMonitor._lock")
         self._beats: dict = {}   # guarded_by: _lock — id -> last beat
         self._state: dict = {}   # guarded_by: _lock — id -> ALIVE | DEAD
+        self._reg: dict = {}     # guarded_by: _lock — id -> beat payload
+        #   (addr + addressable fragments): every beat re-registers, so a
+        #   worker returning from DEAD re-advertises without extra RPCs
         mon = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -101,7 +104,8 @@ class ClusterMonitor:
                 body = json.loads(self.rfile.read(n) or b"{}")
                 if self.path == "/heartbeat" and "id" in body:
                     try:
-                        mon.beat(str(body["id"]))
+                        info = {k: v for k, v in body.items() if k != "id"}
+                        mon.beat(str(body["id"]), info or None)
                         self.send_response(200)
                     except Exception:  # noqa: BLE001  # lint: swallow-ok —
                         # injected/receiver faults answer 500; the worker's
@@ -137,15 +141,36 @@ class ClusterMonitor:
             t.start()
 
     # --- registry ------------------------------------------------------------
-    def beat(self, worker_id: str):
+    def beat(self, worker_id: str, info: dict | None = None):
+        """One worker beat. `info` is the worker's registration payload
+        (exchange addr, addressable fragments) — kept fresh on every
+        beat. A beat from a worker currently marked DEAD is the
+        RECONNECT transition: the gauge drops by exactly one (recomputed
+        under the lock, so a flapping worker can't double-decrement) and
+        the coordinator journals `heartbeat_reconnect` — the worker-side
+        Heartbeater journals its own view in ITS process; this one is
+        what the coordinator's chaos assertions observe."""
         from .failpoint import fail_point
 
         fail_point("heartbeat::recv")
         with self._lock:
+            was = self._state.get(worker_id)
             self._beats[worker_id] = time.monotonic()
             self._state[worker_id] = ALIVE
+            if info is not None:
+                self._reg[worker_id] = dict(info)
             dead = sum(1 for s in self._state.values() if s == DEAD)
         WORKERS_DEAD.set(dead)
+        if was == DEAD:
+            from . import events
+
+            events.emit("heartbeat_reconnect", worker=worker_id,
+                        side="coordinator")
+
+    def registration(self, worker_id: str) -> dict:
+        """Latest beat payload the worker advertised (addr/fragments)."""
+        with self._lock:
+            return dict(self._reg.get(worker_id, {}))
 
     def members(self) -> dict:
         with self._lock:
@@ -158,22 +183,32 @@ class ClusterMonitor:
 
     def _watchdog(self):
         while not self._stop.wait(self.interval_s / 2):
-            deadline = self.interval_s * self.miss_limit
-            fire = []
-            with self._lock:
-                now = time.monotonic()
-                for w, last in self._beats.items():
-                    if now - last > deadline and self._state[w] == ALIVE:
-                        self._state[w] = DEAD
-                        fire.append(w)
-                dead = sum(1 for s in self._state.values() if s == DEAD)
-            WORKERS_DEAD.set(dead)
-            for w in fire:  # hooks run outside the lock
-                if self.on_failure is not None:
-                    try:
-                        self.on_failure(w)
-                    except Exception:  # noqa: BLE001  # lint: swallow-ok — liveness must survive
-                        pass
+            self._scan(time.monotonic())
+
+    def _scan(self, now: float):
+        """One watchdog pass at clock value `now` (separated from the
+        thread loop so tests drive ALIVE->DEAD transitions with a fake
+        clock): promote workers whose last beat is too old to DEAD,
+        journal `heartbeat_loss` once per down transition, fire the
+        restart hook outside the lock."""
+        deadline = self.interval_s * self.miss_limit
+        fire = []
+        with self._lock:
+            for w, last in self._beats.items():
+                if now - last > deadline and self._state[w] == ALIVE:
+                    self._state[w] = DEAD
+                    fire.append(w)
+            dead = sum(1 for s in self._state.values() if s == DEAD)
+        WORKERS_DEAD.set(dead)
+        for w in fire:  # hooks + journal run outside the lock
+            from . import events
+
+            events.emit("heartbeat_loss", worker=w, side="coordinator")
+            if self.on_failure is not None:
+                try:
+                    self.on_failure(w)
+                except Exception:  # noqa: BLE001  # lint: swallow-ok — liveness must survive
+                    pass
 
     def close(self):
         self._stop.set()
@@ -193,14 +228,19 @@ class Heartbeater:
 
     def __init__(self, host: str, port: int, worker_id: str,
                  interval_s: float = 0.2, max_backoff_s: float = 5.0,
-                 rng=None, autostart: bool = True, _wait=None):
+                 rng=None, autostart: bool = True, _wait=None,
+                 payload: dict | None = None):
         """`rng` and `_wait` are injection points for deterministic tests
         (a seeded Random and a fake-clock wait); `autostart=False` builds
-        the beater without its thread for unit-testing the policy."""
+        the beater without its thread for unit-testing the policy.
+        `payload` rides every beat body (the worker's registration:
+        exchange addr, addressable fragments) so a reconnect after DEAD
+        re-registers with no extra round-trip."""
         import random
 
         self.host, self.port = host, port
         self.worker_id = worker_id
+        self.payload = dict(payload or {})
         self.interval_s = interval_s
         self.max_backoff_s = max_backoff_s
         self._failures = 0
@@ -230,7 +270,8 @@ class Heartbeater:
                 self.host, self.port, timeout=2)
             try:
                 conn.request("POST", "/heartbeat",
-                             json.dumps({"id": self.worker_id}),
+                             json.dumps({"id": self.worker_id,
+                                         **self.payload}),
                              {"Content-Type": "application/json"})
                 conn.getresponse().read()
             finally:

@@ -188,6 +188,21 @@ config.define("dist_fragments", True, True,
               "declared placements verified by plan_check) instead of one "
               "monolithic SPMD program (the pre-IR A/B anchor)",
               trace=True)
+config.define("cluster_fragment_retries", 2, True,
+              "fragment re-placement budget when a cluster worker is lost "
+              "mid-query (runtime/cluster_exec.py): each lost attempt "
+              "re-schedules the SAME fragment on another ALIVE worker; "
+              "exhaustion fails the query with WorkerLostError")
+config.define("cluster_exec_timeout_s", 30.0, True,
+              "per-fragment coordinator deadline on the cluster exchange "
+              "plane: a worker that neither answers nor dies (network "
+              "partition / blackholed socket) is declared lost for THIS "
+              "fragment after this many seconds and the fragment re-places")
+config.define("cluster_route_min_fragments", 2, True,
+              "route a query to the cluster runtime only when its fragment "
+              "IR has at least this many fragments; smaller plans (point "
+              "lookups, single-fragment scans) run locally — the exchange "
+              "plane's IPC cost only pays for itself on real exchanges")
 config.define("enable_mv_rewrite", True, True,
               "transparently rewrite queries onto FRESH matching "
               "materialized views (SPJG containment; sql/mv_rewrite.py)")

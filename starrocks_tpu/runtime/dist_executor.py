@@ -260,12 +260,22 @@ class DistExecutor(Executor):
         if out is not None:
             return out
         ir, scans_meta = self._fragment_ir(plan, profile)
+        # the memo hits on plan EQUALITY: fragment roots/boundaries are
+        # nodes of the plan the IR was DERIVED from, and the compiler's
+        # scan table is id()-keyed — compile against that same object
+        # (an equal-but-distinct plan, e.g. one that crossed the cluster
+        # wire or came from a different statement text, would KeyError)
+        plan = ir.plan
         st = ir.stats()
         profile.set_info("fragments", st["fragments"])
         profile.set_info("exchanges", st["exchanges"])
         profile.add_counter("exchange_rows", st["exchange_rows"])
         profile.add_counter("exchange_bytes", st["exchange_bytes"])
         profile.set_info("fragment_topology", st["per_fragment"])
+
+        cluster = getattr(self.catalog, "cluster_runtime", None)
+        if cluster is not None and self._cluster_eligible(ir, scans_meta):
+            return self._run_cluster(cluster, plan, ir, scans_meta, profile)
 
         def attempt(caps, p):
             with p.timer("scan_to_device"):
@@ -298,6 +308,48 @@ class DistExecutor(Executor):
         out = self._adaptive(profile, attempt, publish)
         self._bind_operators(profile, self._dist_node_ord(plan))
         return out
+
+    @staticmethod
+    def _cluster_eligible(ir, scans_meta) -> bool:
+        """Route to the cluster runtime only when the exchange plane can
+        pay for itself AND every scan is a shippable stored/mem table:
+        information_schema and hidden tables are process-local state — a
+        worker's copy would answer about the WRONG process."""
+        if len(ir.fragments) < int(
+                config.get("cluster_route_min_fragments")):
+            return False
+        return all(
+            not t.startswith(("information_schema.", "__"))
+            for (t, _a, _c), _m in scans_meta
+        )
+
+    def _run_cluster(self, cluster, plan, ir, scans_meta, profile) -> Chunk:
+        """Coordinator-side cluster scheduling: fragments go out in topo
+        order, one request per fragment; boundary outputs come back as
+        host pytrees and are cached HERE, so a worker lost mid-query
+        costs one fragment re-placement, never a query restart
+        (cluster_exec.ClusterRuntime owns retry + liveness). Runs inside
+        the session's normal query scope — kill/deadline checkpoints and
+        the admission/accountant unwind hold unchanged under loss."""
+        import pickle
+
+        from .cluster_exec import plan_fingerprint
+
+        blob = pickle.dumps(plan, protocol=4)
+        fp = plan_fingerprint(blob)
+        tables = tuple(t for (t, _a, _c), _m in scans_meta)
+        profile.set_info("cluster_workers", cluster.stats()["alive"])
+        outputs: dict = {}
+        for frag in ir.fragments:
+            lifecycle.checkpoint("cluster::fragment")
+            bnd = tuple(outputs[d] for d in frag.deps)
+            with profile.timer(f"fragment_{frag.fid}_cluster"):
+                out = cluster.exec_fragment(
+                    blob, fp, frag.fid, bnd, tables, profile)
+            lifecycle.account(out, "cluster::fragment")
+            outputs[frag.fid] = out
+        self._bind_operators(profile, self._dist_node_ord(plan))
+        return outputs[ir.fragments[-1].fid]
 
     def _fragment_attempt(self, plan, frag, caps, p, inputs, bnd,
                           scans_meta):

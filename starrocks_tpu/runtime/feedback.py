@@ -155,13 +155,17 @@ class FeedbackStore:
     def _save_locked(self):  # lint: holds _lock  # lint: blocking-ok — sidecar persistence must serialize with entry mutation; the tmp+replace write is bounded by the entry cap and tolerates OSError
         if self._path is None:
             return
+        from .failpoint import FailPointError, fail_point
+
         tmp = self._path + ".tmp"
         try:
+            fail_point("feedback::save")  # injected faults degrade like a
+            #   read-only root: the sidecar skips one write, memory wins
             with open(tmp, "w") as f:
                 json.dump({"entries": self._entries,
                            "quarantine": self._quarantine}, f)
             os.replace(tmp, self._path)
-        except OSError:
+        except (OSError, FailPointError):
             pass  # read-only root: keep learning in memory
 
     # --- consult ------------------------------------------------------------

@@ -471,6 +471,11 @@ def account(obj, stage: str):
     ctx = current()
     if ctx is None:
         return
+    from .failpoint import fail_point
+
+    fail_point("lifecycle::account")  # an injected fault here unwinds the
+    #   statement exactly like a hard-limit breach would (scope exit
+    #   releases every prior charge wholesale)
     n = _nbytes(obj)
     if n:
         ACCOUNTANT.charge(ctx, n, stage)

@@ -77,6 +77,7 @@ import time
 from .. import lockdep
 from . import workgroup as _workgroup  # noqa: F401 — queue-knob definitions
 from .config import config
+from .failpoint import fail_point
 from .metrics import metrics
 from .session import Session
 
@@ -639,6 +640,8 @@ class ServingTier:
         if not self.gate.try_shared(tabs):
             return _FAST_MISS  # DML active/queued on this table: pool path
         try:
+            fail_point("serve::point_inline")  # inside the claim's
+            #   try-finally: injected faults always release the gate
             SERVE_POINT_INLINE.inc()
             SERVE_STATEMENTS.inc()
             return session.sql(sql)
@@ -679,6 +682,8 @@ class ServingTier:
         if not self.gate.try_shared(tabs):
             return _FAST_MISS  # a mutation is active/queued: pool path
         try:
+            fail_point("serve::fast_path")  # inside the claim's
+            #   try-finally: injected faults always release the gate
             SERVE_FAST_PATH.inc()
             SERVE_STATEMENTS.inc()
             return session.sql(sql)
@@ -687,14 +692,37 @@ class ServingTier:
             SERVE_FAST_PATH_HIST.observe(
                 (time.perf_counter() - t0) * 1000.0)
 
+    def attach_cluster(self, runtime):
+        """Route this tier's eligible fragment queries through a
+        multi-process cluster runtime (runtime/cluster_exec.py). The
+        runtime is published on the SHARED catalog, so every pool/
+        connection session — present and future — picks it up; the
+        template session must be distributed (dist_shards set) for
+        fragment plans to exist at all. Detach with `None`."""
+        if runtime is None:
+            if getattr(self.catalog, "cluster_runtime", None) is not None:
+                self.catalog.cluster_runtime = None
+            return self
+        if not self.template.dist_shards:
+            raise ValueError(
+                "cluster routing needs a distributed template session "
+                "(Session(dist_shards=N)) — fragment IR only exists on "
+                "the distributed path")
+        runtime.attach(self.template)
+        return self
+
     def stats(self) -> dict:
-        return {
+        out = {
             "fast_path": SERVE_FAST_PATH.value,
             "point_inline": SERVE_POINT_INLINE.value,
             "statements": SERVE_STATEMENTS.value,
             "pool_pending": self.pool.pending(),
             "plan_cache": self.cache.plan_cache.stats(),
         }
+        cluster = getattr(self.catalog, "cluster_runtime", None)
+        if cluster is not None:
+            out["cluster"] = cluster.stats()
+        return out
 
     def shutdown(self):
         self.pool.shutdown()

@@ -282,6 +282,12 @@ class Session:
     def _save_external_defs(self, add=None, remove=None):
         """External-table definitions survive restarts next to the store's
         manifests (the FE edit-log analog for connector metadata)."""
+        from .failpoint import fail_point
+
+        fail_point("session::external_defs")  # before the read-modify-
+        #   write: an injected fault surfaces as a DDL error with the
+        #   sidecar file untouched (the live catalog keeps the handle;
+        #   only restart durability is degraded)
         import json as _json
         import os
 

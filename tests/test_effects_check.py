@@ -366,6 +366,50 @@ def test_non_daemon_thread_and_missing_stop_rejected():
     assert _rules(effects_check.check_fixture(C4_GOOD)) == []
 
 
+# === contract 4b: subprocess lifecycle =======================================
+
+C4_PROC_BAD = '''
+import subprocess
+
+class Fleet:
+    def spawn(self):
+        self._p = subprocess.Popen(["sleep", "60"])
+'''
+
+C4_PROC_GOOD = '''
+import subprocess
+
+class Fleet:
+    def spawn(self):
+        self._p = subprocess.Popen(["sleep", "60"])
+
+    def stop(self):
+        self._p.terminate()
+        self._p.wait(timeout=5)
+'''
+
+
+def test_popen_without_owner_stop_rejected():
+    rep = effects_check.check_fixture(C4_PROC_BAD)
+    assert _one(rep, "proc-without-stop").severity == "error"
+    assert _rules(effects_check.check_fixture(C4_PROC_GOOD)) == []
+    assert effects_check.check_fixture(C4_PROC_GOOD).stats["procs"] == 1
+
+
+def test_popen_counts_as_proc_acquire_site():
+    from starrocks_tpu.analysis import astwalk
+
+    sites = effects_check.acquire_sites(
+        [astwalk.parse_fixture(C4_PROC_GOOD, "starrocks_tpu/fixture.py")])
+    procs = [s for s in sites if s.kind == "proc"]
+    assert len(procs) == 1 and procs[0].func.endswith(".spawn")
+    assert procs[0].line == 6
+    # ownership (stop/terminate on the owner class) is the guard for a
+    # child process, not a with-block — no unprotected-acquire finding
+    assert "unprotected-acquire" not in _rules(
+        effects_check.check_fixture(C4_PROC_GOOD))
+
+
 # === suppression annotations =================================================
 
 def test_blocking_ok_with_reason_suppresses_and_counts():

@@ -27,6 +27,13 @@ timeout -k 10 1200 env JAX_PLATFORMS=cpu python tools/plan_lint.py --fragments |
 echo "== /metrics live scrape (Prometheus exposition + sr_tpu_ prefix) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/check_metrics_endpoint.py || exit 1
 
+echo "== chaos_fuzz --coverage-check (failpoint coverage of acquire sites) =="
+# round-20 ratchet: every static acquire site must have a failpoint-
+# reachable unwind path in its module, or a written exemption in
+# chaos_fuzz.COVERAGE_EXEMPT — an uncovered module fails the gate.
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/chaos_fuzz.py \
+  --coverage-check || exit 1
+
 echo "== chaos suite (failpoint/KILL/timeout/mem-limit scenarios) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
   -q -m chaos -p no:cacheprovider || exit 1
@@ -41,6 +48,19 @@ if [ -n "${SR_TPU_CHAOS_FUZZ:-}" ]; then
   echo "== chaos_fuzz (randomized fault schedules, seed=$seed) =="
   timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/chaos_fuzz.py \
     --seed "$seed" --rounds 8 || exit 1
+fi
+
+# Opt-in cluster chaos (ISSUE 20): set SR_TPU_CLUSTER_CHAOS=1 to drive a
+# REAL coordinator + 2 worker processes through seeded process-kill /
+# blackhole / delay fault families at the pinned seed (any other integer
+# fuzzes that seed). A red run replays bit-identically via
+# tools/chaos_fuzz.py --cluster --seed N.
+if [ -n "${SR_TPU_CLUSTER_CHAOS:-}" ]; then
+  seed=20260805
+  [ "$SR_TPU_CLUSTER_CHAOS" != "1" ] && seed="$SR_TPU_CLUSTER_CHAOS"
+  echo "== chaos_fuzz --cluster (worker-kill/partition fault families, seed=$seed) =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_fuzz.py \
+    --cluster --seed "$seed" || exit 1
 fi
 
 echo "== tier-1 pytest =="

@@ -945,6 +945,176 @@ def run_serve_bench(threads: int = 32, seconds: float = 8.0,
     return out
 
 
+def run_cluster_phase(workers: int = 2, clients: int = 4,
+                      seconds: float = 8.0) -> dict:
+    """--cluster: N client threads against a coordinator + M worker
+    PROCESSES (runtime/cluster_exec.py), two timed windows:
+
+      steady — every client fires fragment queries against the healthy
+        fleet (each answer checked against a pre-cluster local oracle).
+      kill   — same load; 25% into the window one worker is SIGKILL'd.
+        Queries in flight across the kill re-place their fragments onto
+        the survivors; the phase reports the worst straddling-query
+        latency (retry latency) and the post-kill p99 — the acceptance
+        gate is that the post-kill p99 is FINITE (no wedged query).
+
+    Afterwards the dead worker is respawned and the fleet must report
+    zero dead workers again (gauge recovery), with zero leaked slots/
+    bytes/registry entries."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # the coordinator session is distributed (dist_shards=2): widen
+        # this process's host platform BEFORE any jax backend initializes
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+    import starrocks_tpu.sql.distributed as D
+    from starrocks_tpu import lockdep
+    from starrocks_tpu.runtime.cluster import WORKERS_DEAD
+    from starrocks_tpu.runtime.cluster_exec import ClusterRuntime
+    from starrocks_tpu.runtime.config import config
+    from starrocks_tpu.runtime.events import EVENTS
+    from starrocks_tpu.runtime.lifecycle import ACCOUNTANT, REGISTRY
+
+    sh0, gr0 = D.SHARD_THRESHOLD_ROWS, D.SHUFFLE_AGG_MIN_GROUPS
+    frag0 = config.get("dist_fragments")
+    qc0 = config.get("enable_query_cache")
+    D.SHARD_THRESHOLD_ROWS = 100
+    D.SHUFFLE_AGG_MIN_GROUPS = 10
+    config.set("dist_fragments", True)
+    config.set("enable_query_cache", False)
+
+    from starrocks_tpu.runtime.session import Session
+
+    s = Session(dist_shards=2)
+    s.sql("create table t (a int, b int)")
+    s.sql("insert into t values "
+          + ", ".join(f"({i % 97}, {i % 7})" for i in range(400)))
+    s.sql("create table d (k int, v int)")
+    s.sql("insert into d values "
+          + ", ".join(f"({i}, {i * 10})" for i in range(97)))
+    variants = [
+        "select d.v, sum(t.b) s from t join d on t.a = d.k "
+        f"group by d.v order by s desc, d.v limit {n}" for n in (5, 7, 9)
+    ]
+    oracles = {sql: s.sql(sql).rows() for sql in variants}
+
+    t_setup = time.monotonic()
+    cr = ClusterRuntime(n_workers=workers, shards=2, hb_interval_s=0.1,
+                        hb_miss_limit=3).start(s)
+    cr.attach(s)
+    mem0 = ACCOUNTANT.snapshot()["process_bytes"]
+    errors: list = []
+    lat_lock = threading.Lock()
+
+    def timed_window(window_s: float, kill_at_frac: float | None):
+        """Run `clients` sessions over the shared catalog for window_s;
+        optionally SIGKILL w0 at kill_at_frac of the window. Returns
+        (samples, kill_ts) where samples are (t0, t1, ms) monotonic."""
+        samples: list = []
+        stop_at = time.monotonic() + window_s
+        kill_ts = [None]
+
+        def client_loop(i: int):
+            rng = random.Random(4200 + i)
+            cs = Session(catalog=s.catalog, cache=s.cache, dist_shards=2)
+            my: list = []
+            while time.monotonic() < stop_at:
+                sql = rng.choice(variants)
+                t0 = time.monotonic()
+                try:
+                    rows = cs.sql(sql).rows()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+                    continue
+                t1 = time.monotonic()
+                if rows != oracles[sql]:
+                    errors.append(f"oracle mismatch on: {sql[-20:]}")
+                my.append((t0, t1, (t1 - t0) * 1000.0))
+            with lat_lock:
+                samples.extend(my)
+
+        threads_ = [threading.Thread(target=client_loop, args=(i,),
+                                     daemon=True) for i in range(clients)]
+        for th in threads_:
+            th.start()
+        if kill_at_frac is not None:
+            time.sleep(window_s * kill_at_frac)
+            # hold w0's next fragment in a delay so the SIGKILL lands
+            # mid-fragment — the retry path, not just a re-placement of
+            # future fragments onto the survivors
+            cr.inject_fault("w0", "delay", seconds=2.0, times=1)
+            time.sleep(0.6)  # let a fragment land in w0's delay window
+            kill_ts[0] = time.monotonic()
+            cr.kill_worker("w0")
+        for th in threads_:
+            th.join(timeout=window_s + 120.0)
+        if any(th.is_alive() for th in threads_):
+            errors.append("wedged client: a query never returned")
+        return samples, kill_ts[0]
+
+    out: dict = {"cluster_workers": workers, "cluster_clients": clients}
+    try:
+        for sql in variants:  # warm: fragment programs cached fleet-wide
+            if s.sql(sql).rows() != oracles[sql]:
+                errors.append("warm-up cluster answer diverged")
+        out["setup_s"] = round(time.monotonic() - t_setup, 1)
+        r0 = cr.stats()["retries_total"]
+        loss0 = EVENTS.stats().get("heartbeat_loss", 0)
+
+        steady, _ = timed_window(seconds / 2, None)
+        sl = sorted(ms for _, _, ms in steady)
+        out["steady"] = {
+            "queries": len(sl), "qps": round(len(sl) / (seconds / 2), 1),
+            "p50_ms": round(_pct(sl, 0.50), 2),
+            "p99_ms": round(_pct(sl, 0.99), 2),
+        }
+
+        killed, kill_ts = timed_window(seconds / 2, 0.25)
+        post = sorted(ms for _, t1, ms in killed if t1 >= kill_ts)
+        straddle = [ms for t0, t1, ms in killed if t0 < kill_ts <= t1]
+        out["kill"] = {
+            "queries": len(killed), "post_kill": len(post),
+            "straddling": len(straddle),
+            "retry_latency_ms": round(max(straddle), 2) if straddle
+            else None,
+            "p99_ms": round(_pct(post, 0.99), 2),
+        }
+        out["cluster_retries"] = cr.stats()["retries_total"] - r0
+        out["cluster_kill_p99_ms"] = out["kill"]["p99_ms"]
+        if not post:
+            errors.append("kill phase produced no post-kill samples")
+
+        # recovery: the fleet heals and the observability plane saw it
+        if EVENTS.stats().get("heartbeat_loss", 0) <= loss0:
+            errors.append("kill was not observed (no heartbeat_loss)")
+        cr.respawn_worker("w0")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and WORKERS_DEAD.value > 0:
+            time.sleep(0.1)
+        out["recovered"] = WORKERS_DEAD.value == 0
+        if not out["recovered"]:
+            errors.append("dead-worker gauge did not recover after "
+                          "respawn")
+    finally:
+        s.catalog.cluster_runtime = None
+        cr.stop()
+        D.SHARD_THRESHOLD_ROWS, D.SHUFFLE_AGG_MIN_GROUPS = sh0, gr0
+        config.set("dist_fragments", frag0)
+        config.set("enable_query_cache", qc0)
+
+    out["leaks"] = {
+        "process_bytes": ACCOUNTANT.snapshot()["process_bytes"] - mem0,
+        "registry": len(REGISTRY.snapshot()),
+    }
+    out["witness_cycles"] = len(lockdep.WITNESS.order_cycles())
+    out["errors"] = errors[:5]
+    out["cluster_pass"] = (
+        not errors and out["cluster_kill_p99_ms"] > 0.0
+        and not out["leaks"]["process_bytes"] and not out["leaks"]["registry"]
+        and not out["witness_cycles"])
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="sustained mixed-workload serving benchmark")
@@ -977,9 +1147,34 @@ def main():
                          "(audit+events+sampler on vs off; <5%% gate)")
     ap.add_argument("--no-obs", action="store_true",
                     help="skip the observability A/B phase in the full run")
+    ap.add_argument("--cluster", action="store_true",
+                    help="run ONLY the cluster phase: clients against a "
+                         "coordinator + worker PROCESSES with a "
+                         "kill-one-worker window (retry latency + "
+                         "post-kill p99)")
+    ap.add_argument("--cluster-workers", type=int, default=2,
+                    help="worker processes for --cluster")
+    ap.add_argument("--cluster-clients", type=int, default=4,
+                    help="client threads for --cluster")
     ap.add_argument("--detail", action="store_true",
                     help="merge a 'serve' section into BENCH_DETAIL.json")
     args = ap.parse_args()
+
+    if args.cluster:
+        res = run_cluster_phase(workers=args.cluster_workers,
+                                clients=args.cluster_clients,
+                                seconds=args.seconds)
+        if args.detail:
+            path = os.path.join(REPO, "BENCH_DETAIL.json")
+            detail = {}
+            if os.path.exists(path):
+                with open(path) as f:
+                    detail = json.load(f)
+            detail["cluster"] = res
+            with open(path, "w") as f:
+                json.dump(detail, f, indent=1)
+        print(json.dumps(res))
+        return 0 if res["cluster_pass"] else 1
 
     if args.points:
         import jax
